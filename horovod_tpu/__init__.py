@@ -1,0 +1,104 @@
+"""horovod_tpu — a TPU-native distributed training framework.
+
+A brand-new framework with the capabilities of Horovod (the reference at
+/root/reference, v0.23.0 — see SURVEY.md), re-architected for TPU:
+
+* data plane = XLA collectives over ICI/DCN (``jax.lax.psum`` et al.) instead
+  of NCCL/MPI/Gloo transports;
+* rendezvous = the JAX coordination service instead of MPI init / Gloo HTTP;
+* jit-native fused gradient path (DistributedOptimizer over optax) plus an
+  eager negotiated path for Horovod-style named async collectives;
+* parallelism substrate beyond the reference: mesh axes for dp/tp/sp/ep,
+  reduce-scatter, ring attention (SURVEY.md §2.7, §5.7).
+
+Typical use::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .common.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    num_devices,
+    local_devices,
+    global_devices,
+    is_homogeneous,
+    topology,
+    mesh,
+    set_mesh,
+)
+from .common.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    remove_process_set,
+    global_process_set,
+    process_set_by_id,
+)
+from .common.types import ReduceOp, Status  # noqa: F401
+from .common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+
+# Reduce-op aliases matching the reference's module-level constants
+# (ref: torch/mpi_ops.py Average/Sum/Adasum/Min/Max/Product).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+from . import ops  # noqa: F401,E402
+from .ops import device  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # Lazy imports for heavier subsystems so `import horovod_tpu` stays fast.
+    try:
+        if name in ("allreduce", "allreduce_async", "allgather",
+                    "allgather_async", "broadcast", "broadcast_async",
+                    "alltoall", "alltoall_async", "reducescatter",
+                    "grouped_allreduce", "grouped_allreduce_async",
+                    "synchronize", "poll", "join", "barrier"):
+            from .ops import eager
+
+            return getattr(eager, name)
+        if name == "DistributedOptimizer":
+            from .optimizer import DistributedOptimizer
+
+            return DistributedOptimizer
+        if name in ("broadcast_parameters", "broadcast_optimizer_state",
+                    "broadcast_object"):
+            from . import functions
+
+            return getattr(functions, name)
+        if name == "Compression":
+            from .ops.compression import Compression
+
+            return Compression
+        if name == "elastic":
+            from . import elastic
+
+            return elastic
+        if name == "timeline":
+            from . import timeline
+
+            return timeline
+    except ImportError as e:
+        raise AttributeError(
+            f"horovod_tpu.{name} is unavailable: {e}") from e
+    raise AttributeError(f"module 'horovod_tpu' has no attribute {name!r}")

@@ -1,0 +1,1 @@
+from . import basics, config, exceptions, process_sets, types  # noqa: F401

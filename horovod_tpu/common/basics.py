@@ -1,0 +1,335 @@
+"""Process model, topology, and lifecycle — the ``hvd.init()`` layer.
+
+TPU-native re-conception of the reference's init path
+(ref: common/basics.py:33-489 HorovodBasics; operations.cc:811-863
+InitializeHorovodOnce; operations.cc:887-1353 C API).
+
+Key design translation (SURVEY.md §7 step 1):
+
+* rank / local_rank / cross_rank map onto JAX's process topology:
+  ``rank`` = ``jax.process_index()``, ``cross_rank`` = host index,
+  ``local_rank`` = position within the host.  The launcher provides these
+  via the ``HVDT_*`` env contract (the analog of runner/gloo_run.py:65-76);
+  without a launcher they are derived from JAX itself.
+* Rendezvous = the JAX coordination service (``jax.distributed.initialize``),
+  replacing the reference's MPI init / Gloo HTTP rendezvous
+  (gloo/gloo_context.cc).
+* There is no background C++ thread to spawn at init: under jit, collective
+  scheduling is XLA's job.  The eager negotiated path (ops/eager.py) starts
+  its controller thread lazily on first use.
+
+Unlike the reference (one process per accelerator), JAX runs one process per
+*host* controlling several local devices; chip-level parallelism is expressed
+through sharded arrays over the mesh.  ``size()``/``rank()`` therefore count
+processes (matching the reference's process semantics) while
+``num_devices()``/``device_rank`` count chips.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import os
+import threading
+from typing import Any, List, Optional, Sequence
+
+from . import config
+from .exceptions import NotInitializedError
+from .logging_util import get_logger
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "size",
+    "local_rank",
+    "local_size",
+    "cross_rank",
+    "cross_size",
+    "num_devices",
+    "local_devices",
+    "global_devices",
+    "is_homogeneous",
+    "Topology",
+    "topology",
+]
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static process/device topology, fixed at init.
+
+    (ref: the rank/local_rank/cross_rank triple of SlotInfo,
+    runner/common/util/hosts.py:155, consumed by controller
+    DoInitialization mpi_controller.cc:28.)
+    """
+
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    num_devices: int          # global device (chip) count
+    num_local_devices: int
+
+    @property
+    def is_homogeneous(self) -> bool:
+        return self.num_devices == self.num_local_devices * self.cross_size * (
+            self.local_size if self.local_size else 1
+        ) or self.size == 1
+
+
+class _GlobalState:
+    """Process-wide framework state (ref: global_state.h:39-126
+    HorovodGlobalState — minus the background thread, which on TPU only
+    exists for the eager path and lives in ops/eager.py)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.topology: Optional[Topology] = None
+        self.mesh = None  # jax.sharding.Mesh over all participating devices
+        self.process_set_table = None  # built at init (process_sets.py)
+        self.eager_controller = None   # lazy (ops/eager.py)
+        self.timeline = None           # lazy (timeline.py)
+        self.joined = False
+
+    def reset(self) -> None:
+        self.initialized = False
+        self.topology = None
+        self.mesh = None
+        self.process_set_table = None
+        self.eager_controller = None
+        self.timeline = None
+        self.joined = False
+
+
+_state = _GlobalState()
+
+
+def _global_state() -> _GlobalState:
+    return _state
+
+
+def _jax_distributed_initialized() -> bool:
+    """True if the JAX distributed runtime is already connected.
+
+    Must not initialize the XLA backend as a side effect (unlike
+    jax.process_count()), since jax.distributed.initialize() has to run
+    before backend init."""
+    import jax
+
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    from jax._src import distributed as _dist  # fallback for older jax
+
+    return getattr(_dist.global_state, "client", None) is not None
+
+
+def _build_default_mesh(devices: Sequence[Any]):
+    """Build the default mesh: 1-D data-parallel over all devices, or the
+    axes requested via HVDT_MESH_AXES (e.g. 'dp=4,tp=2')."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    spec = config.get_str("HVDT_MESH_AXES")
+    devs = np.asarray(devices, dtype=object)
+    if not spec:
+        return Mesh(devs, ("dp",))
+    axes, sizes = [], []
+    for part in spec.split(","):
+        name, _, sz = part.strip().partition("=")
+        axes.append(name)
+        sizes.append(int(sz))
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != len(devices):
+        raise ValueError(
+            f"HVDT_MESH_AXES product {total} != device count {len(devices)}")
+    return Mesh(devs.reshape(sizes), tuple(axes))
+
+
+def init(
+    *,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    mesh=None,
+    process_sets: Optional[Sequence[Sequence[int]]] = None,
+) -> None:
+    """Initialize the framework (ref: basics.py init → operations.cc:889
+    horovod_init).
+
+    Reads the launcher env contract (HVDT_RANK/SIZE/LOCAL_RANK/...) when
+    present; connects the JAX distributed runtime for multi-process runs;
+    builds the global device mesh and process-set table.
+
+    Args:
+      coordinator_address: host:port of the JAX coordination service.
+        Defaults to HVDT_COORDINATOR_ADDR from the launcher.
+      num_processes / process_id: override process topology (defaults from
+        the env contract).
+      mesh: optional pre-built jax.sharding.Mesh to adopt instead of the
+        default 1-D data-parallel mesh.
+      process_sets: optional list of rank lists to register as process sets
+        at init (ref: horovod_init's ranks argument + init(comm=[...])).
+    """
+    import jax
+
+    with _state.lock:
+        if _state.initialized:
+            log.debug("init() called twice; ignoring")
+            return
+
+        env_size = config.get_int("HVDT_SIZE")
+        env_rank = config.get_int("HVDT_RANK")
+        coord = coordinator_address or config.get_str("HVDT_COORDINATOR_ADDR")
+        n_proc = num_processes if num_processes is not None else (
+            env_size if env_size > 0 else None)
+        proc_id = process_id if process_id is not None else (
+            env_rank if env_rank >= 0 else None)
+
+        # jax.distributed.initialize must run before anything initializes the
+        # XLA backend (jax.process_count() would), so the "already connected"
+        # check must not touch the backend.
+        if coord and (n_proc or 0) > 1 and not _jax_distributed_initialized():
+            log.info("connecting JAX distributed runtime at %s (%s/%s)",
+                     coord, proc_id, n_proc)
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=n_proc,
+                process_id=proc_id,
+            )
+
+        p_rank = jax.process_index()
+        p_size = jax.process_count()
+
+        local_rank_ = config.get_int("HVDT_LOCAL_RANK")
+        local_size_ = config.get_int("HVDT_LOCAL_SIZE")
+        cross_rank_ = config.get_int("HVDT_CROSS_RANK")
+        cross_size_ = config.get_int("HVDT_CROSS_SIZE")
+        if local_rank_ < 0:
+            local_rank_, local_size_ = 0, 1
+            cross_rank_, cross_size_ = p_rank, p_size
+
+        devices = jax.devices()
+        topo = Topology(
+            rank=p_rank,
+            size=p_size,
+            local_rank=local_rank_,
+            local_size=local_size_,
+            cross_rank=cross_rank_,
+            cross_size=cross_size_,
+            num_devices=len(devices),
+            num_local_devices=len(jax.local_devices()),
+        )
+
+        _state.topology = topo
+        _state.mesh = mesh if mesh is not None else _build_default_mesh(devices)
+
+        from . import process_sets as ps
+
+        _state.process_set_table = ps.ProcessSetTable(topo, _state.mesh)
+        if process_sets:
+            for ranks in process_sets:
+                _state.process_set_table.add(list(ranks))
+
+        _state.initialized = True
+        log.info("initialized: %s", topo)
+
+
+def shutdown() -> None:
+    """Tear down (ref: operations.cc horovod_shutdown)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.eager_controller is not None:
+            _state.eager_controller.shutdown()
+        if _state.timeline is not None:
+            _state.timeline.close()
+        _state.reset()
+
+
+atexit.register(shutdown)
+
+
+def _topo() -> Topology:
+    t = _state.topology
+    if t is None:
+        raise NotInitializedError()
+    return t
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def topology() -> Topology:
+    return _topo()
+
+
+def rank() -> int:
+    return _topo().rank
+
+
+def size() -> int:
+    return _topo().size
+
+
+def local_rank() -> int:
+    return _topo().local_rank
+
+
+def local_size() -> int:
+    return _topo().local_size
+
+
+def cross_rank() -> int:
+    return _topo().cross_rank
+
+
+def cross_size() -> int:
+    return _topo().cross_size
+
+
+def num_devices() -> int:
+    return _topo().num_devices
+
+
+def is_homogeneous() -> bool:
+    return _topo().is_homogeneous
+
+
+def local_devices() -> List[Any]:
+    import jax
+
+    _topo()
+    return list(jax.local_devices())
+
+
+def global_devices() -> List[Any]:
+    import jax
+
+    _topo()
+    return list(jax.devices())
+
+
+def mesh():
+    """The global device mesh adopted at init."""
+    m = _state.mesh
+    if m is None:
+        raise NotInitializedError()
+    return m
+
+
+def set_mesh(new_mesh) -> None:
+    """Adopt a caller-provided mesh as the global mesh (axes for dp/tp/...)."""
+    with _state.lock:
+        _topo()
+        _state.mesh = new_mesh

@@ -1,0 +1,139 @@
+"""Env-var knob registry — single source of truth for runtime configuration.
+
+TPU-native analog of the reference's env registry (ref: common/common.h:107-141,
+parsed in operations.cc:436-607 and utils/env_parser.cc).  Precedence follows
+the reference (runner/common/util/config_parser.py): CLI > env > config file >
+built-in default; the launcher translates CLI flags into these env vars.
+
+All knobs use the ``HVDT_`` prefix (Horovod-TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["Knob", "KNOBS", "get", "get_bool", "get_int", "get_float", "get_str", "registry_doc"]
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    doc: str
+
+    def read(self) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        try:
+            return self.parser(raw)
+        except (ValueError, TypeError):
+            return self.default
+
+
+def _k(name: str, default: Any, parser: Callable[[str], Any], doc: str) -> Knob:
+    return Knob(name, default, parser, doc)
+
+
+# Registry.  Reference analogs noted per knob (common.h line refs).
+KNOBS: Dict[str, Knob] = {
+    k.name: k
+    for k in [
+        # --- fusion / cycle (ref: HOROVOD_FUSION_THRESHOLD common.h:112,
+        #     HOROVOD_CYCLE_TIME :113) ---
+        _k("HVDT_FUSION_THRESHOLD", 64 * 1024 * 1024, int,
+           "Tensor-fusion bucket size in bytes for fused collectives. "
+           "64 MiB default (TPU HBM-friendly; ref default 128 MiB)."),
+        _k("HVDT_CYCLE_TIME", 0.0, float,
+           "Background-loop cycle time in ms for the eager path. 0 = run "
+           "as fast as possible (XLA mode forces 0 in the reference, "
+           "operations.cc:500-506)."),
+        _k("HVDT_BATCH_COLLECTIVES", True, _parse_bool,
+           "Pack multiple same-dtype tensors into one fused collective."),
+        # --- cache (ref: HOROVOD_CACHE_CAPACITY common.h:114) ---
+        _k("HVDT_CACHE_CAPACITY", 1024, int,
+           "Response-cache capacity (negotiated-collective descriptors)."),
+        # --- autotune (ref: HOROVOD_AUTOTUNE* common.h:132-137) ---
+        _k("HVDT_AUTOTUNE", False, _parse_bool,
+           "Enable Bayesian autotuning of fusion threshold / cycle time."),
+        _k("HVDT_AUTOTUNE_LOG", "", str, "CSV log file for autotune samples."),
+        _k("HVDT_AUTOTUNE_WARMUP_SAMPLES", 3, int, "Autotune warmup discard count."),
+        _k("HVDT_AUTOTUNE_STEPS_PER_SAMPLE", 10, int, "Steps per autotune sample."),
+        _k("HVDT_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20, int, "Max BO samples."),
+        _k("HVDT_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8, float, "GP noise alpha."),
+        # --- timeline (ref: HOROVOD_TIMELINE common.h:110) ---
+        _k("HVDT_TIMELINE", "", str,
+           "Write per-tensor Chrome-tracing timeline JSON to this path."),
+        _k("HVDT_TIMELINE_MARK_CYCLES", False, _parse_bool,
+           "Mark background-loop cycles in the timeline."),
+        # --- stall detection (ref: HOROVOD_STALL_CHECK_* common.h:116-118) ---
+        _k("HVDT_STALL_CHECK_DISABLE", False, _parse_bool, "Disable stall inspector."),
+        _k("HVDT_STALL_CHECK_TIME_SECONDS", 60, int,
+           "Warn when a tensor is ready on some-but-not-all ranks this long."),
+        _k("HVDT_STALL_SHUTDOWN_TIME_SECONDS", 0, int,
+           "Abort after this long stalled (0 = never)."),
+        # --- logging (ref: HOROVOD_LOG_LEVEL) ---
+        _k("HVDT_LOG_LEVEL", "warning", str,
+           "trace|debug|info|warning|error|fatal"),
+        _k("HVDT_LOG_HIDE_TIME", False, _parse_bool, "Hide timestamps in log lines."),
+        # --- elastic (ref: HOROVOD_ELASTIC common.h:139) ---
+        _k("HVDT_ELASTIC", False, _parse_bool, "Elastic (fault-tolerant) mode."),
+        # --- topology / rendezvous (set by the launcher; ref env contract
+        #     runner/gloo_run.py:65-76) ---
+        _k("HVDT_RANK", -1, int, "Global process rank (set by launcher)."),
+        _k("HVDT_SIZE", -1, int, "Global process count (set by launcher)."),
+        _k("HVDT_LOCAL_RANK", -1, int, "Rank within the host (set by launcher)."),
+        _k("HVDT_LOCAL_SIZE", -1, int, "Processes on this host (set by launcher)."),
+        _k("HVDT_CROSS_RANK", -1, int, "Host index (set by launcher)."),
+        _k("HVDT_CROSS_SIZE", -1, int, "Number of hosts (set by launcher)."),
+        _k("HVDT_HOSTNAME", "", str, "Logical hostname assigned by launcher."),
+        _k("HVDT_COORDINATOR_ADDR", "", str,
+           "host:port of the JAX coordination service / rendezvous KV."),
+        _k("HVDT_RENDEZVOUS_ADDR", "", str, "Rendezvous HTTP KV server address."),
+        _k("HVDT_RENDEZVOUS_PORT", 0, int, "Rendezvous HTTP KV server port."),
+        _k("HVDT_SECRET_KEY", "", str, "HMAC key for launcher RPC authentication."),
+        # --- mesh defaults ---
+        _k("HVDT_MESH_AXES", "", str,
+           "Comma list of axis=size pairs for the default mesh, e.g. "
+           "'dp=4,tp=2'. Empty = 1-D data-parallel mesh over all devices."),
+        # --- numerics ---
+        _k("HVDT_ALLREDUCE_DTYPE", "", str,
+           "Force wire dtype for allreduce ('bfloat16' for compression-"
+           "on-the-wire; empty = tensor dtype)."),
+    ]
+}
+
+
+def get(name: str) -> Any:
+    return KNOBS[name].read()
+
+
+def get_bool(name: str) -> bool:
+    return bool(get(name))
+
+
+def get_int(name: str) -> int:
+    return int(get(name))
+
+
+def get_float(name: str) -> float:
+    return float(get(name))
+
+
+def get_str(name: str) -> str:
+    return str(get(name))
+
+
+def registry_doc() -> str:
+    """Render the knob registry as help text (used by the CLI)."""
+    lines = []
+    for k in KNOBS.values():
+        lines.append(f"{k.name} (default: {k.default!r})\n    {k.doc}")
+    return "\n".join(lines)

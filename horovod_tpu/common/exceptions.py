@@ -1,0 +1,45 @@
+"""Framework exceptions (ref: horovod/common/exceptions.py:1-49)."""
+
+from __future__ import annotations
+
+__all__ = [
+    "HorovodTpuError",
+    "HorovodInternalError",
+    "HostsUpdatedInterrupt",
+    "NotInitializedError",
+    "TensorMismatchError",
+]
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective operation fails mid-flight.
+
+    In elastic mode this triggers restore-from-last-commit
+    (ref: common/exceptions.py:23, common/elastic.py:151-175).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Raised in elastic mode when host membership changed; training should
+    re-rendezvous without rolling back state (ref: common/exceptions.py:33).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    def __init__(self, what: str = "Framework"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class TensorMismatchError(HorovodTpuError):
+    """Shape/dtype/op mismatch across ranks detected during negotiation
+    (ref: controller.cc:495 ConstructResponse error branches)."""

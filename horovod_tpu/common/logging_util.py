@@ -1,0 +1,42 @@
+"""Leveled, rank-tagged logging (ref: common/logging.{h,cc} LOG(level, rank))."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(5, "TRACE")
+
+_configured = False
+
+
+def get_logger(name: str = "horovod_tpu") -> logging.Logger:
+    global _configured
+    logger = logging.getLogger(name)
+    if not _configured:
+        from . import config
+
+        level = _LEVELS.get(config.get_str("HVDT_LOG_LEVEL").lower(), logging.WARNING)
+        handler = logging.StreamHandler(sys.stderr)
+        rank = os.environ.get("HVDT_RANK", "-")
+        if config.get_bool("HVDT_LOG_HIDE_TIME"):
+            fmt = f"[%(levelname)s | rank {rank}] %(message)s"
+        else:
+            fmt = f"%(asctime)s [%(levelname)s | rank {rank}] %(message)s"
+        handler.setFormatter(logging.Formatter(fmt))
+        root = logging.getLogger("horovod_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+    return logger

@@ -1,0 +1,156 @@
+"""Process sets — sub-groups of ranks doing independent collectives.
+
+TPU-native re-conception of the reference's process sets
+(ref: common/process_set.{h,cc} ProcessSet/ProcessSetTable;
+Python API common/process_sets.py:1-163; dynamic add/remove coordination
+operations.cc:1211-1277).
+
+Translation: in the reference each ProcessSet owns its own controller,
+TensorQueue and ResponseCache because collectives are negotiated at runtime.
+On TPU a process set maps to a **sub-mesh**: the jax devices belonging to the
+member processes.  Collectives inside jit are compiled against that sub-mesh;
+the eager path keys its queues/caches by process-set id.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .exceptions import HorovodTpuError
+
+__all__ = ["ProcessSet", "ProcessSetTable", "global_process_set", "add_process_set", "remove_process_set", "process_set_by_id"]
+
+
+class ProcessSet:
+    """A set of process ranks + the sub-mesh over their devices."""
+
+    def __init__(self, ranks: Sequence[int], set_id: int, topo, parent_mesh):
+        self.ranks: List[int] = sorted(set(int(r) for r in ranks))
+        self.id = set_id
+        self._topo = topo
+        self._mesh = None
+        self._parent_mesh = parent_mesh
+
+    # -- membership ---------------------------------------------------------
+    def included(self, global_rank: Optional[int] = None) -> bool:
+        r = self._topo.rank if global_rank is None else global_rank
+        return r in self.ranks
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank within the set (ref: process_sets.py rank())."""
+        if not self.included():
+            raise HorovodTpuError(
+                f"Process {self._topo.rank} is not part of process set {self.id}")
+        return self.ranks.index(self._topo.rank)
+
+    # -- mesh ---------------------------------------------------------------
+    @property
+    def mesh(self):
+        """Sub-mesh over the devices owned by member processes (1-D 'dp')."""
+        if self._mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            if self.ranks == list(range(self._topo.size)):
+                self._mesh = self._parent_mesh
+            else:
+                devs = [d for d in jax.devices()
+                        if d.process_index in set(self.ranks)]
+                self._mesh = Mesh(np.asarray(devs, dtype=object), ("dp",))
+        return self._mesh
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.id}, ranks={self.ranks})"
+
+
+class ProcessSetTable:
+    """id → ProcessSet registry (ref: common/process_set.{h,cc}
+    ProcessSetTable; lock-guarded like operations.cc:336)."""
+
+    GLOBAL_ID = 0
+
+    def __init__(self, topo, global_mesh):
+        self._lock = threading.RLock()
+        self._topo = topo
+        self._global_mesh = global_mesh
+        self._next_id = 1
+        self._sets: Dict[int, ProcessSet] = {
+            self.GLOBAL_ID: ProcessSet(range(topo.size), self.GLOBAL_ID, topo,
+                                       global_mesh)
+        }
+
+    def get(self, set_id: int) -> ProcessSet:
+        with self._lock:
+            try:
+                return self._sets[set_id]
+            except KeyError:
+                raise HorovodTpuError(f"Unknown process set id {set_id}")
+
+    def global_set(self) -> ProcessSet:
+        return self.get(self.GLOBAL_ID)
+
+    def add(self, ranks: Sequence[int]) -> ProcessSet:
+        """Register a new process set.
+
+        All member ranks must call with identical rank lists — deterministic
+        ids replace the reference's cross-rank id negotiation
+        (operations.cc:1211-1277): under SPMD every process executes the
+        same registration sequence, so ids agree by construction.
+        """
+        ranks = sorted(set(int(r) for r in ranks))
+        bad = [r for r in ranks if r < 0 or r >= self._topo.size]
+        if bad:
+            raise HorovodTpuError(f"Invalid ranks for process set: {bad}")
+        with self._lock:
+            for ps in self._sets.values():
+                if ps.ranks == ranks:
+                    return ps
+            ps = ProcessSet(ranks, self._next_id, self._topo, self._global_mesh)
+            self._sets[self._next_id] = ps
+            self._next_id += 1
+            return ps
+
+    def remove(self, set_id: int) -> None:
+        if set_id == self.GLOBAL_ID:
+            raise HorovodTpuError("Cannot remove the global process set")
+        with self._lock:
+            self._sets.pop(set_id, None)
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._sets)
+
+
+# -- module-level convenience API (ref: common/process_sets.py) -------------
+
+def _table() -> ProcessSetTable:
+    from . import basics
+
+    tbl = basics._global_state().process_set_table
+    if tbl is None:
+        from .exceptions import NotInitializedError
+
+        raise NotInitializedError()
+    return tbl
+
+
+def global_process_set() -> ProcessSet:
+    return _table().global_set()
+
+
+def add_process_set(ranks: Sequence[int]) -> ProcessSet:
+    return _table().add(ranks)
+
+
+def remove_process_set(set_id: int) -> None:
+    _table().remove(set_id)
+
+
+def process_set_by_id(set_id: int) -> ProcessSet:
+    return _table().get(set_id)

@@ -1,0 +1,181 @@
+"""Core framework-neutral types.
+
+TPU-native re-conception of the reference's core type layer
+(ref: horovod/common/common.h:197-382 — Status, TensorShape, DataType,
+TensorTableEntry).  On TPU the tensor abstraction is a jax.Array, so the
+adapter interfaces (Tensor/OpContext/PersistentBuffer/ReadyEvent,
+common.h:259-339) collapse into plain functions over pytrees; what remains
+load-bearing here is the Status machinery used by the async eager path and
+the dtype registry shared by the wire protocol and the collective layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "StatusType",
+    "Status",
+    "DataType",
+    "TensorShape",
+    "ReduceOp",
+    "DATA_TYPE_TO_NUMPY",
+    "NUMPY_TO_DATA_TYPE",
+    "data_type_of",
+]
+
+
+class StatusType(enum.IntEnum):
+    """Mirrors the reference status taxonomy (common.h:190-195)."""
+
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class Status:
+    """Async operation status (ref: common.h:197-232)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def ok() -> "Status":
+        return _OK
+
+    @staticmethod
+    def unknown(msg: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def precondition(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def invalid_argument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def in_progress() -> "Status":
+        return Status(StatusType.IN_PROGRESS, "")
+
+    def ok_p(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress_p(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+
+_OK = Status()
+
+# Error message used when two in-flight tensors share a name
+# (ref: common.h:229 DUPLICATE_NAME_ERROR).
+DUPLICATE_NAME_ERROR = (
+    "Requested to collective-op a tensor with the same name as another tensor "
+    "that is currently being processed.  If you want to request another tensor, "
+    "use a different tensor name."
+)
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype enum (ref: message.h:30-41).
+
+    Values kept stable — they appear in the serialized wire protocol.
+    """
+
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    # TPU-native extension: bf16 is the native matmul dtype on TPU.
+    BFLOAT16 = 10
+
+
+def _bfloat16_np():
+    import ml_dtypes  # ships with jax
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+DATA_TYPE_TO_NUMPY = {
+    DataType.UINT8: np.dtype(np.uint8),
+    DataType.INT8: np.dtype(np.int8),
+    DataType.UINT16: np.dtype(np.uint16),
+    DataType.INT16: np.dtype(np.int16),
+    DataType.INT32: np.dtype(np.int32),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.FLOAT16: np.dtype(np.float16),
+    DataType.FLOAT32: np.dtype(np.float32),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.BOOL: np.dtype(np.bool_),
+}
+
+NUMPY_TO_DATA_TYPE = {v: k for k, v in DATA_TYPE_TO_NUMPY.items()}
+
+
+def data_type_of(array: Any) -> DataType:
+    """Map a numpy/jax array (or dtype) to the wire DataType."""
+    dtype = np.dtype(getattr(array, "dtype", array))
+    if dtype.name == "bfloat16":
+        return DataType.BFLOAT16
+    try:
+        return NUMPY_TO_DATA_TYPE[dtype]
+    except KeyError as e:
+        raise ValueError(f"Unsupported dtype for collective ops: {dtype}") from e
+
+
+def numpy_dtype_of(dt: DataType) -> np.dtype:
+    if dt == DataType.BFLOAT16:
+        return _bfloat16_np()
+    return DATA_TYPE_TO_NUMPY[dt]
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction selector (ref: message carries ReduceOp for allreduce;
+    Average/Sum split into prescale/postscale in the bindings —
+    torch/mpi_ops.py and tensorflow/__init__.py:55)."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class TensorShape:
+    """Shape value object (ref: common.h:234-257)."""
+
+    dims: Tuple[int, ...] = ()
+
+    @staticmethod
+    def of(array: Any) -> "TensorShape":
+        return TensorShape(tuple(int(d) for d in array.shape))
+
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def __str__(self) -> str:
+        return "[" + ", ".join(str(d) for d in self.dims) + "]"

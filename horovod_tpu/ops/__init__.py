@@ -1,0 +1,1 @@
+from . import device  # noqa: F401

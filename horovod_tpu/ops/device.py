@@ -1,0 +1,230 @@
+"""Device-level collective primitives — the TPU data plane.
+
+This module is the TPU-native replacement for the reference's entire
+collective-op backend stack (ref: ops/mpi_operations.cc, ops/nccl_operations.cc,
+ops/gloo_operations.cc, ops/ccl_operations.cc — SURVEY.md §2.2): instead of
+hand-written transports, collectives are XLA programs over ICI/DCN expressed
+with ``jax.lax`` named-axis primitives.  They are valid inside ``shard_map``
+/ ``pjit`` bodies where the named mesh axes are bound.
+
+Design notes (SURVEY.md §5.8): under jit, op order is globally consistent, so
+the reference's name-negotiation machinery is unnecessary here — XLA plays the
+role of the OperationManager, and fusion is explicit bucketing (see
+``fused_allreduce``) mirroring the FusionBufferManager
+(ref: common/fusion_buffer_manager.{h,cc}, controller.cc:808 FuseResponses).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.types import ReduceOp
+
+__all__ = [
+    "allreduce",
+    "allgather",
+    "reduce_scatter",
+    "broadcast",
+    "alltoall",
+    "axis_rank",
+    "axis_size",
+    "fused_allreduce",
+    "fused_allreduce_buckets",
+]
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def axis_rank(axis: AxisName) -> jax.Array:
+    """Rank of this shard along ``axis`` (ref: horovod_rank per communicator)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    """Number of shards along ``axis`` (ref: horovod_size)."""
+    return lax.axis_size(axis)
+
+
+def allreduce(x, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Allreduce over a mesh axis (ref: EnqueueTensorAllreduce
+    operations.cc:1357; NCCLAllreduce::Execute nccl_operations.cc:175).
+
+    Average is implemented as sum + postscale by 1/size, matching the
+    reference's prescale/postscale split (torch/optimizer.py:197-204) —
+    XLA folds the scales into neighbouring ops.
+    """
+    if prescale_factor != 1.0:
+        x = jax.tree.map(lambda t: t * prescale_factor, x)
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        out = lax.psum(x, axis)
+        if op == ReduceOp.AVERAGE:
+            n = lax.psum(1, axis) if not isinstance(axis, str) else lax.axis_size(axis)
+            out = jax.tree.map(lambda t: t / n, out)
+    elif op == ReduceOp.MIN:
+        out = lax.pmin(x, axis)
+    elif op == ReduceOp.MAX:
+        out = lax.pmax(x, axis)
+    elif op == ReduceOp.PRODUCT:
+        # exp(psum(log|x|)) with explicit sign/zero tracking so arbitrary
+        # reals reduce correctly (log of a negative would poison the psum).
+        def _prod(t):
+            mag = jnp.exp(lax.psum(jnp.log(jnp.where(t == 0, 1.0, jnp.abs(t))), axis))
+            n_neg = lax.psum((t < 0).astype(jnp.int32), axis)
+            any_zero = lax.psum((t == 0).astype(jnp.int32), axis) > 0
+            signed = jnp.where(n_neg % 2 == 1, -mag, mag)
+            return jnp.where(any_zero, 0.0, signed).astype(t.dtype)
+
+        out = jax.tree.map(_prod, x)
+    elif op == ReduceOp.ADASUM:
+        from . import adasum as _adasum
+
+        out = _adasum.adasum_allreduce(x, axis)
+    else:
+        raise ValueError(f"Unsupported reduce op: {op}")
+    if postscale_factor != 1.0:
+        out = jax.tree.map(lambda t: t * postscale_factor, out)
+    return out
+
+
+def allgather(x, axis: AxisName = "dp", concat_axis: int = 0, *, tiled: bool = True):
+    """Allgather over a mesh axis, concatenating along ``concat_axis``
+    (ref: EnqueueTensorAllgather; AllgatherOp displacement math
+    ops/collective_operations.h:129).  Unlike the reference, first-dimension
+    ragged gathers are not supported under jit (static shapes); use the eager
+    path for ragged inputs."""
+    return jax.tree.map(
+        lambda t: lax.all_gather(t, axis, axis=concat_axis, tiled=tiled), x)
+
+
+def reduce_scatter(x, axis: AxisName = "dp", scatter_axis: int = 0,
+                   op: ReduceOp = ReduceOp.SUM):
+    """Reduce-scatter over a mesh axis — first-class on TPU (building block
+    for ZeRO/FSDP-style sharding and Adasum; the reference only has it
+    embedded inside NCCLHierarchicalAllreduce, nccl_operations.cc:378)."""
+    def _rs(t):
+        out = lax.psum_scatter(t, axis, scatter_dimension=scatter_axis, tiled=True)
+        if op == ReduceOp.AVERAGE:
+            out = out / lax.axis_size(axis)
+        return out
+
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(f"reduce_scatter supports SUM/AVERAGE, got {op}")
+    return jax.tree.map(_rs, x)
+
+
+def broadcast(x, root_rank: int = 0, axis: AxisName = "dp"):
+    """Broadcast from ``root_rank``'s shard to all shards along ``axis``
+    (ref: EnqueueTensorBroadcast; NCCLBroadcast nccl_operations.cc:535).
+
+    Implemented as a masked psum — the idiomatic XLA lowering (a one-hot
+    select then all-reduce rides the same ICI reduction tree as a native
+    broadcast)."""
+    idx = lax.axis_index(axis)
+
+    def _bcast(t):
+        # where (not multiply) so NaN/Inf in non-root shards — e.g.
+        # uninitialized buffers being overwritten by the broadcast — cannot
+        # poison the psum.
+        zero = jnp.zeros((), dtype=jnp.int32 if t.dtype == jnp.bool_ else t.dtype)
+        contrib = jnp.where(idx == root_rank,
+                            t.astype(zero.dtype) if t.dtype == jnp.bool_ else t,
+                            zero)
+        out = lax.psum(contrib, axis)
+        return (out != 0) if t.dtype == jnp.bool_ else out
+
+    return jax.tree.map(_bcast, x)
+
+
+def alltoall(x, axis: AxisName = "dp", split_axis: int = 0, concat_axis: int = 0):
+    """All-to-all over a mesh axis (ref: EnqueueTensorAlltoall
+    operations.cc:1642; AlltoallOp ops/collective_operations.h:195).
+
+    Equal splits only under jit (static shapes); the eager path handles
+    uneven splits.  This is the substrate for expert parallelism (MoE token
+    routing) — SURVEY.md §2.7."""
+    return jax.tree.map(
+        lambda t: lax.all_to_all(t, axis, split_axis=split_axis,
+                                 concat_axis=concat_axis, tiled=True), x)
+
+
+# ---------------------------------------------------------------------------
+# Tensor fusion: bucketed fused allreduce over a pytree of gradients.
+# (ref: FusionBufferManager common/fusion_buffer_manager.{h,cc};
+#  FuseResponses controller.cc:808; fused memcpy collective_operations.cc.)
+# On TPU the "fusion buffer" is a flat concatenated array per (dtype, bucket)
+# — XLA emits a single all-reduce per bucket, the concat/split melt into
+# copies that fuse with neighbours.
+# ---------------------------------------------------------------------------
+
+def fused_allreduce_buckets(leaves: Sequence[jax.Array],
+                            threshold_bytes: int) -> List[List[int]]:
+    """Plan fusion buckets: group leaf indices by dtype, pack up to
+    ``threshold_bytes`` per bucket (64-byte alignment unit like the
+    reference, common.h:147 — moot on TPU but kept for parity of the plan).
+
+    Pure planning function; host-side, shape-only."""
+    by_dtype: Dict[Any, List[int]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.result_type(leaf), []).append(i)
+    buckets: List[List[int]] = []
+    for dtype, idxs in by_dtype.items():
+        cur: List[int] = []
+        cur_bytes = 0
+        itemsize = jnp.dtype(dtype).itemsize
+        for i in idxs:
+            nbytes = -(-leaves[i].size * itemsize // 64) * 64
+            if cur and cur_bytes + nbytes > threshold_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            buckets.append(cur)
+    return buckets
+
+
+def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE,
+                    threshold_bytes: Optional[int] = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    wire_dtype: Optional[Any] = None):
+    """Allreduce a pytree as few fused flat collectives (the hot path of
+    DistributedOptimizer — ref call stack SURVEY.md §3.2).
+
+    ``wire_dtype`` optionally casts buckets for the reduction (bf16 wire
+    compression — ref: tensorflow/compression.py:141) and casts back.
+    """
+    from ..common import config
+
+    if threshold_bytes is None:
+        threshold_bytes = config.get_int("HVDT_FUSION_THRESHOLD")
+
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    buckets = fused_allreduce_buckets(leaves, threshold_bytes)
+
+    out_leaves: List[Optional[jax.Array]] = [None] * len(leaves)
+    for bucket in buckets:
+        parts = [leaves[i] for i in bucket]
+        shapes = [p.shape for p in parts]
+        sizes = [p.size for p in parts]
+        flat = jnp.concatenate([jnp.ravel(p) for p in parts]) if len(parts) > 1 \
+            else jnp.ravel(parts[0])
+        orig_dtype = flat.dtype
+        if wire_dtype is not None and flat.dtype != wire_dtype:
+            flat = flat.astype(wire_dtype)
+        red = allreduce(flat, axis, op, prescale_factor, postscale_factor)
+        if red.dtype != orig_dtype:
+            red = red.astype(orig_dtype)
+        offset = 0
+        for i, shape, sz in zip(bucket, shapes, sizes):
+            out_leaves[i] = lax.dynamic_slice_in_dim(red, offset, sz).reshape(shape)
+            offset += sz
+    return jax.tree.unflatten(treedef, out_leaves)

@@ -1,0 +1,56 @@
+"""Test harness: simulate an 8-device TPU slice on CPU.
+
+Mirrors the reference's test strategy tier (a) (SURVEY.md §4): in-process
+collective-correctness tests parameterized over a multi-chip mesh, simulated
+via XLA's host-platform device-count flag.
+"""
+
+import os
+
+# Must be set before the first jax backend initialization.  Hard-override:
+# the outer environment may point JAX at real TPU hardware and a
+# sitecustomize may force jax_platforms at interpreter start; unit tests
+# always run on the simulated CPU mesh, so override both the env var and
+# the already-applied jax config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices, dtype=object), ("dp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2d(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices, dtype=object).reshape(4, 2), ("dp", "tp"))
+
+
+@pytest.fixture()
+def hvd():
+    """Initialized framework, torn down after each test."""
+    import horovod_tpu as hvd_mod
+
+    hvd_mod.init()
+    yield hvd_mod
+    hvd_mod.shutdown()

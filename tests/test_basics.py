@@ -1,0 +1,76 @@
+"""Core init/topology/process-set tests (ref analog: test_torch.py rank/size
+assertions; test_process_sets_multi_comm.py)."""
+
+import pytest
+
+
+def test_init_and_topology(hvd):
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.num_devices() == 8
+    assert hvd.is_homogeneous()
+
+
+def test_not_initialized_raises():
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.common.exceptions import NotInitializedError
+
+    hvd_mod.shutdown()
+    with pytest.raises(NotInitializedError):
+        hvd_mod.rank()
+
+
+def test_double_init_is_noop(hvd):
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_default_mesh(hvd):
+    m = hvd.mesh()
+    assert m.axis_names == ("dp",)
+    assert m.devices.size == 8
+
+
+def test_mesh_axes_env(monkeypatch):
+    import horovod_tpu as hvd_mod
+
+    hvd_mod.shutdown()
+    monkeypatch.setenv("HVDT_MESH_AXES", "dp=4,tp=2")
+    hvd_mod.init()
+    try:
+        m = hvd_mod.mesh()
+        assert m.axis_names == ("dp", "tp")
+        assert m.devices.shape == (4, 2)
+    finally:
+        hvd_mod.shutdown()
+
+
+def test_process_sets(hvd):
+    ps = hvd.global_process_set()
+    assert ps.id == 0
+    assert ps.ranks == [0]
+    assert ps.included()
+    assert ps.rank() == 0
+    # single-process: only the trivial subset is valid
+    ps2 = hvd.add_process_set([0])
+    assert ps2.id >= 0
+    # duplicate registration returns the same set
+    ps3 = hvd.add_process_set([0])
+    assert ps3.id == ps2.id
+    with pytest.raises(Exception):
+        hvd.add_process_set([0, 5])
+    with pytest.raises(Exception):
+        hvd.remove_process_set(0)
+
+
+def test_knob_registry(monkeypatch):
+    from horovod_tpu.common import config
+
+    assert config.get_int("HVDT_FUSION_THRESHOLD") == 64 * 1024 * 1024
+    monkeypatch.setenv("HVDT_FUSION_THRESHOLD", "1024")
+    assert config.get_int("HVDT_FUSION_THRESHOLD") == 1024
+    monkeypatch.setenv("HVDT_FUSION_THRESHOLD", "garbage")
+    assert config.get_int("HVDT_FUSION_THRESHOLD") == 64 * 1024 * 1024
+    assert "HVDT_TIMELINE" in config.registry_doc()

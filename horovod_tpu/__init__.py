@@ -72,8 +72,9 @@ def __getattr__(name):
         if name in ("allreduce", "allreduce_async", "allgather",
                     "allgather_async", "broadcast", "broadcast_async",
                     "alltoall", "alltoall_async", "reducescatter",
-                    "grouped_allreduce", "grouped_allreduce_async",
-                    "synchronize", "poll", "join", "barrier"):
+                    "reducescatter_async", "grouped_allreduce",
+                    "grouped_allreduce_async", "synchronize", "poll", "join",
+                    "barrier"):
             from .ops import eager
 
             return getattr(eager, name)
@@ -90,14 +91,10 @@ def __getattr__(name):
             from .ops.compression import Compression
 
             return Compression
-        if name == "elastic":
-            from . import elastic
+        if name in ("elastic", "timeline"):
+            import importlib
 
-            return elastic
-        if name == "timeline":
-            from . import timeline
-
-            return timeline
+            return importlib.import_module(f".{name}", __name__)
     except ImportError as e:
         raise AttributeError(
             f"horovod_tpu.{name} is unavailable: {e}") from e

@@ -95,8 +95,6 @@ class _GlobalState:
         self.mesh = None  # jax.sharding.Mesh over all participating devices
         self.process_set_table = None  # built at init (process_sets.py)
         self.eager_controller = None   # lazy (ops/eager.py)
-        self.timeline = None           # lazy (timeline.py)
-        self.joined = False
 
     def reset(self) -> None:
         self.initialized = False
@@ -104,8 +102,6 @@ class _GlobalState:
         self.mesh = None
         self.process_set_table = None
         self.eager_controller = None
-        self.timeline = None
-        self.joined = False
 
 
 _state = _GlobalState()
@@ -251,9 +247,10 @@ def shutdown() -> None:
             return
         if _state.eager_controller is not None:
             _state.eager_controller.shutdown()
-        if _state.timeline is not None:
-            _state.timeline.close()
         _state.reset()
+    from ..timeline import stop_timeline
+
+    stop_timeline()
 
 
 atexit.register(shutdown)

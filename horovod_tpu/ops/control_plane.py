@@ -1,0 +1,155 @@
+"""Control-plane transports for eager negotiation.
+
+TPU-native analog of the reference's Controller transport layer
+(ref: common/controller.h:47-64 — six pure-virtual comm primitives
+implemented per backend: mpi/mpi_controller.cc, gloo/gloo_controller.cc).
+
+On TPU the idiomatic control plane is the JAX coordination service (the
+same service `jax.distributed.initialize` stands up for rendezvous), used
+as a key-value store + barrier — replacing MPI_Gatherv/MPI_Bcast.  The
+primitives here are deliberately coarser than the reference's six
+(gather-to-root + broadcast-from-root + barrier) because a KV round trip
+dominates either way.
+
+A Local transport serves single-process runs (the negotiation degenerates
+but queue/fusion/cache/timeline still run, preserving eager semantics).
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["ControlPlane", "LocalControlPlane", "CoordServiceControlPlane",
+           "default_control_plane"]
+
+
+class ControlPlane(abc.ABC):
+    """Blocking, cycle-synchronous control collectives over process ranks."""
+
+    @abc.abstractmethod
+    def rank(self) -> int: ...
+
+    @abc.abstractmethod
+    def size(self) -> int: ...
+
+    @abc.abstractmethod
+    def gather(self, payload: str, cycle: int) -> Optional[List[str]]:
+        """All ranks submit a payload; returns the rank-ordered list on rank
+        0, None elsewhere (ref: RecvReadyTensors/SendReadyTensors,
+        mpi_controller.cc:135,191)."""
+
+    @abc.abstractmethod
+    def broadcast(self, payload: Optional[str], cycle: int) -> str:
+        """Rank 0 provides payload; everyone returns it
+        (ref: SendFinalTensors = MPI_Bcast, mpi_controller.cc:180)."""
+
+    @abc.abstractmethod
+    def barrier(self, tag: str = "") -> None: ...
+
+    def shutdown(self) -> None:
+        pass
+
+
+class LocalControlPlane(ControlPlane):
+    """Single-process control plane — trivial negotiation."""
+
+    def rank(self) -> int:
+        return 0
+
+    def size(self) -> int:
+        return 1
+
+    def gather(self, payload: str, cycle: int) -> Optional[List[str]]:
+        return [payload]
+
+    def broadcast(self, payload: Optional[str], cycle: int) -> str:
+        assert payload is not None
+        return payload
+
+    def barrier(self, tag: str = "") -> None:
+        return None
+
+
+class CoordServiceControlPlane(ControlPlane):
+    """Negotiation over the JAX coordination service KV store.
+
+    Key scheme: ``hvdt/<namespace>/<cycle>/g<rank>`` for gather payloads and
+    ``hvdt/<namespace>/<cycle>/resp`` for the response broadcast.  Cycle
+    counters advance in lockstep on every rank (each rank participates in
+    every negotiation cycle, exactly like the reference's RunLoopOnce),
+    which keeps keys unique without deletion races; old keys are deleted
+    opportunistically a few cycles later.
+    """
+
+    def __init__(self, namespace: str = "ctl", timeout_s: float = 300.0):
+        import jax
+
+        from jax._src import distributed as _dist
+
+        client = getattr(_dist.global_state, "client", None)
+        if client is None:
+            raise RuntimeError(
+                "JAX distributed runtime is not initialized; "
+                "CoordServiceControlPlane requires jax.distributed.initialize")
+        self._client = client
+        self._ns = namespace
+        self._rank = jax.process_index()
+        self._size = jax.process_count()
+        self._timeout_ms = int(timeout_s * 1000)
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    def _key(self, cycle: int, suffix: str) -> str:
+        return f"hvdt/{self._ns}/{cycle}/{suffix}"
+
+    def gather(self, payload: str, cycle: int) -> Optional[List[str]]:
+        self._client.key_value_set(self._key(cycle, f"g{self._rank}"), payload)
+        if self._rank != 0:
+            return None
+        out = []
+        for r in range(self._size):
+            out.append(self._client.blocking_key_value_get(
+                self._key(cycle, f"g{r}"), self._timeout_ms))
+        return out
+
+    def broadcast(self, payload: Optional[str], cycle: int) -> str:
+        key = self._key(cycle, "resp")
+        if self._rank == 0:
+            assert payload is not None
+            self._client.key_value_set(key, payload)
+            self._gc(cycle)
+            return payload
+        val = self._client.blocking_key_value_get(key, self._timeout_ms)
+        return val
+
+    def _gc(self, cycle: int, keep: int = 8) -> None:
+        # Opportunistic deletion of stale cycle keys (rank 0 only).
+        old = cycle - keep
+        if old < 0:
+            return
+        try:
+            self._client.key_value_delete(f"hvdt/{self._ns}/{old}/")
+        except Exception:
+            pass
+
+    def barrier(self, tag: str = "") -> None:
+        self._client.wait_at_barrier(
+            f"hvdt/{self._ns}/barrier/{tag}", self._timeout_ms)
+
+
+def default_control_plane() -> ControlPlane:
+    """Pick the control plane for the current topology."""
+    import jax
+
+    from ..common import basics
+
+    if basics.size() > 1:
+        return CoordServiceControlPlane()
+    return LocalControlPlane()

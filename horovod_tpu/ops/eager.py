@@ -1,0 +1,862 @@
+"""Eager named-collective path: negotiation controller + public async API.
+
+TPU-native re-conception of the reference's coordination core
+(ref: common/operations.cc — background thread loop RunLoopOnce
+operations.cc:706-806, enqueue API :1357-1795; common/controller.cc —
+ComputeResponseList :73, ConstructResponse :495, FuseResponses :808,
+IncrementTensorCount :977; common/tensor_queue.{h,cc};
+common/response_cache.{h,cc}; common/group_table.{h,cc}).
+
+Why this layer exists on TPU at all (SURVEY.md §5.8): under jit, op order
+is globally consistent and XLA fuses collectives — that path lives in
+ops/device.py.  The eager path serves Horovod-parity semantics: framework
+threads enqueue *named* tensors in nondeterministic order; a controller
+matches names across ranks, validates shapes/dtypes, fuses small tensors,
+and executes — with joined-rank zero-contribution, stall detection, a
+response cache, and per-tensor timeline instrumentation.
+
+Threading model mirrors the reference design comment (operations.cc:363-383):
+a single background thread owns all cross-rank communication; framework
+threads only touch the tensor queue and handle table.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import basics, config
+from ..common.exceptions import HorovodInternalError
+from ..common.logging_util import get_logger
+from ..common.process_sets import ProcessSet, global_process_set
+from ..common.types import DUPLICATE_NAME_ERROR, ReduceOp, Status, data_type_of, numpy_dtype_of
+from . import host_collectives as hostc
+from .control_plane import ControlPlane, default_control_plane
+from .handles import HandleManager
+from .messages import (Request, RequestType, Response, decode_request_list,
+                       decode_response_list, encode_request_list,
+                       encode_response_list)
+
+__all__ = [
+    "allreduce", "allreduce_async", "grouped_allreduce",
+    "grouped_allreduce_async", "allgather", "allgather_async", "broadcast",
+    "broadcast_async", "alltoall", "alltoall_async", "reducescatter",
+    "reducescatter_async", "barrier", "join", "poll", "synchronize",
+    "shutdown_controller",
+]
+
+log = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Local bookkeeping structures
+# ---------------------------------------------------------------------------
+
+class _Entry:
+    """Local in-flight tensor (ref: TensorTableEntry common.h:348-382)."""
+
+    __slots__ = ("request", "tensor", "handle", "enqueue_ts", "was_jax")
+
+    def __init__(self, request: Request, tensor: Optional[np.ndarray],
+                 handle: int, was_jax: bool):
+        self.request = request
+        self.tensor = tensor
+        self.handle = handle
+        self.enqueue_ts = time.monotonic()
+        self.was_jax = was_jax
+
+
+class ResponseCache:
+    """LRU cache of negotiated request descriptors, coherent across ranks
+    (ref: common/response_cache.{h,cc}): every rank applies identical
+    updates in response-execution order, so cache bit positions agree
+    without extra synchronization — the analog of the reference's
+    bitvector-AND steady-state path (controller.cc:780-806)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # name -> Request (insertion-ordered for LRU)
+        self._entries: "collections.OrderedDict[str, Request]" = \
+            collections.OrderedDict()
+
+    def lookup_bit(self, req: Request) -> Optional[int]:
+        cached = self._entries.get(req.tensor_name)
+        if cached is None:
+            return None
+        if cached.descriptor() != req.descriptor() or \
+                cached.splits != req.splits or \
+                cached.prescale_factor != req.prescale_factor or \
+                cached.postscale_factor != req.postscale_factor or \
+                cached.tensor_shape != req.tensor_shape:
+            # descriptor changed → treat as uncached; will be re-inserted
+            return None
+        return list(self._entries).index(req.tensor_name)
+
+    def request_for_bit(self, bit: int) -> Optional[Request]:
+        names = list(self._entries)
+        if 0 <= bit < len(names):
+            return self._entries[names[bit]]
+        return None
+
+    def insert(self, req: Request) -> None:
+        if self.capacity <= 0:
+            return
+        name = req.tensor_name
+        if name in self._entries:
+            self._entries.pop(name)
+        self._entries[name] = req
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+
+class _MessageTable:
+    """Coordinator-side readiness table (ref: IncrementTensorCount
+    controller.cc:977; arrival-ordered like the reference's ready queue)."""
+
+    def __init__(self) -> None:
+        # key -> {rank: Request}; insertion order = first-arrival order
+        self.pending: "collections.OrderedDict[Tuple[int, str], Dict[int, Request]]" = \
+            collections.OrderedDict()
+
+    def add(self, req: Request) -> None:
+        key = (req.process_set_id, req.tensor_name)
+        self.pending.setdefault(key, {})[req.request_rank] = req
+
+
+# ---------------------------------------------------------------------------
+# Controller
+# ---------------------------------------------------------------------------
+
+class EagerController:
+    def __init__(self, control_plane: Optional[ControlPlane] = None):
+        self.cp = control_plane or default_control_plane()
+        self.handles = HandleManager()
+        self._lock = threading.Lock()
+        # (ps_id, name) -> _Entry   (ref: TensorQueue duplicate-name check)
+        self._entries: Dict[Tuple[int, str], _Entry] = {}
+        self._to_announce: List[Request] = []
+        self._cache = ResponseCache(config.get_int("HVDT_CACHE_CAPACITY"))
+        self._message_table = _MessageTable()
+        self._group_members: Dict[int, set] = {}   # group_id -> names
+        self._next_group_id = itertools.count()
+        self._joined: Dict[int, Dict[int, int]] = {}  # ps_id -> {rank: join order}
+        self._local_join_handles: Dict[int, int] = {}  # ps_id -> handle
+        self._cycle = 0
+        self._running = True
+        from ..stall import StallInspector
+
+        self._stall = StallInspector(self.cp.size())
+        from ..timeline import get_timeline
+
+        get_timeline()  # trigger env auto-start once
+        self._cycle_time_s = config.get_float("HVDT_CYCLE_TIME") / 1000.0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvdt-controller", daemon=True)
+        self._thread.start()
+
+    @property
+    def _timeline(self):
+        # read the live singleton each time so dynamic start_timeline()/
+        # stop_timeline() take effect on a running controller
+        from ..timeline import current
+
+        return current()
+
+    # -- framework-thread API ----------------------------------------------
+    def enqueue(self, request: Request, tensor: Optional[np.ndarray],
+                was_jax: bool) -> int:
+        key = (request.process_set_id, request.tensor_name)
+        with self._lock:
+            if not self._running:
+                raise HorovodInternalError("controller is shut down")
+            if key in self._entries:
+                raise ValueError(DUPLICATE_NAME_ERROR +
+                                 f" (tensor: {request.tensor_name})")
+            handle = self.handles.allocate()
+            self._entries[key] = _Entry(request, tensor, handle, was_jax)
+            self._to_announce.append(request)
+        if self._timeline:
+            self._timeline.start_activity(
+                request.tensor_name,
+                f"NEGOTIATE_{RequestType(request.request_type).name}")
+        return handle
+
+    def enqueue_join(self, ps: ProcessSet) -> int:
+        req = Request(self.cp.rank(), RequestType.JOIN, f"join.{ps.id}",
+                      0, (), process_set_id=ps.id)
+        with self._lock:
+            if ps.id in self._local_join_handles:
+                raise ValueError(f"join already pending for process set {ps.id}")
+            handle = self.handles.allocate()
+            self._local_join_handles[ps.id] = handle
+            self._to_announce.append(req)
+        return handle
+
+    def next_group_id(self) -> int:
+        return next(self._next_group_id)
+
+    # -- background loop (ref: RunLoopOnce operations.cc:706) --------------
+    def _loop(self) -> None:
+        idle_sleep = 0.0001
+        while self._running:
+            if self._cycle_time_s > 0:
+                time.sleep(self._cycle_time_s)
+            try:
+                did_work = self._run_cycle()
+            except Exception as e:  # pragma: no cover - defensive
+                log.exception("controller cycle failed: %s", e)
+                self._fail_all(f"controller cycle failed: {e}")
+                return
+            if self._timeline:
+                self._timeline.mark_cycle()
+            if not did_work and self._cycle_time_s == 0:
+                time.sleep(idle_sleep)
+                idle_sleep = min(idle_sleep * 2, 0.002)
+            else:
+                idle_sleep = 0.0001
+
+    def _run_cycle(self) -> bool:
+        with self._lock:
+            to_send = self._to_announce
+            self._to_announce = []
+            join_pending = set(self._local_join_handles)
+        multi = self.cp.size() > 1
+        if not multi and not to_send:
+            return False
+
+        # -- announce: cache bits for hits, full requests for misses
+        bits: List[int] = []
+        misses: List[Request] = []
+        for req in to_send:
+            if req.request_type == RequestType.JOIN:
+                misses.append(req)
+                continue
+            bit = self._cache.lookup_bit(req)
+            if bit is not None:
+                bits.append(bit)
+            else:
+                misses.append(req)
+        payload = encode_request_list(misses, joined=bool(join_pending))
+        payload = f"{','.join(map(str, bits))}|{payload}"
+
+        gathered = self.cp.gather(payload, self._cycle)
+
+        # -- coordinator: build response list
+        resp_payload: Optional[str] = None
+        if gathered is not None:
+            responses = self._construct_response_list(gathered)
+            resp_payload = encode_response_list(responses)
+        resp_payload = self.cp.broadcast(resp_payload, self._cycle)
+        self._cycle += 1
+        responses = decode_response_list(resp_payload)
+        if responses:
+            self._execute_response_list(responses)
+        return bool(to_send) or bool(responses)
+
+    # -- coordinator logic (ref: ComputeResponseList controller.cc:73) -----
+    def _construct_response_list(self, gathered: List[str]) -> List[Response]:
+        for rank, raw in enumerate(gathered):
+            bits_part, _, req_part = raw.partition("|")
+            reqs, _joined = decode_request_list(req_part)
+            if bits_part:
+                import dataclasses as _dc
+
+                for bit in map(int, bits_part.split(",")):
+                    cached = self._cache.request_for_bit(bit)
+                    if cached is not None:
+                        reqs.append(_dc.replace(cached, request_rank=rank))
+            for req in reqs:
+                req.request_rank = rank
+                if req.request_type == RequestType.JOIN:
+                    joined = self._joined.setdefault(req.process_set_id, {})
+                    if rank not in joined:
+                        joined[rank] = len(joined)
+                    continue
+                self._message_table.add(req)
+                self._stall.record(req.tensor_name, rank)
+
+        responses: List[Response] = []
+        ready_keys: List[Tuple[int, str]] = []
+        for key, by_rank in self._message_table.pending.items():
+            ps_id = key[0]
+            try:
+                ps = basics._global_state().process_set_table.get(ps_id)
+                ps_size = ps.size()
+            except Exception:
+                ps_size = self.cp.size()
+            joined = self._joined.get(ps_id, {})
+            if len(by_rank) + len([r for r in joined if r not in by_rank]) \
+                    >= ps_size:
+                ready_keys.append(key)
+
+        # group all-or-nothing gate (ref: group_table.{h,cc})
+        ready_group_names: Dict[int, set] = {}
+        for key in ready_keys:
+            req = next(iter(self._message_table.pending[key].values()))
+            if req.group_id >= 0:
+                ready_group_names.setdefault(req.group_id, set()).add(key[1])
+        gated: List[Tuple[int, str]] = []
+        for key in ready_keys:
+            req = next(iter(self._message_table.pending[key].values()))
+            if req.group_id >= 0:
+                members = self._group_members.get(req.group_id)
+                if members is not None and \
+                        ready_group_names.get(req.group_id, set()) != members:
+                    continue
+            gated.append(key)
+
+        for key in gated:
+            by_rank = self._message_table.pending.pop(key)
+            self._stall.resolve(key[1])
+            responses.append(self._construct_response(key, by_rank))
+
+        # JOIN responses: all ranks of a set joined and nothing pending
+        for ps_id, joined in list(self._joined.items()):
+            try:
+                ps = basics._global_state().process_set_table.get(ps_id)
+                ps_size = ps.size()
+            except Exception:
+                ps_size = self.cp.size()
+            has_pending = any(k[0] == ps_id
+                              for k in self._message_table.pending)
+            if len(joined) >= ps_size and not has_pending:
+                last = max(joined, key=lambda r: joined[r])
+                responses.append(Response(RequestType.JOIN, [f"join.{ps_id}"],
+                                          process_set_id=ps_id,
+                                          last_joined_rank=last))
+                del self._joined[ps_id]
+
+        self._stall.check()
+        return self._fuse_responses(responses)
+
+    def _construct_response(self, key: Tuple[int, str],
+                            by_rank: Dict[int, Request]) -> Response:
+        """Validate cross-rank agreement and emit a Response
+        (ref: ConstructResponse controller.cc:495)."""
+        ps_id, name = key
+        reqs = list(by_rank.values())
+        first = reqs[0]
+        for other in reqs[1:]:
+            if other.request_type != first.request_type:
+                return Response(first.request_type, [name],
+                                error_message=f"Mismatched collective type for "
+                                f"tensor {name}.")
+            if other.tensor_type != first.tensor_type:
+                return Response(first.request_type, [name],
+                                error_message=f"Mismatched data type for tensor "
+                                f"{name}.")
+            if other.descriptor() != first.descriptor():
+                return Response(first.request_type, [name],
+                                error_message=f"Mismatched shape/params for "
+                                f"tensor {name}: {first.tensor_shape} vs "
+                                f"{other.tensor_shape}.")
+        rt = first.request_type
+        resp = Response(rt, [name], tensor_type=first.tensor_type,
+                        reduce_op=first.reduce_op,
+                        prescale_factor=first.prescale_factor,
+                        postscale_factor=first.postscale_factor,
+                        root_rank=first.root_rank, process_set_id=ps_id)
+        if rt == RequestType.ALLGATHER:
+            # per-set-rank dim0 sizes, joined ranks contribute 0 rows
+            try:
+                ps = basics._global_state().process_set_table.get(ps_id)
+                set_ranks = ps.ranks
+            except Exception:
+                set_ranks = list(range(self.cp.size()))
+            shapes = []
+            for r in set_ranks:
+                if r in by_rank:
+                    shapes.append(tuple(by_rank[r].tensor_shape))
+                else:
+                    shapes.append((0,) + tuple(first.tensor_shape[1:]))
+            resp.tensor_shapes = shapes
+        elif rt == RequestType.ALLTOALL:
+            try:
+                ps = basics._global_state().process_set_table.get(ps_id)
+                set_ranks = ps.ranks
+            except Exception:
+                set_ranks = list(range(self.cp.size()))
+            resp.recv_splits = [tuple(by_rank[r].splits) if r in by_rank
+                                else (0,) * len(set_ranks)
+                                for r in set_ranks]
+            resp.tensor_shapes = [tuple(first.tensor_shape)]
+        else:
+            resp.tensor_shapes = [tuple(first.tensor_shape)]
+        return resp
+
+    def _fuse_responses(self, responses: List[Response]) -> List[Response]:
+        """Pack compatible allreduce responses into fused responses up to the
+        fusion threshold (ref: FuseResponses controller.cc:808)."""
+        threshold = config.get_int("HVDT_FUSION_THRESHOLD")
+        if not config.get_bool("HVDT_BATCH_COLLECTIVES"):
+            return responses
+        fused: List[Response] = []
+        pending: Optional[Response] = None
+        pending_bytes = 0
+
+        def flush():
+            nonlocal pending, pending_bytes
+            if pending is not None:
+                fused.append(pending)
+            pending, pending_bytes = None, 0
+
+        for resp in responses:
+            fusible = (resp.response_type in (RequestType.ALLREDUCE,
+                                              RequestType.ADASUM)
+                       and not resp.error_message)
+            if not fusible:
+                flush()
+                fused.append(resp)
+                continue
+            nbytes = int(np.prod(resp.tensor_shapes[0]) *
+                         numpy_dtype_of_safe(resp.tensor_type).itemsize) \
+                if resp.tensor_shapes[0] else 0
+            compatible = (
+                pending is not None
+                and pending.response_type == resp.response_type
+                and pending.tensor_type == resp.tensor_type
+                and pending.reduce_op == resp.reduce_op
+                and pending.prescale_factor == resp.prescale_factor
+                and pending.postscale_factor == resp.postscale_factor
+                and pending.process_set_id == resp.process_set_id
+                and pending_bytes + nbytes <= threshold)
+            if compatible:
+                pending.tensor_names.extend(resp.tensor_names)
+                pending.tensor_shapes.extend(resp.tensor_shapes)
+                pending_bytes += nbytes
+            else:
+                flush()
+                pending = resp
+                pending_bytes = nbytes
+        flush()
+        return fused
+
+    # -- execution (ref: PerformOperation operations.cc:257) ---------------
+    def _execute_response_list(self, responses: List[Response]) -> None:
+        for resp in responses:
+            try:
+                self._execute_response(resp)
+            except Exception as e:
+                log.exception("execution failed for %s", resp.tensor_names)
+                self._fail_response(resp, f"{type(e).__name__}: {e}")
+
+    def _pop_entries(self, resp: Response) -> List[Optional[_Entry]]:
+        entries = []
+        with self._lock:
+            for name in resp.tensor_names:
+                entries.append(self._entries.pop((resp.process_set_id, name),
+                                                 None))
+        return entries
+
+    def _execute_response(self, resp: Response) -> None:
+        rt = resp.response_type
+        if rt == RequestType.JOIN:
+            with self._lock:
+                handle = self._local_join_handles.pop(resp.process_set_id, None)
+            if handle is not None:
+                self.handles.mark_done(handle, Status.ok(),
+                                       resp.last_joined_rank)
+            return
+        if rt == RequestType.BARRIER:
+            for name, entry in zip(resp.tensor_names, self._pop_entries(resp)):
+                if entry is not None:
+                    self.handles.mark_done(entry.handle, Status.ok(), None)
+            return
+        if resp.error_message:
+            self._fail_response(resp, resp.error_message)
+            return
+
+        entries = self._pop_entries(resp)
+        # record timeline: negotiation over, execution begins
+        if self._timeline:
+            for name in resp.tensor_names:
+                self._timeline.end_activity(name)
+                self._timeline.start_activity(name, f"EXEC_{rt.name}",
+                                              {"fused": len(resp.tensor_names)})
+        try:
+            import jax
+
+            with jax.profiler.TraceAnnotation(
+                    f"hvdt.{rt.name}.{resp.tensor_names[0]}"
+                    + (f"+{len(resp.tensor_names)-1}" if
+                       len(resp.tensor_names) > 1 else "")):
+                self._dispatch(resp, entries)
+        finally:
+            if self._timeline:
+                for name, shape in zip(resp.tensor_names,
+                                       resp.tensor_shapes or
+                                       [()] * len(resp.tensor_names)):
+                    self._timeline.end_activity(name, {"shape": list(shape)})
+        # coherent cache update on every rank, in execution order
+        ps = basics._global_state().process_set_table.get(resp.process_set_id)
+        my_splits: Tuple[int, ...] = ()
+        if rt == RequestType.ALLTOALL and resp.recv_splits and ps.included():
+            my_splits = tuple(resp.recv_splits[ps.rank()])
+        for name, shape in zip(resp.tensor_names, resp.tensor_shapes):
+            req = Request(0, rt, name, resp.tensor_type, tuple(shape),
+                          resp.reduce_op, resp.prescale_factor,
+                          resp.postscale_factor, resp.root_rank,
+                          my_splits, resp.process_set_id, -1)
+            self._cache.insert(req)
+
+    def _dispatch(self, resp: Response, entries: List[Optional[_Entry]]) -> None:
+        ps = basics._global_state().process_set_table.get(resp.process_set_id)
+        if not ps.included():
+            # responses broadcast to all ranks; non-members just skip
+            # (they hold no entries and own no devices in the sub-mesh)
+            return
+        rt = resp.response_type
+        dtype = numpy_dtype_of_safe(resp.tensor_type)
+        single = ps.size() == 1
+
+        def finish(entry: Optional[_Entry], value: np.ndarray) -> None:
+            if entry is None:
+                return
+            result: Any = value
+            if entry.was_jax:
+                import jax.numpy as jnp
+
+                result = jnp.asarray(value)
+            self.handles.mark_done(entry.handle, Status.ok(), result)
+
+        if rt in (RequestType.ALLREDUCE, RequestType.ADASUM):
+            op = ReduceOp(resp.reduce_op)
+            values = []
+            for name, shape, entry in zip(resp.tensor_names,
+                                          resp.tensor_shapes, entries):
+                if entry is None or entry.tensor is None:
+                    # joined rank: contribute zeros (ref: JoinOp semantics)
+                    values.append(np.zeros(shape, dtype))
+                else:
+                    values.append(np.asarray(entry.tensor))
+            pre, post = resp.prescale_factor, resp.postscale_factor
+            if pre != 1.0:
+                values = [v * np.asarray(pre, v.dtype) for v in values]
+            if single:
+                outs = values
+            else:
+                flat = np.concatenate([v.reshape(-1) for v in values]) \
+                    if len(values) > 1 else values[0].reshape(-1)
+                if op == ReduceOp.ADASUM:
+                    from .adasum import host_adasum
+
+                    red = host_adasum(flat, ps)
+                else:
+                    red = hostc.host_allreduce(flat, ps, op)
+                outs = []
+                off = 0
+                for shape in resp.tensor_shapes:
+                    n = int(np.prod(shape)) if shape else 1
+                    outs.append(red[off:off + n].reshape(shape))
+                    off += n
+            if post != 1.0:
+                outs = [o * np.asarray(post, o.dtype) for o in outs]
+            for entry, out in zip(entries, outs):
+                finish(entry, out)
+        elif rt == RequestType.ALLGATHER:
+            entry = entries[0]
+            dim0s = [s[0] for s in resp.tensor_shapes]
+            if single:
+                out = np.asarray(entry.tensor) if entry else np.zeros((0,), dtype)
+            else:
+                my = np.asarray(entry.tensor) if entry is not None and \
+                    entry.tensor is not None else \
+                    np.zeros((0,) + tuple(resp.tensor_shapes[0][1:]), dtype)
+                out = hostc.host_allgather(my, ps, dim0s)
+            finish(entry, out)
+        elif rt == RequestType.BROADCAST:
+            entry = entries[0]
+            shape = resp.tensor_shapes[0]
+            if single:
+                out = np.asarray(entry.tensor) if entry else np.zeros(shape, dtype)
+            else:
+                val = np.asarray(entry.tensor) if entry is not None and \
+                    entry.tensor is not None else None
+                out = hostc.host_broadcast(val, resp.root_rank, ps, shape,
+                                           dtype)
+            finish(entry, out)
+        elif rt == RequestType.ALLTOALL:
+            entry = entries[0]
+            all_splits = [list(s) for s in resp.recv_splits]
+            if single:
+                out = np.asarray(entry.tensor) if entry else np.zeros((0,), dtype)
+                recv = [out.shape[0]] if out.ndim else [0]
+            else:
+                # joined rank: zero-row contribution with zero splits
+                my = (np.asarray(entry.tensor) if entry is not None and
+                      entry.tensor is not None else
+                      np.zeros((0,) + tuple(resp.tensor_shapes[0][1:]), dtype))
+                my_splits = all_splits[ps.rank()]
+                out, recv = hostc.host_alltoall(my, my_splits, ps, all_splits)
+            if entry is not None:
+                result = (out, recv)
+                if entry.was_jax:
+                    import jax.numpy as jnp
+
+                    result = (jnp.asarray(out), recv)
+                self.handles.mark_done(entry.handle, Status.ok(), result)
+        elif rt == RequestType.REDUCESCATTER:
+            entry = entries[0]
+            op = ReduceOp(resp.reduce_op)
+            if single:
+                out = np.asarray(entry.tensor) if entry else np.zeros((0,), dtype)
+            else:
+                # joined rank contributes zeros of the negotiated shape
+                my = (np.asarray(entry.tensor) if entry is not None and
+                      entry.tensor is not None else
+                      np.zeros(tuple(resp.tensor_shapes[0]), dtype))
+                out = hostc.host_reducescatter(my, ps, op)
+            finish(entry, out)
+        else:
+            raise HorovodInternalError(f"Unknown response type {rt}")
+
+    def _fail_response(self, resp: Response, message: str) -> None:
+        for entry in self._pop_entries(resp):
+            if entry is not None:
+                self.handles.mark_done(entry.handle,
+                                       Status.unknown(message))
+        if self._timeline:
+            for name in resp.tensor_names:
+                self._timeline.instant(name, "ERROR", {"message": message})
+
+    def _fail_all(self, message: str) -> None:
+        with self._lock:
+            self._running = False
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            self.handles.mark_done(e.handle, Status.unknown(message))
+        self.handles.abort_all(message)
+
+    # -- group registration -------------------------------------------------
+    def register_group(self, group_id: int, names: Sequence[str]) -> None:
+        self._group_members[group_id] = set(names)
+
+    def shutdown(self) -> None:
+        self._running = False
+        self._thread.join(timeout=5)
+        self.handles.abort_all("controller shut down")
+        self.cp.shutdown()
+
+
+def numpy_dtype_of_safe(tensor_type: int) -> np.dtype:
+    from ..common.types import DataType, numpy_dtype_of
+
+    try:
+        return numpy_dtype_of(DataType(tensor_type))
+    except Exception:
+        return np.dtype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Module-level controller lifecycle
+# ---------------------------------------------------------------------------
+
+def _controller() -> EagerController:
+    state = basics._global_state()
+    if not state.initialized:
+        from ..common.exceptions import NotInitializedError
+
+        raise NotInitializedError()
+    with state.lock:
+        if state.eager_controller is None:
+            state.eager_controller = EagerController()
+        return state.eager_controller
+
+
+def shutdown_controller() -> None:
+    state = basics._global_state()
+    with state.lock:
+        if state.eager_controller is not None:
+            state.eager_controller.shutdown()
+            state.eager_controller = None
+
+
+# ---------------------------------------------------------------------------
+# Public API (ref: torch/mpi_ops.py:107-994 API surface)
+# ---------------------------------------------------------------------------
+
+_name_counters: Dict[str, Any] = collections.defaultdict(itertools.count)
+
+
+def _auto_name(kind: str, name: Optional[str]) -> str:
+    """Deterministic auto-naming — identical across ranks as long as ops are
+    issued in the same order (ref: allreduce.noname.N convention)."""
+    if name is not None:
+        return name
+    return f"{kind}.noname.{next(_name_counters[kind])}"
+
+
+def _prep(tensor) -> Tuple[np.ndarray, bool]:
+    was_jax = type(tensor).__module__.startswith("jax")
+    return np.asarray(tensor), was_jax
+
+
+def _resolve_op(op, average):
+    if op is not None and average is not None:
+        raise ValueError("Specify either op or average, not both")
+    if op is None:
+        if average is None or average:
+            return ReduceOp.AVERAGE
+        return ReduceOp.SUM
+    return ReduceOp(op)
+
+
+def allreduce_async(tensor, average=None, name: Optional[str] = None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    """Asynchronously allreduce a named tensor across ranks
+    (ref: torch/mpi_ops.py allreduce_async_)."""
+    ps = process_set or global_process_set()
+    value, was_jax = _prep(tensor)
+    rop = _resolve_op(op, average)
+    req = Request(_controller().cp.rank(),
+                  RequestType.ADASUM if rop == ReduceOp.ADASUM
+                  else RequestType.ALLREDUCE,
+                  _auto_name("allreduce", name), int(data_type_of(value)),
+                  tuple(value.shape), int(rop), prescale_factor,
+                  postscale_factor, process_set_id=ps.id)
+    return _controller().enqueue(req, value, was_jax)
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set: Optional[ProcessSet] = None):
+    return synchronize(allreduce_async(tensor, average, name, op,
+                                       prescale_factor, postscale_factor,
+                                       process_set))
+
+
+def grouped_allreduce_async(tensors: Sequence, average=None,
+                            name: Optional[str] = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            process_set: Optional[ProcessSet] = None) -> List[int]:
+    """Grouped allreduce: all-or-nothing fusion
+    (ref: EnqueueTensorAllreduces operations.cc:1384, GroupTable)."""
+    ps = process_set or global_process_set()
+    ctl = _controller()
+    rop = _resolve_op(op, average)
+    gid = ctl.next_group_id()
+    base = _auto_name("grouped_allreduce", name)
+    names = [f"{base}.{i}" for i in range(len(tensors))]
+    ctl.register_group(gid, names)
+    handles = []
+    for nm, t in zip(names, tensors):
+        value, was_jax = _prep(t)
+        req = Request(ctl.cp.rank(), RequestType.ALLREDUCE, nm,
+                      int(data_type_of(value)), tuple(value.shape), int(rop),
+                      prescale_factor, postscale_factor,
+                      process_set_id=ps.id, group_id=gid)
+        handles.append(ctl.enqueue(req, value, was_jax))
+    return handles
+
+
+def grouped_allreduce(tensors: Sequence, **kwargs) -> List:
+    return [synchronize(h) for h in grouped_allreduce_async(tensors, **kwargs)]
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    ps = process_set or global_process_set()
+    value, was_jax = _prep(tensor)
+    req = Request(_controller().cp.rank(), RequestType.ALLGATHER,
+                  _auto_name("allgather", name), int(data_type_of(value)),
+                  tuple(value.shape), process_set_id=ps.id)
+    return _controller().enqueue(req, value, was_jax)
+
+
+def allgather(tensor, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    ps = process_set or global_process_set()
+    value, was_jax = _prep(tensor)
+    req = Request(_controller().cp.rank(), RequestType.BROADCAST,
+                  _auto_name("broadcast", name), int(data_type_of(value)),
+                  tuple(value.shape), root_rank=root_rank,
+                  process_set_id=ps.id)
+    return _controller().enqueue(req, value, was_jax)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
+
+
+def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
+                   name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    ps = process_set or global_process_set()
+    value, was_jax = _prep(tensor)
+    if splits is None:
+        n = value.shape[0]
+        p = ps.size()
+        base, rem = divmod(n, p)
+        splits = [base + (1 if i < rem else 0) for i in range(p)]
+    if int(sum(splits)) != value.shape[0]:
+        raise ValueError(
+            f"splits sum ({sum(splits)}) != tensor dim0 ({value.shape[0]})")
+    req = Request(_controller().cp.rank(), RequestType.ALLTOALL,
+                  _auto_name("alltoall", name), int(data_type_of(value)),
+                  tuple(value.shape), splits=tuple(int(s) for s in splits),
+                  process_set_id=ps.id)
+    return _controller().enqueue(req, value, was_jax)
+
+
+def alltoall(tensor, splits: Optional[Sequence[int]] = None,
+             name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None):
+    """Returns (output, recv_splits) (ref: torch/mpi_ops.py alltoall)."""
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
+
+
+def reducescatter_async(tensor, op=ReduceOp.SUM, name: Optional[str] = None,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    ps = process_set or global_process_set()
+    value, was_jax = _prep(tensor)
+    req = Request(_controller().cp.rank(), RequestType.REDUCESCATTER,
+                  _auto_name("reducescatter", name),
+                  int(data_type_of(value)), tuple(value.shape),
+                  int(ReduceOp(op)), process_set_id=ps.id)
+    return _controller().enqueue(req, value, was_jax)
+
+
+def reducescatter(tensor, op=ReduceOp.SUM, name: Optional[str] = None,
+                  process_set: Optional[ProcessSet] = None):
+    return synchronize(reducescatter_async(tensor, op, name, process_set))
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Block until all ranks reach the barrier (ref: operations.cc barrier
+    enqueue :1767)."""
+    ps = process_set or global_process_set()
+    ctl = _controller()
+    req = Request(ctl.cp.rank(), RequestType.BARRIER,
+                  _auto_name("barrier", None), 0, (), process_set_id=ps.id)
+    synchronize(ctl.enqueue(req, None, False))
+
+
+def join(process_set: Optional[ProcessSet] = None) -> int:
+    """Signal this rank has no more work; block until all ranks join.
+    Returns the last rank to join (ref: torch/mpi_ops.py:954 join;
+    JoinOp ops/collective_operations.h:275)."""
+    ps = process_set or global_process_set()
+    return synchronize(_controller().enqueue_join(ps))
+
+
+def poll(handle: int) -> bool:
+    return _controller().handles.poll(handle)
+
+
+def synchronize(handle: int, timeout: Optional[float] = None):
+    return _controller().handles.synchronize(handle, timeout)

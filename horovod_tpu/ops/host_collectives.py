@@ -1,0 +1,217 @@
+"""Cross-process data plane for the eager path, over host arrays.
+
+TPU-native replacement for the reference's CPU/network data plane
+(ref: ops/mpi_operations.cc, ops/gloo_operations.cc): eager tensors live on
+the host (or a single local device) per process; collectives across
+processes are executed as jitted XLA programs over the process-set's device
+mesh, so the bytes ride ICI/DCN exactly like the jit path — there is no
+second transport stack to maintain.
+
+Mechanics: each process contributes its value on its first local mesh
+device (identity elements elsewhere), a cached jitted reduction with
+replicated output sharding forces the collective, and every process reads
+the replicated result locally.  Single-process short-circuits at the layer
+above (ops/eager.py), so these functions assume size > 1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+
+__all__ = ["host_allreduce", "host_allgather", "host_broadcast",
+           "host_alltoall", "host_reducescatter"]
+
+
+def _identity_value(op: ReduceOp, dtype: np.dtype):
+    """Reduction identity element, dtype-aware (int MIN/MAX must not use
+    float infinities)."""
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        return 0
+    if op == ReduceOp.PRODUCT:
+        return 1
+    if op == ReduceOp.MIN:
+        return np.iinfo(dtype).max if dtype.kind in "iu" else np.inf
+    if op == ReduceOp.MAX:
+        return np.iinfo(dtype).min if dtype.kind in "iu" else -np.inf
+    raise ValueError(f"No identity for {op}")
+
+
+@functools.lru_cache(maxsize=32)
+def _flat_mesh(mesh):
+    """1-D view of any mesh for host collectives (the eager data plane is
+    rank-level, so axis structure is irrelevant here)."""
+    from jax.sharding import Mesh
+
+    if mesh.axis_names == ("dp",) and mesh.devices.ndim == 1:
+        return mesh
+    return Mesh(np.asarray(list(mesh.devices.flat), dtype=object), ("dp",))
+
+
+def _mesh_local_devices(mesh) -> List[Any]:
+    import jax
+
+    local = [d for d in mesh.devices.flat if d.process_index ==
+             jax.process_index()]
+    if not local:
+        raise RuntimeError("This process owns no devices in the mesh")
+    return local
+
+
+@functools.lru_cache(maxsize=256)
+def _reduce_fn(mesh, op: ReduceOp, n_participants: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def fn(g):
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+            out = g.sum(0)
+            if op == ReduceOp.AVERAGE:
+                out = out / n_participants
+        elif op == ReduceOp.MIN:
+            out = g.min(0)
+        elif op == ReduceOp.MAX:
+            out = g.max(0)
+        elif op == ReduceOp.PRODUCT:
+            out = g.prod(0)
+        else:
+            raise ValueError(f"Unsupported host reduce op {op}")
+        return out
+
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=16)
+def _identity_fn(mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(lambda g: g, out_shardings=NamedSharding(mesh, P()))
+
+
+def _make_global(mesh, rows_per_device: Dict[Any, np.ndarray],
+                 row_shape: Tuple[int, ...], dtype) -> Any:
+    """Build a global (D, *row_shape) array where device d holds
+    rows_per_device[d]."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = list(mesh.devices.flat)
+    sharding = NamedSharding(mesh, P("dp", *([None] * len(row_shape))))
+    local = [jax.device_put(rows_per_device[d][None], d)
+             for d in devs if d.process_index == jax.process_index()]
+    return jax.make_array_from_single_device_arrays(
+        (len(devs),) + row_shape, sharding, local)
+
+
+def _contribution_rows(mesh, value: np.ndarray, identity_val: float):
+    """value on the first local device, identity elsewhere."""
+    local = _mesh_local_devices(mesh)
+    rows = {}
+    for i, d in enumerate(local):
+        if i == 0:
+            rows[d] = value
+        else:
+            rows[d] = np.full_like(value, identity_val)
+    return rows
+
+
+def host_allreduce(value: np.ndarray, process_set, op: ReduceOp) -> np.ndarray:
+    """Allreduce ``value`` across the processes of ``process_set``."""
+    mesh = _flat_mesh(process_set.mesh)
+    value = np.ascontiguousarray(value)
+    calc_dtype = value.dtype
+    if op == ReduceOp.PRODUCT and value.dtype.kind in "iu":
+        calc_dtype = np.float64  # avoid int overflow surprises in prod
+    rows = _contribution_rows(mesh, value.astype(calc_dtype),
+                              _identity_value(op, np.dtype(calc_dtype)))
+    g = _make_global(mesh, rows, value.shape, calc_dtype)
+    out = _reduce_fn(mesh, op, process_set.size())(g)
+    return np.asarray(out.addressable_data(0)).astype(value.dtype)
+
+
+def host_broadcast(value: Optional[np.ndarray], root_rank: int, process_set,
+                   shape: Tuple[int, ...], dtype) -> np.ndarray:
+    """Broadcast from set-relative ``root_rank``.  Non-root processes pass
+    value=None and receive the root's tensor."""
+    mesh = _flat_mesh(process_set.mesh)
+    is_root = process_set.rank() == root_rank
+    contrib = (np.ascontiguousarray(value) if is_root
+               else np.zeros(shape, dtype))
+    rows = _contribution_rows(mesh, contrib, 0.0)
+    g = _make_global(mesh, rows, tuple(shape), np.dtype(dtype))
+    out = _reduce_fn(mesh, ReduceOp.SUM, process_set.size())(g)
+    return np.asarray(out.addressable_data(0)).astype(dtype)
+
+
+def host_allgather(value: np.ndarray, process_set,
+                   all_dim0: Sequence[int]) -> np.ndarray:
+    """Ragged allgather: concat along dim 0 with per-rank sizes
+    ``all_dim0`` (negotiated by the controller — the analog of the
+    allgather displacement math in ops/collective_operations.h:129)."""
+    mesh = _flat_mesh(process_set.mesh)
+    value = np.ascontiguousarray(value)
+    max0 = max(all_dim0) if all_dim0 else 0
+    rest = value.shape[1:]
+    padded = np.zeros((max0,) + rest, value.dtype)
+    padded[: value.shape[0]] = value
+    # Row for first local device = my padded block; zeros elsewhere.  The
+    # replicated identity jit forces an all-gather of every row.
+    rows = _contribution_rows(mesh, padded, 0.0)
+    g = _make_global(mesh, rows, (max0,) + rest, value.dtype)
+    full = np.asarray(_identity_fn(mesh)(g).addressable_data(0))
+    # row index of each process's first local device in mesh order
+    devs = list(mesh.devices.flat)
+    first_row_of_proc: Dict[int, int] = {}
+    for i, d in enumerate(devs):
+        first_row_of_proc.setdefault(d.process_index, i)
+    import jax
+
+    proc_ids = sorted(first_row_of_proc)
+    pieces = []
+    for set_rank, proc in enumerate(proc_ids):
+        n = all_dim0[set_rank]
+        pieces.append(full[first_row_of_proc[proc], :n])
+    return np.concatenate(pieces, axis=0) if pieces else value
+
+
+def host_alltoall(value: np.ndarray, splits: Sequence[int], process_set,
+                  all_splits: Sequence[Sequence[int]]) -> Tuple[np.ndarray, List[int]]:
+    """Uneven alltoall (ref: AlltoallOp PrepareOutputAndParams
+    collective_operations.h:209-273).  ``all_splits[r]`` is rank r's send
+    splits, negotiated by the controller.  Returns (output, recv_splits).
+
+    Implemented as ragged allgather + local slicing: correctness-first (the
+    jit path's lax.all_to_all is the performance path)."""
+    my_rank = process_set.rank()
+    dim0s = [int(sum(s)) for s in all_splits]
+    gathered = host_allgather(value, process_set, dim0s)
+    out_pieces = []
+    recv_splits = []
+    offset = 0
+    for r, s in enumerate(all_splits):
+        start = offset + int(sum(s[:my_rank]))
+        n = int(s[my_rank])
+        out_pieces.append(gathered[start:start + n])
+        recv_splits.append(n)
+        offset += dim0s[r]
+    return np.concatenate(out_pieces, axis=0), recv_splits
+
+
+def host_reducescatter(value: np.ndarray, process_set,
+                       op: ReduceOp) -> np.ndarray:
+    """Reduce + scatter rows (TPU-native extension; equal-ish split with
+    remainder to low ranks)."""
+    reduced = host_allreduce(value, process_set, op)
+    p = process_set.size()
+    r = process_set.rank()
+    n = reduced.shape[0]
+    base, rem = divmod(n, p)
+    start = r * base + min(r, rem)
+    stop = start + base + (1 if r < rem else 0)
+    return reduced[start:stop]

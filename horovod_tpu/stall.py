@@ -1,0 +1,80 @@
+"""Coordinator-side stall detection.
+
+TPU-native analog of the reference's StallInspector
+(ref: common/stall_inspector.{h,cc}; check logic stall_inspector.cc:32-104):
+warns when a tensor has been submitted on some-but-not-all ranks for longer
+than the warning threshold, listing ready and missing ranks; optionally
+shuts training down after a second threshold.  Even on TPU this matters —
+host-side logic divergence (a rank skipping a step) hangs the negotiation
+exactly as it does on GPU clusters.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from .common import config
+from .common.logging_util import get_logger
+
+__all__ = ["StallInspector"]
+
+log = get_logger(__name__)
+
+
+class StallInspector:
+    def __init__(self, world_size: int,
+                 warn_seconds: Optional[int] = None,
+                 shutdown_seconds: Optional[int] = None,
+                 on_shutdown: Optional[Callable[[str], None]] = None):
+        self.enabled = not config.get_bool("HVDT_STALL_CHECK_DISABLE")
+        self.warn_s = (warn_seconds if warn_seconds is not None
+                       else config.get_int("HVDT_STALL_CHECK_TIME_SECONDS"))
+        self.shutdown_s = (shutdown_seconds if shutdown_seconds is not None
+                           else config.get_int("HVDT_STALL_SHUTDOWN_TIME_SECONDS"))
+        self.world_size = world_size
+        self.on_shutdown = on_shutdown
+        # tensor name -> (first_seen_ts, ranks that reported)
+        self._pending: Dict[str, tuple] = {}
+        self._warned: Set[str] = set()
+        self._last_check = 0.0
+
+    def record(self, name: str, rank: int) -> None:
+        ts, ranks = self._pending.get(name, (time.monotonic(), set()))
+        ranks.add(rank)
+        self._pending[name] = (ts, ranks)
+
+    def resolve(self, name: str) -> None:
+        self._pending.pop(name, None)
+        self._warned.discard(name)
+
+    def check(self) -> List[str]:
+        """Run the stall check; returns names of stalled tensors
+        (ref: stall_inspector.cc:32-104).  Called from the controller's
+        cycle loop on the coordinator rank."""
+        if not self.enabled:
+            return []
+        now = time.monotonic()
+        if now - self._last_check < 1.0:
+            return []
+        self._last_check = now
+        stalled = []
+        for name, (ts, ranks) in self._pending.items():
+            age = now - ts
+            if age > self.warn_s and name not in self._warned:
+                missing = sorted(set(range(self.world_size)) - ranks)
+                log.warning(
+                    "One or more tensors were submitted to be reduced/"
+                    "gathered but were not ready on all ranks for %.0fs. "
+                    "This may indicate diverged host-side control flow. "
+                    "Stalled op: %s [ready ranks: %s] [missing ranks: %s]",
+                    age, name, sorted(ranks), missing)
+                self._warned.add(name)
+                stalled.append(name)
+            if self.shutdown_s and age > self.shutdown_s:
+                msg = (f"Stalled tensor {name} exceeded shutdown threshold "
+                       f"({self.shutdown_s}s)")
+                log.error(msg)
+                if self.on_shutdown:
+                    self.on_shutdown(msg)
+        return stalled

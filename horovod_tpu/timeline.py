@@ -1,0 +1,178 @@
+"""Per-tensor Chrome-tracing timeline profiler.
+
+TPU-native re-conception of the reference's Timeline subsystem
+(ref: common/timeline.{h,cc} — TimelineWriter timeline.h:48, Timeline
+timeline.h:108, TimelineController timeline.h:165; JSON emission
+timeline.cc:217-294; "tensors as pids" timeline.cc:244-266).
+
+Phases mirror the reference lifecycle (common.h:72-105): NEGOTIATE_<OP>,
+QUEUE, FUSE, <BACKEND> activity, with an end marker carrying the output
+shape.  Events are pushed onto a queue consumed by a dedicated writer
+thread, so the hot path never blocks on file IO (same design as
+TimelineWriter's record queue).
+
+Enable via ``HVDT_TIMELINE=<path>`` or dynamically with
+``timeline.start_timeline`` / ``stop_timeline``
+(ref: horovod_start_timeline operations.cc:1032-1064).
+
+For device-side tracing, see ``jax.profiler`` integration in
+``horovod_tpu.ops.eager`` — each fused collective executes under a named
+``jax.profiler.TraceAnnotation`` so XPlane traces carry the same names.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .common import config
+from .common.logging_util import get_logger
+
+__all__ = ["Timeline", "start_timeline", "stop_timeline", "get_timeline"]
+
+log = get_logger(__name__)
+
+
+class _Event:
+    __slots__ = ("phase", "tensor", "marker", "args", "ts")
+
+    def __init__(self, phase: str, tensor: str, marker: str,
+                 args: Optional[dict], ts: float):
+        self.phase = phase      # 'B' begin, 'E' end, 'i' instant, 'M' meta
+        self.tensor = tensor
+        self.marker = marker
+        self.args = args
+        self.ts = ts
+
+
+class Timeline:
+    """Chrome-tracing JSON writer with an async writer thread.
+
+    Each tensor gets its own "pid" row; activities nest as duration events
+    (ref: timeline.cc:244-266).
+    """
+
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._queue: "queue.Queue[Optional[_Event]]" = queue.Queue()
+        self._tensor_pids: Dict[str, int] = {}
+        self._next_pid = 1
+        self._lock = threading.Lock()
+        self._start = time.perf_counter()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._closed = False
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="hvdt-timeline-writer",
+                                        daemon=True)
+        self._writer.start()
+
+    # -- recording API (hot path: enqueue only) -----------------------------
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._start) * 1e6
+
+    def start_activity(self, tensor: str, activity: str,
+                       args: Optional[dict] = None) -> None:
+        self._queue.put(_Event("B", tensor, activity, args, self._now_us()))
+
+    def end_activity(self, tensor: str, args: Optional[dict] = None) -> None:
+        self._queue.put(_Event("E", tensor, "", args, self._now_us()))
+
+    def instant(self, tensor: str, marker: str,
+                args: Optional[dict] = None) -> None:
+        self._queue.put(_Event("i", tensor, marker, args, self._now_us()))
+
+    def mark_cycle(self) -> None:
+        if self.mark_cycles:
+            self.instant("_cycle", "CYCLE")
+
+    # -- writer thread ------------------------------------------------------
+    def _pid_for(self, tensor: str) -> int:
+        pid = self._tensor_pids.get(tensor)
+        if pid is None:
+            pid = self._next_pid
+            self._next_pid += 1
+            self._tensor_pids[tensor] = pid
+            self._emit({"name": "process_name", "ph": "M", "pid": pid,
+                        "args": {"name": tensor}})
+        return pid
+
+    def _emit(self, record: dict) -> None:
+        if not self._first:
+            self._file.write(",\n")
+        self._first = False
+        self._file.write(json.dumps(record))
+
+    def _writer_loop(self) -> None:
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                break
+            pid = self._pid_for(ev.tensor)
+            rec = {"ph": ev.phase, "pid": pid, "tid": 0,
+                   "ts": round(ev.ts, 3)}
+            if ev.phase in ("B", "i"):
+                rec["name"] = ev.marker
+            if ev.phase == "i":
+                rec["s"] = "p"
+            if ev.args:
+                rec["args"] = ev.args
+            self._emit(rec)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._writer.join(timeout=5)
+        self._file.write("\n]\n")
+        self._file.close()
+
+
+# -- module-level singleton control (ref: TimelineController) ---------------
+
+_timeline: Optional[Timeline] = None
+_tl_lock = threading.Lock()
+
+
+def current() -> Optional[Timeline]:
+    """The active timeline, if any — cheap read for hot paths (no lock, no
+    env auto-start).  Callers needing auto-start use get_timeline() once."""
+    return _timeline
+
+
+def get_timeline() -> Optional[Timeline]:
+    """Active timeline, auto-starting from HVDT_TIMELINE on first call."""
+    global _timeline
+    with _tl_lock:
+        if _timeline is None:
+            path = config.get_str("HVDT_TIMELINE")
+            if path:
+                _timeline = Timeline(
+                    path, config.get_bool("HVDT_TIMELINE_MARK_CYCLES"))
+        return _timeline
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> None:
+    """Start recording dynamically (ref: operations.cc:1032
+    horovod_start_timeline)."""
+    global _timeline
+    with _tl_lock:
+        if _timeline is not None:
+            log.warning("timeline already active; ignoring start_timeline")
+            return
+        _timeline = Timeline(path, mark_cycles)
+
+
+def stop_timeline() -> None:
+    global _timeline
+    with _tl_lock:
+        if _timeline is not None:
+            _timeline.close()
+            _timeline = None

@@ -1,0 +1,122 @@
+"""Training-loop callbacks and schedules.
+
+TPU-native analog of the reference's Keras callback set
+(ref: horovod/_keras/callbacks.py — BroadcastGlobalVariablesCallback :20,
+MetricAverageCallback :49, LearningRateScheduleCallback,
+LearningRateWarmupCallback; keras/callbacks.py:151 BestModelCheckpoint).
+
+JAX training loops are explicit, so these are functions/schedules rather
+than Keras callback objects — same capabilities, idiomatic shape:
+
+* ``broadcast_global_state``    — sync params+opt state from rank 0 at start
+* ``average_metrics``           — allreduce epoch metrics across ranks
+* ``warmup_schedule``           — LR warmup to lr*size over N steps (the
+  "facebook paper" ramp the reference implements)
+* ``rank_zero_only``            — checkpoint-on-rank-0 guard
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Mapping, Optional
+
+import numpy as np
+
+from .common import basics
+from .common.process_sets import ProcessSet, global_process_set
+from .functions import broadcast_optimizer_state, broadcast_parameters
+
+__all__ = ["broadcast_global_state", "average_metrics", "warmup_schedule",
+           "rank_zero_only", "BestModelCheckpoint"]
+
+
+def broadcast_global_state(params, opt_state=None, root_rank: int = 0,
+                           process_set: Optional[ProcessSet] = None):
+    """Make rank 0's params (and optionally optimizer state) authoritative
+    (ref: BroadcastGlobalVariablesCallback on_batch_end-once semantics)."""
+    params = broadcast_parameters(params, root_rank, process_set)
+    if opt_state is not None:
+        opt_state = broadcast_optimizer_state(opt_state, root_rank,
+                                              process_set)
+        return params, opt_state
+    return params
+
+
+def average_metrics(metrics: Mapping[str, Any],
+                    process_set: Optional[ProcessSet] = None) -> Dict[str, Any]:
+    """Average scalar metrics across ranks at epoch end
+    (ref: MetricAverageCallback _keras/callbacks.py:49)."""
+    from .ops import eager
+
+    ps = process_set or global_process_set()
+    out = {}
+    for key in sorted(metrics):
+        val = np.asarray(metrics[key], dtype=np.float64)
+        out[key] = float(eager.allreduce(val, name=f"metric.{key}",
+                                         process_set=ps))
+    return out
+
+
+def warmup_schedule(base_lr: float, warmup_steps: int,
+                    scale: Optional[float] = None,
+                    after: Optional[Callable[[int], float]] = None):
+    """LR schedule ramping from base_lr to base_lr*scale over warmup_steps
+    (ref: LearningRateWarmupCallback — gradual warmup to the size-scaled
+    rate per Goyal et al.), then following ``after`` (step→multiplier-free
+    absolute schedule) or holding the scaled rate.
+
+    ``scale`` defaults to world size (the linear-scaling rule)."""
+    if scale is None:
+        scale = float(max(1, basics.size())) if basics.is_initialized() else 1.0
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, jnp.float32)
+        target = base_lr * scale
+        frac = jnp.minimum(step / max(1, warmup_steps), 1.0)
+        warm = base_lr + (target - base_lr) * frac
+        if after is None:
+            return warm
+        return jnp.where(step < warmup_steps, warm, after(step))
+
+    return schedule
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Decorator: run only on (global) rank 0 — the checkpoint guard
+    (ref: rank-0-only save pattern, keras/callbacks.py:151)."""
+
+    def wrapper(*args, **kwargs):
+        if basics.rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper
+
+
+class BestModelCheckpoint:
+    """Keep the best params by a monitored metric, saving on rank 0 only
+    (ref: keras/callbacks.py:151 BestModelCheckpoint)."""
+
+    def __init__(self, path: str, monitor: str = "val_loss",
+                 mode: str = "min"):
+        self.path = path
+        self.monitor = monitor
+        self.mode = mode
+        self.best: Optional[float] = None
+
+    def __call__(self, metrics: Mapping[str, Any], params) -> bool:
+        value = float(np.asarray(metrics[self.monitor]))
+        better = (self.best is None or
+                  (value < self.best if self.mode == "min" else
+                   value > self.best))
+        if better:
+            self.best = value
+            if basics.rank() == 0:
+                import pickle
+
+                import jax
+
+                with open(self.path, "wb") as f:
+                    pickle.dump(jax.device_get(params), f)
+        return better

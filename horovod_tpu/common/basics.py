@@ -242,14 +242,15 @@ def init(
 
 def shutdown() -> None:
     """Tear down (ref: operations.cc horovod_shutdown)."""
+    from ..timeline import stop_timeline
+
     with _state.lock:
         if not _state.initialized:
+            stop_timeline()  # a timeline may exist without init
             return
         if _state.eager_controller is not None:
             _state.eager_controller.shutdown()
         _state.reset()
-    from ..timeline import stop_timeline
-
     stop_timeline()
 
 

@@ -1,0 +1,122 @@
+"""State-consistency helpers: broadcast parameters / optimizer state / objects.
+
+TPU-native analog of the reference's broadcast functions
+(ref: torch/functions.py:30-235 broadcast_parameters /
+broadcast_optimizer_state / broadcast_object; tensorflow/functions.py
+broadcast_variables).  Used at training start (and after elastic resets) to
+make rank 0's state authoritative.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+from .common.process_sets import ProcessSet, global_process_set
+
+__all__ = ["broadcast_parameters", "broadcast_optimizer_state",
+           "broadcast_object", "allgather_object"]
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None):
+    """Broadcast a pytree of arrays from ``root_rank`` to all ranks
+    (ref: torch/functions.py:30 broadcast_parameters).
+
+    Eager-path operation (host collectives); returns a new pytree.  Inside
+    jit, use ops.device.broadcast instead.
+    """
+    import jax
+
+    from .ops import eager
+
+    ps = process_set or global_process_set()
+    leaves, treedef = jax.tree.flatten(params)
+    handles = [
+        eager.broadcast_async(leaf, root_rank,
+                              name=f"broadcast_parameters.{i}",
+                              process_set=ps)
+        for i, leaf in enumerate(leaves)
+    ]
+    out = [eager.synchronize(h) for h in handles]
+    return jax.tree.unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None):
+    """Broadcast an optax optimizer-state pytree (ref: torch/functions.py
+    broadcast_optimizer_state — there a state-dict walk; here optimizer
+    state is already a pytree, so it reduces to broadcast_parameters with
+    non-array leaves carried via object broadcast)."""
+    import jax
+
+    ps = process_set or global_process_set()
+    leaves, treedef = jax.tree.flatten(opt_state)
+    array_idx = [i for i, l in enumerate(leaves)
+                 if hasattr(l, "shape") and hasattr(l, "dtype")]
+    arrays = [leaves[i] for i in array_idx]
+    new_arrays = broadcast_parameters(arrays, root_rank, ps) if arrays else []
+    others = [l for i, l in enumerate(leaves) if i not in set(array_idx)]
+    new_others = broadcast_object(others, root_rank, ps) if others else []
+    out = list(leaves)
+    for i, v in zip(array_idx, new_arrays):
+        out[i] = v
+    oi = 0
+    for i in range(len(out)):
+        if i not in set(array_idx):
+            out[i] = new_others[oi]
+            oi += 1
+    return jax.tree.unflatten(treedef, out)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     process_set: Optional[ProcessSet] = None,
+                     name: Optional[str] = None) -> Any:
+    """Broadcast an arbitrary picklable object
+    (ref: torch/functions.py:146 broadcast_object: serialize → bcast size →
+    bcast payload)."""
+    from .ops import eager
+
+    ps = process_set or global_process_set()
+    name = name or "broadcast_object"
+    if ps.rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        size = np.array([payload.shape[0]], dtype=np.int64)
+    else:
+        payload = None
+        size = np.zeros(1, dtype=np.int64)
+    size = eager.broadcast(size, root_rank, name=f"{name}.size",
+                           process_set=ps)
+    n = int(size[0])
+    if payload is None:
+        payload = np.zeros(n, dtype=np.uint8)
+    payload = eager.broadcast(payload, root_rank, name=f"{name}.data",
+                              process_set=ps)
+    return pickle.loads(np.asarray(payload).tobytes())
+
+
+def allgather_object(obj: Any, process_set: Optional[ProcessSet] = None,
+                     name: Optional[str] = None) -> list:
+    """Gather a picklable object from every rank (ref: torch/mpi_ops.py
+    allgather_object)."""
+    from .ops import eager
+
+    ps = process_set or global_process_set()
+    name = name or "allgather_object"
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    gathered = eager.allgather(payload.reshape(-1, 1),
+                               name=f"{name}.data", process_set=ps)
+    # ragged gather of (n_i, 1) blocks; recover per-rank lengths
+    sizes = eager.allgather(np.array([[payload.shape[0]]], dtype=np.int64),
+                            name=f"{name}.sizes", process_set=ps)
+    out = []
+    offset = 0
+    flat = np.asarray(gathered).reshape(-1)
+    for n in np.asarray(sizes).reshape(-1):
+        out.append(pickle.loads(flat[offset:offset + int(n)]
+                                .astype(np.uint8).tobytes()))
+        offset += int(n)
+    return out

@@ -16,13 +16,14 @@ ranks.  Across N = 2^k ranks it is applied recursively in a binary tree
 
 Two implementations:
 
-* ``adasum_allreduce`` — jit/shard_map path.  Instead of the reference's
-  point-to-point recursive halving (an MPI pattern), the TPU-native design
-  computes the tree reduction out of all-gathered per-rank dot products:
-  the vectors are reduce-scattered across ranks first (so each rank holds a
-  1/N shard — same bandwidth shape as the reference's hierarchical version,
-  nccl_operations.cc:249-517), then the k-level combination runs on shards
-  with one psum of 3 scalars per level.
+* ``adasum_allreduce`` — jit/shard_map path: one ``all_gather`` of the
+  flattened per-rank vectors, then every rank evaluates the identical
+  binary combination tree locally (the tree is unrolled at trace time —
+  rank count is static under jit).  Correctness-first: memory/bandwidth is
+  O(N·G) per device versus the reference's recursive-halving O(G); a
+  reduce-scattered formulation (combination tree on 1/N shards with
+  psum'd scalar dots per level, mirroring the bandwidth shape of
+  nccl_operations.cc:249-517) is the planned optimization once profiled.
 * ``host_adasum`` — eager-path version over host arrays.
 """
 
@@ -80,20 +81,10 @@ def host_adasum(flat: np.ndarray, process_set) -> np.ndarray:
 def adasum_allreduce(x, axis: str = "dp"):
     """Adasum allreduce inside shard_map/jit over a mesh axis.
 
-    Algorithm (TPU-native formulation of adasum.h's recursive
-    vector-halving distance-doubling):
-
-    1. reduce-scatter is NOT applicable (values differ per rank), so each
-       rank keeps its full vector; the combination tree is evaluated with
-       all-gathered scalar dot products — per tree level, each rank needs
-       only 3 dot products involving subtree partial sums, obtained with
-       one ``all_gather`` of its local vector's dots.  For typical gradient
-       sizes the scalar traffic is negligible vs. the one all-gather of
-       vectors the reference's hierarchical variant also performs.
-
-    Implementation: gather per-rank vectors along the axis (bf16-safe in
-    f32), run the same binary tree as the host path via a fori-style
-    unrolled loop (axis size is static under jit).
+    Gathers per-rank vectors along the axis (bf16-safe: combination math in
+    f32), then runs the same binary tree as the host path, unrolled (axis
+    size is static under jit).  See the module docstring for the
+    memory/bandwidth caveat vs. the reference's recursive halving.
     """
     import jax
     import jax.numpy as jnp
@@ -117,7 +108,5 @@ def adasum_allreduce(x, axis: str = "dp"):
                                        jnp.vdot(b, b)))
             vecs = nxt
         return vecs[0].reshape(orig_shape).astype(orig_dtype)
-
-    import jax
 
     return jax.tree.map(_one, x)

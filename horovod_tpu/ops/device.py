@@ -50,6 +50,53 @@ def axis_size(axis: AxisName) -> int:
     return lax.axis_size(axis)
 
 
+def _axes_tuple(axis: AxisName) -> Tuple[str, ...]:
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def _vma_tracking_active(axis: AxisName) -> bool:
+    """True when varying-manual-axes tracking is live for ``axis`` in the
+    current trace.  Under ``shard_map(..., check_vma=False)`` every aval
+    reports an empty vma, which would be indistinguishable from "genuinely
+    replicated" — probe with a pcast: if even an explicitly-varied zero
+    reports an empty vma, tracking is off and callers must assume varying.
+    """
+    import jax.numpy as jnp
+
+    for a in _axes_tuple(axis):
+        try:
+            probe = lax.pcast(jnp.zeros((), jnp.float32), a, to="varying")
+            if a not in jax.typeof(probe).vma:
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def is_varying(x, axis: AxisName) -> bool:
+    """Whether ``x`` is varying (per-shard distinct) over ``axis`` under
+    JAX's varying-manual-axes tracking (jax>=0.8 shard_map).
+
+    Load-bearing semantics note: in modern JAX, ``jax.grad`` taken inside
+    ``shard_map`` w.r.t. a *replicated* (unvarying) parameter already
+    returns the cross-shard SUM of per-shard gradients — the AD system
+    inserts the psum to keep the cotangent unvarying.  An allreduce on such
+    a value must therefore not psum again; the varying-aware fast paths
+    below keep Horovod allreduce semantics exact in both regimes.
+
+    Conservatively returns True (collective WILL be issued) whenever
+    tracking cannot be positively confirmed: older jax, eager, or
+    ``check_vma=False`` shard_maps.
+    """
+    if not _vma_tracking_active(axis):
+        return True
+    try:
+        vma = jax.typeof(x).vma
+    except Exception:
+        return True
+    return any(a in vma for a in _axes_tuple(axis))
+
+
 def allreduce(x, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Allreduce over a mesh axis (ref: EnqueueTensorAllreduce
@@ -61,6 +108,33 @@ def allreduce(x, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE,
     """
     if prescale_factor != 1.0:
         x = jax.tree.map(lambda t: t * prescale_factor, x)
+
+    # Varying-aware fast path: an unvarying input is identical on every
+    # shard, so the reduction is a scalar identity and no collective is
+    # needed.  SEMANTICS: this treats x as "the per-rank value" — average
+    # of n identical copies is x, sum is n*x (exactly what a psum would
+    # return, minus the collective).  For GRADIENTS of replicated params,
+    # which modern AD delivers pre-summed, use
+    # optimizer.allreduce_gradients — it applies the gradient-aware
+    # interpretation (average = x/n) instead.
+    leaves = jax.tree.leaves(x)
+    if leaves and all(not is_varying(t, axis) for t in leaves):
+        n = 1
+        for a in _axes_tuple(axis):
+            n *= lax.axis_size(a)
+        if op == ReduceOp.SUM:
+            out = jax.tree.map(lambda t: t * n, x)
+        elif op in (ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
+                    ReduceOp.ADASUM):
+            out = x
+        elif op == ReduceOp.PRODUCT:
+            out = jax.tree.map(lambda t: t ** n, x)
+        else:
+            raise ValueError(f"Unsupported reduce op: {op}")
+        if postscale_factor != 1.0:
+            out = jax.tree.map(lambda t: t * postscale_factor, out)
+        return out
+
     if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         out = lax.psum(x, axis)
         if op == ReduceOp.AVERAGE:

@@ -85,6 +85,11 @@ class ResponseCache:
             collections.OrderedDict()
 
     def lookup_bit(self, req: Request) -> Optional[int]:
+        if req.group_id >= 0:
+            # grouped requests always fully negotiate: group membership is
+            # not carried by cached descriptors, and the all-or-nothing
+            # gate (GroupTable) must see the live group id
+            return None
         cached = self._entries.get(req.tensor_name)
         if cached is None:
             return None
@@ -224,7 +229,6 @@ class EagerController:
         with self._lock:
             to_send = self._to_announce
             self._to_announce = []
-            join_pending = set(self._local_join_handles)
         multi = self.cp.size() > 1
         if not multi and not to_send:
             return False
@@ -241,7 +245,7 @@ class EagerController:
                 bits.append(bit)
             else:
                 misses.append(req)
-        payload = encode_request_list(misses, joined=bool(join_pending))
+        payload = encode_request_list(misses)
         payload = f"{','.join(map(str, bits))}|{payload}"
 
         gathered = self.cp.gather(payload, self._cycle)
@@ -262,7 +266,7 @@ class EagerController:
     def _construct_response_list(self, gathered: List[str]) -> List[Response]:
         for rank, raw in enumerate(gathered):
             bits_part, _, req_part = raw.partition("|")
-            reqs, _joined = decode_request_list(req_part)
+            reqs = decode_request_list(req_part)
             if bits_part:
                 import dataclasses as _dc
 

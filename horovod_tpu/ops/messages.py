@@ -58,9 +58,13 @@ class Request:
 
     def descriptor(self) -> Tuple:
         """The fields that must agree across ranks (ref: ConstructResponse
-        shape/dtype cross-validation, controller.cc:495)."""
-        shape_part = (self.tensor_shape if self.request_type
-                      != RequestType.ALLGATHER else self.tensor_shape[1:])
+        shape/dtype cross-validation, controller.cc:495).  Allgather and
+        alltoall legitimately differ in dim 0 across ranks (ragged/uneven),
+        so only trailing dims participate for those ops."""
+        if self.request_type in (RequestType.ALLGATHER, RequestType.ALLTOALL):
+            shape_part = self.tensor_shape[1:]
+        else:
+            shape_part = self.tensor_shape
         return (self.request_type, self.tensor_type, shape_part,
                 self.reduce_op, self.root_rank, self.process_set_id)
 
@@ -110,13 +114,13 @@ class Response:
                         o[8], [tuple(s) for s in o[9]], o[10], o[11])
 
 
-def encode_request_list(reqs: Sequence[Request], joined: bool = False) -> str:
-    return json.dumps({"j": joined, "r": [r.to_obj() for r in reqs]})
+def encode_request_list(reqs: Sequence[Request]) -> str:
+    return json.dumps({"r": [r.to_obj() for r in reqs]})
 
 
-def decode_request_list(data: str) -> Tuple[List[Request], bool]:
+def decode_request_list(data: str) -> List[Request]:
     obj = json.loads(data)
-    return [Request.from_obj(o) for o in obj["r"]], bool(obj["j"])
+    return [Request.from_obj(o) for o in obj["r"]]
 
 
 def encode_response_list(resps: Sequence[Response]) -> str:

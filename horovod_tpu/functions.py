@@ -57,16 +57,17 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0,
     leaves, treedef = jax.tree.flatten(opt_state)
     array_idx = [i for i, l in enumerate(leaves)
                  if hasattr(l, "shape") and hasattr(l, "dtype")]
+    array_set = set(array_idx)
     arrays = [leaves[i] for i in array_idx]
     new_arrays = broadcast_parameters(arrays, root_rank, ps) if arrays else []
-    others = [l for i, l in enumerate(leaves) if i not in set(array_idx)]
+    others = [l for i, l in enumerate(leaves) if i not in array_set]
     new_others = broadcast_object(others, root_rank, ps) if others else []
     out = list(leaves)
     for i, v in zip(array_idx, new_arrays):
         out[i] = v
     oi = 0
     for i in range(len(out)):
-        if i not in set(array_idx):
+        if i not in array_set:
             out[i] = new_others[oi]
             oi += 1
     return jax.tree.unflatten(treedef, out)

@@ -7,8 +7,10 @@ NoneCompressor / FP16Compressor selected via ``Compression.fp16``).
 On TPU the natural wire dtype is bfloat16 (same exponent range as f32 — no
 loss-scaling gymnastics needed, and the MXU-native type), so ``fp16`` maps
 to bf16 by default; IEEE float16 remains available for parity.  In the jit
-path compression is just the ``wire_dtype`` of the fused collective; the
-eager path calls compress/decompress around the host collective.
+path the framework consumes only ``wire_dtype`` — the cast target of the
+fused collective (optimizer.py → fused_allreduce).  ``compress``/
+``decompress`` mirror the reference's optimizer-level API for user code
+that wants explicit round-trip casts around eager ops.
 """
 
 from __future__ import annotations

@@ -63,8 +63,8 @@ class HandleManager:
         if e is None:
             raise ValueError(f"Unknown handle {handle}")
         if not e.event.wait(timeout):
-            with self._lock:
-                self._entries.pop(handle, None)  # don't leak abandoned handles
+            # Keep the entry: the collective may still complete and the
+            # caller may retry synchronize()/poll() on the same handle.
             raise TimeoutError(f"Collective op (handle {handle}) timed out")
         with self._lock:
             self._entries.pop(handle, None)

@@ -1,0 +1,43 @@
+"""Parallelism substrate: mesh axes, sharding rules, SP/PP/EP building blocks.
+
+This subpackage is the capability the reference framework lacks but whose
+substrate SURVEY.md §2.7/§5.7 requires the TPU build to provide: tensor,
+pipeline, sequence/context (ring attention), and expert parallelism expressed
+natively over a ``jax.sharding.Mesh`` with XLA collectives — instead of the
+reference's answer of "more data-parallel replicas + better allreduce"
+(ref: common/process_set.{h,cc} process sets and the raw alltoall primitive,
+operations.cc:1642, are the closest the reference gets).
+
+Canonical axis names (any subset may be present in a mesh, size-1 axes are
+free):
+
+* ``dp`` — data parallel (gradient allreduce; the reference's whole world)
+* ``fsdp`` — fully-sharded data parallel (param/grad reduce-scatter +
+  all-gather; the ZeRO-style axis SURVEY.md §2.7 lists as absent upstream)
+* ``pp`` — pipeline stages (microbatch circulation over ``ppermute``)
+* ``tp`` — tensor (Megatron-style) parallel within a layer
+* ``sp`` — sequence/context parallel (ring attention)
+* ``ep`` — expert parallel (MoE alltoall token routing)
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_PP,
+    AXIS_TP,
+    AXIS_SP,
+    AXIS_EP,
+    CANONICAL_AXES,
+    MeshSpec,
+    make_mesh,
+    mesh_shape_for,
+)
+from .sharding import (  # noqa: F401
+    batch_spec,
+    logical_to_mesh,
+    named_sharding,
+    transformer_rules,
+)
+from .ring_attention import ring_attention  # noqa: F401
+from .pipeline import pipeline_spmd  # noqa: F401
+from .moe import moe_dispatch_combine  # noqa: F401

@@ -1,0 +1,137 @@
+"""Device-mesh construction for multi-axis parallelism.
+
+The TPU-native analog of the reference's rank layout machinery
+(ref: runner/common/util/hosts.py:get_host_assignments SlotInfo{rank,
+local_rank, cross_rank} — SURVEY.md §2.5): where the reference assigns one
+process per GPU and splits communicators by node, we lay devices out on an
+N-dimensional ``jax.sharding.Mesh`` whose axes name the parallelism kinds.
+
+Axis order convention follows the scaling playbook: outermost axes change
+slowest across the physical topology, so put the bandwidth-hungry axes
+(``tp``, ``sp``) innermost where neighboring devices share the fastest ICI
+links, and the latency-tolerant axes (``dp``, ``pp``) outermost where hops
+may cross DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_PP = "pp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+# Outer-to-inner canonical ordering (latency-tolerant → bandwidth-hungry).
+CANONICAL_AXES: Tuple[str, ...] = (
+    AXIS_DP, AXIS_PP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
+
+__all__ = [
+    "AXIS_DP", "AXIS_FSDP", "AXIS_PP", "AXIS_TP", "AXIS_SP", "AXIS_EP",
+    "CANONICAL_AXES", "MeshSpec", "make_mesh", "mesh_shape_for",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A validated mesh layout: ordered (axis, size) pairs.
+
+    ``MeshSpec.create(dp=2, tp=4)`` fills unspecified axes with size 1 and
+    orders axes canonically; total size must divide the device count.
+    """
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def create(cls, *, devices_total: Optional[int] = None,
+               **sizes: int) -> "MeshSpec":
+        for name, n in sizes.items():
+            if n < 1:
+                raise ValueError(f"axis {name!r} must have size >= 1, got {n}")
+        ordered: List[Tuple[str, int]] = []
+        for name in CANONICAL_AXES:
+            if name in sizes:
+                ordered.append((name, sizes.pop(name)))
+        # Unknown (user-defined) axes go last, in given order.
+        for name, n in sizes.items():
+            ordered.append((name, n))
+        spec = cls(tuple(ordered))
+        if devices_total is not None:
+            want = spec.total
+            if want > devices_total or devices_total % want:
+                raise ValueError(
+                    f"mesh spec {spec.shape} (total {want}) does not divide "
+                    f"{devices_total} devices")
+        return spec
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self.axes)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def total(self) -> int:
+        return math.prod(n for _, n in self.axes)
+
+
+def mesh_shape_for(n_devices: int,
+                   *,
+                   tp: int = 1,
+                   pp: int = 1,
+                   sp: int = 1,
+                   ep: int = 1,
+                   fsdp: int = 1) -> MeshSpec:
+    """Fill the ``dp`` axis with whatever devices remain after the model axes.
+
+    The default-layout helper: give it the model-parallel degrees and it
+    derives data parallelism, mirroring how ``horovodrun -np N`` derives the
+    world size from host slots (ref: runner/launch.py, hosts.py).
+    """
+    model = tp * pp * sp * ep * fsdp
+    if n_devices % model:
+        raise ValueError(
+            f"model-parallel degree {model} (tp={tp} pp={pp} sp={sp} ep={ep} "
+            f"fsdp={fsdp}) does not divide {n_devices} devices")
+    return MeshSpec.create(dp=n_devices // model, pp=pp, fsdp=fsdp,
+                           ep=ep, sp=sp, tp=tp)
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None,
+              **sizes: int):
+    """Build a ``jax.sharding.Mesh`` from a spec or axis sizes.
+
+    ``make_mesh(dp=2, tp=4)`` → Mesh over the first 8 devices with axes
+    ("dp", "tp") in canonical order.  Uses ``jax.make_mesh`` when laying out
+    over all real devices so XLA can pick a topology-aware device order;
+    falls back to reshaping an explicit device list otherwise.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if spec is None:
+        spec = MeshSpec.create(**sizes)
+    elif sizes:
+        raise TypeError("pass either spec= or axis sizes, not both")
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if spec.total > len(devices):
+        raise ValueError(
+            f"mesh {spec.shape} needs {spec.total} devices, "
+            f"have {len(devices)}")
+    shape = tuple(n for _, n in spec.axes)
+    if len(devices) == spec.total and devices == list(jax.devices()):
+        # Topology-aware layout for the full device set.
+        return jax.make_mesh(shape, spec.names)
+    used = np.asarray(devices[: spec.total], dtype=object).reshape(shape)
+    return Mesh(used, spec.names)

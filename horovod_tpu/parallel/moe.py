@@ -1,0 +1,98 @@
+"""Expert parallelism: switch-style top-1 MoE with alltoall token routing.
+
+The reference exposes the raw alltoall primitive that makes user-level MoE
+possible (ref: operations.cc:1642-1725, ops/collective_operations.h:195
+AlltoallOp) but ships no EP layer (SURVEY.md §2.7).  Here the full dispatch
+→ expert → combine path is provided, TPU-style: static capacity (no dynamic
+shapes for XLA), ``lax.all_to_all`` over the ``ep`` mesh axis riding ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_dispatch_combine", "MoEAux"]
+
+
+class MoEAux(NamedTuple):
+    load_balance_loss: jax.Array   # switch-transformer aux loss (scalar)
+    dropped_fraction: jax.Array    # fraction of tokens over capacity (scalar)
+
+
+def moe_dispatch_combine(tokens: jax.Array,
+                         router_logits: jax.Array,
+                         expert_fn: Callable[[jax.Array], jax.Array],
+                         *,
+                         axis: str = "ep",
+                         experts_per_rank: int = 1,
+                         capacity_factor: float = 1.25) -> Tuple[jax.Array, MoEAux]:
+    """Route each token to its top-1 expert across the ``ep`` axis.
+
+    Must run inside shard_map with ``axis`` bound.  Tokens over a full
+    expert's capacity are dropped (residual passthrough — standard switch
+    behavior).
+
+    Args:
+      tokens: local tokens ``[T, D]``.
+      router_logits: ``[T, E]`` where ``E = ep_size * experts_per_rank``.
+      expert_fn: vmapped-over-experts body ``[E_local, N, D] -> [E_local, N, D]``.
+      capacity_factor: per-expert slots = ceil(T/E * factor).
+
+    Returns (combined ``[T, D]``, MoEAux).
+    """
+    t, d = tokens.shape
+    ep = lax.axis_size(axis)
+    e_total = ep * experts_per_rank
+    if router_logits.shape[-1] != e_total:
+        raise ValueError(
+            f"router logits last dim {router_logits.shape[-1]} != "
+            f"ep*experts_per_rank = {e_total}")
+    cap = max(1, int(-(-t * capacity_factor // e_total)))  # ceil
+
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    one_hot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)   # [T, E]
+    pos = (jnp.cumsum(one_hot, axis=0) - one_hot) * one_hot        # [T, E]
+    pos_in_expert = pos.sum(-1).astype(jnp.int32)                  # [T]
+    kept = pos_in_expert < cap
+
+    # Scatter local tokens into [E, cap, D] dispatch slots.
+    dispatch = jnp.zeros((e_total, cap, d), tokens.dtype)
+    idx_e = jnp.where(kept, expert, 0)
+    idx_c = jnp.where(kept, pos_in_expert, 0)
+    weight = jnp.where(kept, 1.0, 0.0)
+    dispatch = dispatch.at[idx_e, idx_c].add(
+        tokens * weight[:, None].astype(tokens.dtype))
+
+    # [E, cap, D] -> [ep, E_local, cap, D] -> alltoall over ep.
+    dispatch = dispatch.reshape(ep, experts_per_rank, cap, d)
+    recv = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                  # [ep(src), E_l, cap, D]
+    # Fold source-rank dim into the capacity dim for the expert body.
+    recv = recv.transpose(1, 0, 2, 3).reshape(experts_per_rank, ep * cap, d)
+    processed = expert_fn(recv)
+    processed = processed.reshape(experts_per_rank, ep, cap, d).transpose(
+        1, 0, 2, 3)
+    back = lax.all_to_all(processed, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                  # [ep, E_l, cap, D]
+    back = back.reshape(e_total, cap, d)
+
+    # Combine: gather each kept token's slot, weight by its gate.
+    out = back[idx_e, idx_c] * (gate * weight).astype(tokens.dtype)[:, None]
+
+    # Switch-transformer load-balancing loss: E * Σ_e f_e · P_e, where f is
+    # the routed fraction and P the mean router prob — averaged globally.
+    f = one_hot.mean(axis=0)
+    p_mean = probs.mean(axis=0)
+    f = lax.pmean(f, axis)
+    p_mean = lax.pmean(p_mean, axis)
+    aux = MoEAux(
+        load_balance_loss=e_total * jnp.sum(f * p_mean),
+        dropped_fraction=lax.pmean(1.0 - kept.mean(), axis))
+    return out, aux

@@ -1,0 +1,91 @@
+"""Pipeline parallelism: GPipe-style microbatch circulation over a mesh axis.
+
+Substrate beyond reference parity (SURVEY.md §2.7 — the reference has no
+pipeline layer).  TPU-native design: all ``pp`` ranks run the same SPMD
+program; activations hop stage→stage with ``lax.ppermute`` inside a
+``lax.scan`` over clock ticks, so XLA sees one static program and can
+overlap the permute with the next tick's compute.  Differentiable end to
+end — ``jax.grad`` through the scan yields the 1F1B-equivalent backward
+schedule automatically (ppermute transposes to the reverse permute).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pipeline_spmd"]
+
+
+def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                  stage_params: Any,
+                  microbatches: jax.Array,
+                  *,
+                  axis: str = "pp",
+                  broadcast_out: bool = True) -> jax.Array:
+    """Run ``stage_fn`` as one pipeline stage per ``axis`` rank.
+
+    Must be called inside shard_map with ``axis`` bound.  Stage activations
+    must be shape-uniform across stages (do embedding before and the head
+    after the pipeline — replicated over ``pp``).
+
+    Args:
+      stage_fn: ``(params, x) -> y`` mapping one microbatch activation
+        through this rank's stage; same output shape as input.
+      stage_params: this rank's stage parameters (slice the stacked
+        [stages, ...] params over ``pp`` in your in_specs).
+      microbatches: ``[M, mb, ...]`` activations, replicated over ``pp``.
+      broadcast_out: if True, psum-broadcast the last stage's outputs to all
+        ``pp`` ranks so the loss can be computed replicated (simplest
+        composition with dp/tp). If False, non-final ranks return zeros.
+
+    Returns ``[M, mb, ...]`` outputs of the final stage.
+    """
+    p = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    ticks = m + p - 1
+    fwd = [(i, (i + 1) % p) for i in range(p)]
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        mb_idx = t - me                      # microbatch this rank works on
+        active = (mb_idx >= 0) & (mb_idx < m)
+        x0 = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, m - 1), keepdims=False)
+        x_in = jnp.where(me == 0, x0, recv)
+        y = stage_fn(stage_params, x_in)
+        # Zero the bubble so garbage never contaminates grads/outputs.
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        is_last = me == p - 1
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf,
+            jnp.where(active & is_last,
+                      y,
+                      lax.dynamic_index_in_dim(
+                          out_buf, jnp.clip(mb_idx, 0, m - 1),
+                          keepdims=False)),
+            jnp.clip(mb_idx, 0, m - 1), axis=0)
+        recv_next = lax.ppermute(y, axis, fwd)
+        return (recv_next, out_buf), None
+
+    # Initial carries must match the body's varying-manual-axes type
+    # (inputs' vma plus the pipeline axis) for vma stability under scan.
+    want_vma = (set(jax.typeof(microbatches).vma)
+                | {ax for leaf in jax.tree.leaves(stage_params)
+                   for ax in jax.typeof(leaf).vma} | {axis})
+
+    def _varying(x):
+        missing = tuple(want_vma - set(jax.typeof(x).vma))
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    recv0 = _varying(jnp.zeros_like(microbatches[0]))
+    out0 = _varying(jnp.zeros_like(microbatches))
+    (_, out), _ = lax.scan(tick, (recv0, out0), jnp.arange(ticks))
+    if broadcast_out:
+        # Only the last stage wrote non-zeros; psum = broadcast from it.
+        out = lax.psum(jnp.where(me == p - 1, out, jnp.zeros_like(out)), axis)
+    return out

@@ -1,0 +1,129 @@
+"""Ring attention: exact attention over sequence shards via an ICI ring.
+
+Long-context substrate (SURVEY.md §5.7 — absent upstream; the reference's
+only sequence-adjacent primitive is alltoall, operations.cc:1642).  Design
+follows the ring-attention pattern: Q stays put, K/V blocks rotate around
+the ``sp`` mesh axis with ``lax.ppermute`` while each device accumulates
+its block's contribution with flash-style (log-sum-exp) running statistics,
+so per-step memory is O(block) and comm overlaps compute under XLA async
+dispatch.
+
+Must be called inside ``shard_map``/pjit where the ``sp`` axis is bound and
+the sequence dimension of q/k/v is the *local* shard.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_update(q, k, v, acc, row_max, row_sum, mask, scale):
+    """One flash-attention block accumulation step.
+
+    q: [B, Lq, H, D]; k/v: [B, Lk, Hkv, D] (Hkv divides H — expanded here,
+    after the ring transfer, so the ppermute only ever moves the small
+    unexpanded K/V); acc: [B, Lq, H, D]; row_max/row_sum: [B, H, Lq];
+    mask: broadcastable to [B, H, Lq, Lk].
+    """
+    h, kv_heads = q.shape[2], k.shape[2]
+    if h != kv_heads:
+        k = jnp.repeat(k, h // kv_heads, axis=2)
+        v = jnp.repeat(v, h // kv_heads, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, _NEG_INF)
+    new_max = jnp.maximum(row_max, scores.max(axis=-1))
+    # exp() of masked rows would be exp(0)=1 when the whole row is masked
+    # (scores == new_max == -inf); re-mask explicitly.
+    p = jnp.where(mask, jnp.exp(scores - new_max[..., None]), 0.0)
+    correction = jnp.exp(row_max - new_max)
+    acc = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    row_sum = row_sum * correction + p.sum(axis=-1)
+    return acc, new_max, row_sum
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *,
+                   axis: str = "sp",
+                   causal: bool = True,
+                   scale: Optional[float] = None,
+                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Exact (optionally causal) attention over a sequence-sharded ring.
+
+    Args:
+      q, k, v: local shards ``[batch, local_seq, heads, head_dim]``.  MQA/GQA
+        is supported: k/v may have fewer heads as long as q heads divide;
+        the ring only ever transfers the unexpanded K/V.
+      axis: mesh axis name carrying the sequence shards.
+      causal: apply a causal mask using *global* positions.
+      scale: score scale; default ``1/sqrt(head_dim)``.
+      segment_ids: optional ``[batch, local_seq]`` int segment labels for
+        packed sequences; attention is masked to equal segments.  The key
+        side's labels rotate around the ring with K/V.
+
+    Returns ``[batch, local_seq, heads, head_dim]`` in q's dtype.
+    """
+    b, lq, h, d = q.shape
+    if h % k.shape[2]:
+        raise ValueError(
+            f"q heads {h} not divisible by kv heads {k.shape[2]}")
+    if scale is None:
+        scale = d ** -0.5
+    sp = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    lk = k.shape[1]
+
+    q_pos = my * lq + jnp.arange(lq)                      # global q positions
+
+    # Initial accumulators must carry the same varying-manual-axes type the
+    # scan body produces (q/k/v's vma plus the ring axis) so the carry is
+    # type-stable — q may additionally vary over dp/tp axes of the mesh.
+    want_vma = (set(jax.typeof(q).vma) | set(jax.typeof(k).vma)
+                | set(jax.typeof(v).vma) | {axis})
+
+    def _varying(x):
+        missing = tuple(want_vma - set(jax.typeof(x).vma))
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    acc = _varying(jnp.zeros((b, lq, h, d), jnp.float32))
+    row_max = _varying(jnp.full((b, h, lq), _NEG_INF, jnp.float32))
+    row_sum = _varying(jnp.zeros((b, h, lq), jnp.float32))
+    fwd = [(i, (i + 1) % sp) for i in range(sp)]
+    k_seg0 = segment_ids if segment_ids is not None else None
+
+    def step(carry, s):
+        k_blk, v_blk, k_seg, acc, row_max, row_sum = carry
+        # After s rotations the resident block originated at rank (my - s).
+        src = (my - s) % sp
+        k_pos = src * lk + jnp.arange(lk)
+        if causal:
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        else:
+            mask = jnp.ones((1, 1, 1, 1), bool)
+        if k_seg is not None:
+            same = segment_ids[:, :, None] == k_seg[:, None, :]
+            mask = jnp.logical_and(mask, same[:, None, :, :])
+        acc, row_max, row_sum = _block_update(
+            q, k_blk, v_blk, acc, row_max, row_sum, mask, scale)
+        # Rotate K/V (and its segment labels) forward for the next step.
+        k_nxt = lax.ppermute(k_blk, axis, fwd)
+        v_nxt = lax.ppermute(v_blk, axis, fwd)
+        seg_nxt = (lax.ppermute(k_seg, axis, fwd)
+                   if k_seg is not None else None)
+        return (k_nxt, v_nxt, seg_nxt, acc, row_max, row_sum), None
+
+    (_, _, _, acc, _, row_sum), _ = lax.scan(
+        step, (k, v, k_seg0, acc, row_max, row_sum), jnp.arange(sp))
+    out = acc / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

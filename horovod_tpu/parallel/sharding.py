@@ -1,0 +1,105 @@
+"""Logical-axis sharding rules → ``PartitionSpec``s.
+
+The reference has no analog (its only layout concept is one-process-per-GPU
+data parallelism); this is the TPU-native substrate SURVEY.md §2.7 calls for.
+Models name their parameter dimensions with *logical* axes ("embed", "mlp",
+"heads", "batch", "seq", ...) and a rule table maps those to mesh axes —
+the pattern used across public JAX LLM codebases (t5x/flax partitioning).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Sequence, Tuple, Union
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP
+
+__all__ = [
+    "transformer_rules", "logical_to_mesh", "named_sharding", "batch_spec",
+]
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+def transformer_rules(*, fsdp: bool = False) -> Dict[str, MeshAxes]:
+    """Default logical→mesh rules for a Megatron-style transformer.
+
+    * ``embed`` (the model/hidden dim) is replicated across ``tp`` —
+      or sharded over ``fsdp`` when ZeRO-style sharding is on;
+    * ``mlp``/``heads``/``kv`` (the per-layer wide dims) shard over ``tp``;
+    * ``batch`` shards over (dp, fsdp), ``seq`` over ``sp``;
+    * ``experts`` shard over ``ep``; ``stages`` over ``pp``;
+    * ``vocab`` shards over ``tp`` (parallel embedding / logits).
+    """
+    return {
+        "batch": (AXIS_DP, AXIS_FSDP) if fsdp else AXIS_DP,
+        "seq": AXIS_SP,
+        "embed": AXIS_FSDP if fsdp else None,
+        "mlp": AXIS_TP,
+        "heads": AXIS_TP,
+        "kv": None,
+        "vocab": AXIS_TP,
+        "experts": AXIS_EP,
+        "stages": AXIS_PP,
+        "unmodeled": None,
+    }
+
+
+def logical_to_mesh(logical: Sequence[Optional[str]],
+                    rules: Mapping[str, MeshAxes],
+                    mesh: Optional[Mesh] = None) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec.
+
+    Mesh axes not present in ``mesh`` (or of size 1) are dropped so one rule
+    table works across mesh shapes — e.g. the same model runs pure-DP or
+    DP×TP without edits.  A mesh axis may be consumed at most once.
+    """
+    present = dict(mesh.shape) if mesh is not None else None
+    used = set()
+    out = []
+    for name in logical:
+        axes = rules.get(name) if name is not None else None
+        if axes is None:
+            out.append(None)
+            continue
+        if isinstance(axes, str):
+            axes = (axes,)
+        kept = []
+        for ax in axes:
+            if present is not None and present.get(ax, 1) <= 1:
+                continue
+            if ax in used:
+                raise ValueError(
+                    f"mesh axis {ax!r} consumed twice in logical spec "
+                    f"{tuple(logical)}")
+            used.add(ax)
+            kept.append(ax)
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def named_sharding(mesh: Mesh, logical: Sequence[Optional[str]],
+                   rules: Optional[Mapping[str, MeshAxes]] = None
+                   ) -> NamedSharding:
+    """Convenience: ``NamedSharding`` for a logical spec under ``rules``."""
+    if rules is None:
+        rules = transformer_rules()
+    return NamedSharding(mesh, logical_to_mesh(logical, rules, mesh))
+
+
+def batch_spec(mesh: Optional[Mesh] = None, *, seq_sharded: bool = False,
+               rules: Optional[Mapping[str, MeshAxes]] = None
+               ) -> PartitionSpec:
+    """PartitionSpec for an input batch [batch, seq, ...]."""
+    if rules is None:
+        rules = transformer_rules()
+    logical = ("batch", "seq" if seq_sharded else None)
+    return logical_to_mesh(logical, rules, mesh)

@@ -1,0 +1,26 @@
+"""Model zoo for benchmarks, examples, and the driver's flagship entry.
+
+The reference ships its models as examples (ref: examples/pytorch/
+pytorch_synthetic_benchmark.py — torchvision resnet50;
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py — Keras ResNet50;
+examples/pytorch/pytorch_mnist.py).  Here the models are first-class,
+pure-JAX pytree models designed to compose with the parallelism substrate
+(``horovod_tpu.parallel``): logical-axis annotations per parameter, ring
+attention over ``sp``, MoE over ``ep``, pipeline stacking over ``pp``.
+"""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    transformer_init,
+    transformer_apply,
+    transformer_loss,
+    transformer_logical_axes,
+    transformer_flops_per_token,
+)
+from .resnet import (  # noqa: F401
+    ResNetConfig,
+    resnet50_init,
+    resnet_apply,
+    resnet_loss,
+)
+from .mlp import mlp_init, mlp_apply, mlp_loss  # noqa: F401

@@ -1,0 +1,39 @@
+"""Small MLP classifier — the MNIST-class example model.
+
+Equivalent of the reference's MNIST examples used as CI smoke tests
+(ref: examples/pytorch/pytorch_mnist.py, .buildkite/gen-pipeline.sh:157-189
+— SURVEY.md §4 tier 4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mlp_init", "mlp_apply", "mlp_loss"]
+
+
+def mlp_init(key: jax.Array, sizes: Sequence[int] = (784, 256, 128, 10)
+             ) -> Dict:
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jax.random.normal(keys[i], (a, b)) * (a ** -0.5)
+        params[f"b{i}"] = jnp.zeros((b,))
+    return params
+
+
+def mlp_apply(params: Dict, x: jax.Array) -> jax.Array:
+    n = len(params) // 2
+    for i in range(n):
+        x = x @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params: Dict, x: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(mlp_apply(params, x), -1)
+    return -jnp.take_along_axis(logp, labels[:, None], -1).mean()

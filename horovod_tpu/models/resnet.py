@@ -1,0 +1,180 @@
+"""ResNet-50 (v1.5), pure-JAX pytree implementation.
+
+The reference benchmarks Horovod with torchvision/Keras ResNet-50
+(ref: examples/pytorch/pytorch_synthetic_benchmark.py:17-26,
+examples/tensorflow2/tensorflow2_synthetic_benchmark.py; docs/benchmarks.rst
+headline numbers — SURVEY.md §6).  This is the equivalent model for this
+framework's synthetic benchmark and scaling-efficiency harness (bench.py).
+
+TPU-first choices: NHWC layout (XLA-TPU native), bf16 compute with f32
+batch-norm statistics, ``(params, batch_stats)`` as explicit pytrees so
+the train step is a pure function.  Cross-replica BN is available via
+``horovod_tpu.sync_batch_norm`` semantics: pass ``bn_axis`` to average
+batch statistics over the data-parallel mesh axis (the reference's
+SyncBatchNorm, ref: torch/sync_batch_norm.py:1-218).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["ResNetConfig", "resnet50_init", "resnet_apply", "resnet_loss"]
+
+# Stage layout for ResNet-50: (blocks, mid-channels) per stage.
+_R50_STAGES = ((3, 64), (4, 128), (6, 256), (3, 512))
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+    bn_axis: Optional[str] = None   # mesh axis for cross-replica SyncBN
+
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout))
+            * np.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_stats(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def resnet50_init(key: jax.Array, cfg: ResNetConfig
+                  ) -> Tuple[Dict, Dict]:
+    """Returns (params, batch_stats)."""
+    pd = cfg.param_dtype
+    n_blocks = sum(b for b, _ in _R50_STAGES)
+    keys = iter(jax.random.split(key, 4 + n_blocks * 4))
+    params: Dict = {"conv_stem": _conv_init(next(keys), 7, 7, 3, 64, pd),
+                    "bn_stem": _bn_init(64, pd)}
+    stats: Dict = {"bn_stem": _bn_stats(64)}
+    cin = 64
+    for si, (blocks, mid) in enumerate(_R50_STAGES):
+        cout = mid * 4
+        for bi in range(blocks):
+            name = f"s{si}b{bi}"
+            p = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid, pd),
+                "bn1": _bn_init(mid, pd),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid, pd),
+                "bn2": _bn_init(mid, pd),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout, pd),
+                "bn3": _bn_init(cout, pd),
+            }
+            s = {"bn1": _bn_stats(mid), "bn2": _bn_stats(mid),
+                 "bn3": _bn_stats(cout)}
+            if bi == 0:
+                p["conv_proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+                p["bn_proj"] = _bn_init(cout, pd)
+                s["bn_proj"] = _bn_stats(cout)
+            params[name] = p
+            stats[name] = s
+            cin = cout
+    params["fc_w"] = (jax.random.normal(next(keys), (cin, cfg.num_classes))
+                      * (cin ** -0.5)).astype(pd)
+    params["fc_b"] = jnp.zeros((cfg.num_classes,), pd)
+    return params, stats
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
+    """Returns (y, new_stats). In training mode uses batch statistics
+    (optionally averaged over ``cfg.bn_axis`` — SyncBatchNorm) and
+    EMA-updates the running stats."""
+    if train:
+        xf = x.astype(jnp.float32)
+        axes = (0, 1, 2)
+        mean = xf.mean(axes)
+        var = (xf ** 2).mean(axes) - mean ** 2
+        if cfg.bn_axis is not None:
+            mean = lax.pmean(mean, cfg.bn_axis)
+            var = lax.pmean(var, cfg.bn_axis)   # E[x²]−E[x]² form averages
+        m = cfg.bn_momentum
+        new_s = {"mean": m * s["mean"] + (1 - m) * mean,
+                 "var": m * s["var"] + (1 - m) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = lax.rsqrt(var + cfg.bn_eps)
+    y = (x.astype(jnp.float32) - mean) * inv
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new_s
+
+
+def _bottleneck(x, p, s, cfg, train, stride):
+    out_s = {}
+    y, out_s["bn1"] = _batch_norm(_conv(x, p["conv1"]), p["bn1"], s["bn1"],
+                                  cfg, train)
+    y = jax.nn.relu(y)
+    # v1.5: stride lives on the 3x3 conv.
+    y, out_s["bn2"] = _batch_norm(_conv(y, p["conv2"], stride), p["bn2"],
+                                  s["bn2"], cfg, train)
+    y = jax.nn.relu(y)
+    y, out_s["bn3"] = _batch_norm(_conv(y, p["conv3"]), p["bn3"], s["bn3"],
+                                  cfg, train)
+    if "conv_proj" in p:
+        sc, out_s["bn_proj"] = _batch_norm(
+            _conv(x, p["conv_proj"], stride), p["bn_proj"], s["bn_proj"],
+            cfg, train)
+    else:
+        sc = x
+    return jax.nn.relu(y + sc), out_s
+
+
+def resnet_apply(params: Dict, batch_stats: Dict, images: jax.Array,
+                 cfg: ResNetConfig, train: bool = True
+                 ) -> Tuple[jax.Array, Dict]:
+    """images: [N, H, W, 3] → (logits [N, classes], new_batch_stats)."""
+    x = images.astype(cfg.dtype)
+    new_stats: Dict = {}
+    x = lax.conv_general_dilated(
+        x, params["conv_stem"].astype(x.dtype), (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    x, new_stats["bn_stem"] = _batch_norm(
+        x, params["bn_stem"], batch_stats["bn_stem"], cfg, train)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    for si, (blocks, _) in enumerate(_R50_STAGES):
+        for bi in range(blocks):
+            name = f"s{si}b{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            x, new_stats[name] = _bottleneck(
+                x, params[name], batch_stats[name], cfg, train, stride)
+    x = x.mean(axis=(1, 2)).astype(jnp.float32)
+    logits = x @ params["fc_w"].astype(jnp.float32) + params["fc_b"].astype(
+        jnp.float32)
+    return logits, new_stats
+
+
+def resnet_loss(params: Dict, batch_stats: Dict, images: jax.Array,
+                labels: jax.Array, cfg: ResNetConfig
+                ) -> Tuple[jax.Array, Dict]:
+    """Cross-entropy loss; returns (loss, new_batch_stats) for
+    ``jax.value_and_grad(..., has_aux=True)``."""
+    logits, new_stats = resnet_apply(params, batch_stats, images, cfg, True)
+    logp = jax.nn.log_softmax(logits, -1)
+    loss = -jnp.take_along_axis(logp, labels[:, None], -1).mean()
+    return loss, new_stats

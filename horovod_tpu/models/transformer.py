@@ -1,0 +1,309 @@
+"""Flagship decoder-only Transformer LM, built for the 5-axis mesh.
+
+TPU-first design decisions:
+
+* **bf16 compute, f32 params/accumulation** — MXU-native (SURVEY.md §6's
+  per-chip throughput target is set by MXU utilization).
+* **RoPE** instead of learned positions — no position table to shard.
+* **Scan over layers** — one compiled block body regardless of depth
+  (compile time O(1) in layers), standard XLA practice.
+* **Hybrid parallelism**: dp/fsdp/tp are expressed with logical-axis
+  sharding rules (GSPMD auto-partitioning inserts the collectives); sp
+  (ring attention) and ep (MoE alltoall) are manual ``shard_map`` islands;
+  pp wraps the block stack in ``pipeline_spmd``.
+
+The reference has no model layer — its examples lean on torchvision/Keras
+(ref: examples/pytorch/pytorch_synthetic_benchmark.py:17-26).  This module
+is the equivalent benchmark substrate plus the TP/SP/PP/EP showcase the
+reference lacks (SURVEY.md §2.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..parallel.moe import moe_dispatch_combine
+from ..parallel.pipeline import pipeline_spmd
+from ..parallel.ring_attention import ring_attention
+
+__all__ = [
+    "TransformerConfig", "transformer_init", "transformer_apply",
+    "transformer_loss", "transformer_logical_axes",
+    "transformer_flops_per_token",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    layers: int = 4
+    d_model: int = 512
+    heads: int = 8
+    kv_heads: int = 8            # < heads ⇒ GQA
+    d_ff: int = 2048
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16    # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    # MoE: num_experts == 0 ⇒ dense MLP.  Every block is MoE when on
+    # (simplest uniform scan body; interleaving is a config refinement).
+    num_experts: int = 0
+    capacity_factor: float = 1.25
+    # Parallel degrees the *model code* must know about (mesh axes the
+    # forward pass opens manual islands for); dp/fsdp/tp stay automatic.
+    sp: int = 1                  # sequence-parallel degree (ring attention)
+    ep: int = 1                  # expert-parallel degree
+    pp: int = 1                  # pipeline stages (layers % pp == 0)
+    remat: bool = False          # jax.checkpoint each block
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.heads
+
+    @property
+    def layers_per_stage(self) -> int:
+        assert self.layers % max(self.pp, 1) == 0
+        return self.layers // max(self.pp, 1)
+
+
+def _init_linear(key, fan_in, shape, dtype):
+    return (jax.random.normal(key, shape) * (fan_in ** -0.5)).astype(dtype)
+
+
+def transformer_init(key: jax.Array, cfg: TransformerConfig) -> Dict:
+    """Parameter pytree. Block params are stacked [layers, ...] for scan;
+    under pp they are reshaped to [pp, layers_per_stage, ...] at apply time
+    (same memory layout, stage-major)."""
+    keys = jax.random.split(key, 8)
+    d, h, hk, dh, f = (cfg.d_model, cfg.heads, cfg.kv_heads, cfg.head_dim,
+                       cfg.d_ff)
+    L = cfg.layers
+    pd = cfg.param_dtype
+
+    def stack(initfn, subkey):
+        return jnp.stack([initfn(k) for k in jax.random.split(subkey, L)])
+
+    block = {
+        "ln1": jnp.ones((L, d), pd),
+        "ln2": jnp.ones((L, d), pd),
+        "wq": stack(lambda k: _init_linear(k, d, (d, h * dh), pd), keys[1]),
+        "wk": stack(lambda k: _init_linear(k, d, (d, hk * dh), pd), keys[2]),
+        "wv": stack(lambda k: _init_linear(k, d, (d, hk * dh), pd), keys[3]),
+        "wo": stack(lambda k: _init_linear(k, h * dh, (h * dh, d), pd),
+                    keys[4]),
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        block["w_router"] = stack(
+            lambda k: _init_linear(k, d, (d, e), pd), keys[5])
+        block["w_up"] = stack(
+            lambda k: _init_linear(k, d, (e, d, f), pd), keys[6])
+        block["w_down"] = stack(
+            lambda k: _init_linear(k, f, (e, f, d), pd), keys[7])
+    else:
+        block["w_up"] = stack(lambda k: _init_linear(k, d, (d, f), pd),
+                              keys[5])
+        block["w_gate"] = stack(lambda k: _init_linear(k, d, (d, f), pd),
+                                keys[6])
+        block["w_down"] = stack(lambda k: _init_linear(k, f, (f, d), pd),
+                                keys[7])
+    return {
+        "embed": (jax.random.normal(keys[0], (cfg.vocab, d)) * 0.02
+                  ).astype(pd),
+        "ln_f": jnp.ones((d,), pd),
+        "block": block,
+    }
+
+
+def transformer_logical_axes(cfg: TransformerConfig) -> Dict:
+    """Same-structure pytree of logical axis names (None = replicated dim)
+    for ``parallel.sharding.logical_to_mesh``. Leading stacked-layers dim
+    maps to "stages" so pp shards it when the mesh has a pp axis."""
+    block = {
+        "ln1": ("stages", None),
+        "ln2": ("stages", None),
+        "wq": ("stages", "embed", "heads"),
+        "wk": ("stages", "embed", "kv"),
+        "wv": ("stages", "embed", "kv"),
+        "wo": ("stages", "heads", "embed"),
+    }
+    if cfg.num_experts:
+        block["w_router"] = ("stages", "embed", None)
+        block["w_up"] = ("stages", "experts", "embed", "mlp")
+        block["w_down"] = ("stages", "experts", "mlp", "embed")
+    else:
+        block["w_up"] = ("stages", "embed", "mlp")
+        block["w_gate"] = ("stages", "embed", "mlp")
+        block["w_down"] = ("stages", "mlp", "embed")
+    return {"embed": ("vocab", "embed"), "ln_f": (None,), "block": block}
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), -1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(
+        x.dtype) * g.astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    """x: [B, L, H, D]; positions: [B, L] global token positions."""
+    d2 = x.shape[-1] // 2
+    freqs = (1.0 / theta) ** (jnp.arange(d2, dtype=jnp.float32) / d2)
+    ang = positions[..., None].astype(jnp.float32) * freqs   # [B, L, d2]
+    cos, sin = jnp.cos(ang)[..., None, :], jnp.sin(ang)[..., None, :]
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def _attention(p, x, positions, cfg: TransformerConfig):
+    b, l, d = x.shape
+    h, hk, dh = cfg.heads, cfg.kv_heads, cfg.head_dim
+    q = (x @ p["wq"].astype(x.dtype)).reshape(b, l, h, dh)
+    k = (x @ p["wk"].astype(x.dtype)).reshape(b, l, hk, dh)
+    v = (x @ p["wv"].astype(x.dtype)).reshape(b, l, hk, dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if cfg.sp > 1:
+        # Manual island: the sequence dim is the local sp shard here (the
+        # caller's shard_map over {'sp'} has already split it).
+        o = ring_attention(q, k, v, axis="sp", causal=True)
+    else:
+        scale = dh ** -0.5
+        if h != hk:
+            k = jnp.repeat(k, h // hk, axis=2)
+            v = jnp.repeat(v, h // hk, axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((l, l), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", w, v)
+    return o.reshape(b, l, h * dh) @ p["wo"].astype(x.dtype)
+
+
+def _mlp(p, x):
+    up = x @ p["w_up"].astype(x.dtype)
+    gate = jax.nn.silu(x @ p["w_gate"].astype(x.dtype))
+    return (up * gate) @ p["w_down"].astype(x.dtype)
+
+
+def _moe_mlp(p, x, cfg: TransformerConfig):
+    b, l, d = x.shape
+    tokens = x.reshape(b * l, d)
+    logits = tokens @ p["w_router"].astype(x.dtype)
+    w_up, w_down = p["w_up"].astype(x.dtype), p["w_down"].astype(x.dtype)
+    if cfg.ep > 1:
+        # w_up/w_down enter the island sharded over ep on the expert dim.
+        def expert_fn(toks):   # [E_local, N, D]
+            hmid = jax.nn.silu(jnp.einsum("end,edf->enf", toks, w_up))
+            return jnp.einsum("enf,efd->end", hmid, w_down)
+        out, aux = moe_dispatch_combine(
+            tokens, logits, expert_fn, axis="ep",
+            experts_per_rank=cfg.num_experts // cfg.ep,
+            capacity_factor=cfg.capacity_factor)
+    else:
+        # Dense (einsum-over-experts) fallback: exact, no capacity drops.
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        top = jnp.argmax(probs, -1)
+        gate = jnp.take_along_axis(probs, top[:, None], 1)[:, 0]
+        hmid = jax.nn.silu(jnp.einsum("nd,edf->enf", tokens, w_up))
+        all_out = jnp.einsum("enf,efd->end", hmid, w_down)
+        sel = jnp.take_along_axis(
+            all_out, top[None, :, None], 0)[0]
+        out = sel * gate[:, None].astype(x.dtype)
+        aux = None
+    return out.reshape(b, l, d), aux
+
+
+def _block(p, x, positions, cfg: TransformerConfig):
+    x = x + _attention(p, _rmsnorm(x, p["ln1"]), positions, cfg)
+    if cfg.num_experts:
+        y, _ = _moe_mlp(p, _rmsnorm(x, p["ln2"]), cfg)
+    else:
+        y = _mlp(p, _rmsnorm(x, p["ln2"]))
+    return x + y
+
+
+def _scan_blocks(block_params, x, positions, cfg: TransformerConfig):
+    body = functools.partial(_block, positions=positions, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def step(h, layer_p):
+        return body(layer_p, h), None
+
+    out, _ = lax.scan(step, x, block_params)
+    return out
+
+
+def transformer_apply(params: Dict, tokens: jax.Array,
+                      cfg: TransformerConfig) -> jax.Array:
+    """Logits for next-token prediction.
+
+    tokens: [batch, seq] int32 — the *local* sp shard of the sequence when
+    called inside a shard_map over {'sp'} (positions are globalized with
+    the sp rank), the full sequence otherwise.
+    """
+    b, l = tokens.shape
+    if cfg.sp > 1:
+        offset = lax.axis_index("sp") * l
+    else:
+        offset = 0
+    positions = offset + jnp.broadcast_to(jnp.arange(l), (b, l))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    # Manual-island axes make activations varying (e.g. the MoE alltoall);
+    # pre-cast so the scan-over-layers carry is type-stable under vma.
+    manual_axes = [ax for ax, on in (("sp", cfg.sp > 1),
+                                     ("ep", cfg.ep > 1 and cfg.num_experts))
+                   if on]
+    missing = tuple(set(manual_axes) - set(jax.typeof(x).vma))
+    if missing:
+        x = lax.pcast(x, missing, to="varying")
+    if cfg.pp > 1:
+        # Inside a shard_map over {'pp'} the stacked-layers dim of the
+        # block params is the sharded "stages" logical axis, so the local
+        # slice is already this rank's [layers_per_stage, ...] stage.
+        # Microbatch over batch dim with M = pp (minimum schedule).
+        m = cfg.pp
+        assert b % m == 0, f"batch {b} not divisible by pp {cfg.pp}"
+        mb = b // m
+        acts = x.reshape(m, mb, l, cfg.d_model)
+        pos_mb = positions.reshape(m, mb, l)
+
+        def stage_fn(stage_p, a):
+            # positions are identical across microbatches in this layout
+            return _scan_blocks(stage_p, a, pos_mb[0], cfg)
+
+        x = pipeline_spmd(stage_fn, params["block"], acts, axis="pp")
+        x = x.reshape(b, l, cfg.d_model)
+    else:
+        x = _scan_blocks(params["block"], x, positions, cfg)
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
+
+
+def transformer_loss(params: Dict, tokens: jax.Array,
+                     cfg: TransformerConfig) -> jax.Array:
+    """Causal LM loss (next-token cross entropy) over the local shard."""
+    logits = transformer_apply(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, -1)
+    ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    return -ll.mean()
+
+
+def transformer_flops_per_token(cfg: TransformerConfig) -> float:
+    """Approximate forward-pass matmul FLOPs per token (for MFU metrics)."""
+    d, f, l = cfg.d_model, cfg.d_ff, cfg.layers
+    h, hk, dh = cfg.heads, cfg.kv_heads, cfg.head_dim
+    attn_proj = 2 * d * (h * dh + 2 * hk * dh + h * dh)
+    attn_scores = 2 * 2 * cfg.max_seq * h * dh          # per token, approx
+    mlp = 2 * d * f * (3 if not cfg.num_experts else 2)
+    return l * (attn_proj + attn_scores + mlp) + 2 * d * cfg.vocab

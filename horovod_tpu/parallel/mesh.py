@@ -107,6 +107,8 @@ def mesh_shape_for(n_devices: int,
 
 def make_mesh(spec: Optional[MeshSpec] = None,
               devices: Optional[Sequence] = None,
+              *,
+              explicit_sharding: bool = False,
               **sizes: int):
     """Build a ``jax.sharding.Mesh`` from a spec or axis sizes.
 
@@ -130,8 +132,15 @@ def make_mesh(spec: Optional[MeshSpec] = None,
             f"mesh {spec.shape} needs {spec.total} devices, "
             f"have {len(devices)}")
     shape = tuple(n for _, n in spec.axes)
+    # Auto axes = classic GSPMD propagation: plain model code works and the
+    # partitioner inserts collectives.  Explicit (sharding-in-types) mode is
+    # opt-in for users who want shardings checked in the type system.
+    from jax.sharding import AxisType
+
+    kind = AxisType.Explicit if explicit_sharding else AxisType.Auto
+    axis_types = (kind,) * len(shape)
     if len(devices) == spec.total and devices == list(jax.devices()):
         # Topology-aware layout for the full device set.
-        return jax.make_mesh(shape, spec.names)
+        return jax.make_mesh(shape, spec.names, axis_types=axis_types)
     used = np.asarray(devices[: spec.total], dtype=object).reshape(shape)
-    return Mesh(used, spec.names)
+    return Mesh(used, spec.names, axis_types=axis_types)

@@ -39,7 +39,11 @@ def transformer_rules(*, fsdp: bool = False) -> Dict[str, MeshAxes]:
         "mlp": AXIS_TP,
         "heads": AXIS_TP,
         "kv": None,
-        "vocab": AXIS_TP,
+        # Vocab stays replicated: a tp-sharded embedding makes the token
+        # gather's output sharding ambiguous under sharding-in-types, and
+        # the per-layer dims already carry the tp FLOPs.  (Megatron-style
+        # vocab-parallel embedding = future refinement via one-hot matmul.)
+        "vocab": None,
         "experts": AXIS_EP,
         "stages": AXIS_PP,
         "unmodeled": None,

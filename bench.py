@@ -1,0 +1,96 @@
+"""Synthetic ResNet-50 training benchmark — the reference's headline harness.
+
+Equivalent of ref: examples/pytorch/pytorch_synthetic_benchmark.py (ResNet-50,
+bs=32, images/sec; SURVEY.md §6) re-built TPU-native: bf16 compute, NHWC,
+jitted train step with donated params, synthetic ImageNet-shaped data.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline: the reference's only published per-device synthetic number —
+1656.82 images/sec over 16 P100s (ResNet-101, docs/benchmarks.rst:27-43) =
+103.55 images/sec/device.  vs_baseline = value / 103.55.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_S_PER_DEVICE = 1656.82 / 16.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-iters", type=int, default=5)
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--num-warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import ResNetConfig, resnet50_init, resnet_loss
+
+    dev = jax.devices()[0]
+    print(f"benchmarking on {dev.platform}:{dev.device_kind}",
+          file=sys.stderr)
+
+    cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16)
+    params, stats = resnet50_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.01, momentum=0.9)
+    opt_state = opt.init(params)
+
+    images = jax.random.normal(
+        jax.random.PRNGKey(1),
+        (args.batch_size, args.image_size, args.image_size, 3), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (args.batch_size,),
+                                0, 1000)
+
+    @jax.jit
+    def step(params, stats, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            resnet_loss, has_aux=True)(params, stats, images, labels, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_warmup):
+        params, stats, opt_state, loss = step(params, stats, opt_state,
+                                              images, labels)
+    jax.block_until_ready(params)
+    print(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    rates = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, stats, opt_state, loss = step(params, stats, opt_state,
+                                                  images, labels)
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        rates.append(args.batch_size * args.num_batches_per_iter / dt)
+
+    import numpy as np
+
+    value = float(np.mean(rates))
+    print(f"img/sec per iter: {[round(r, 1) for r in rates]} "
+          f"(+-{float(np.std(rates)):.1f}); final loss {float(loss):.3f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / BASELINE_IMG_S_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

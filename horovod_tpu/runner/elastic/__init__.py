@@ -1,0 +1,13 @@
+"""Elastic launcher: discovery, driver, worker registration.
+
+Re-conception of ref: runner/elastic/ (driver.py, discovery.py,
+registration.py, worker.py — SURVEY.md §2.5, §3.4, §5.3) for preemptible
+TPU VMs: the driver discovers hosts with a user script, recomputes slot
+assignments on change, publishes them to the rendezvous KV with a bumped
+version, and workers re-rendezvous (re-initialize JAX distributed) around
+the in-training State commit/restore machine (horovod_tpu.elastic).
+"""
+
+from .discovery import HostManager, HostState  # noqa: F401
+from .driver import ElasticDriver, run_elastic  # noqa: F401
+from .registration import WorkerStateRegistry  # noqa: F401

@@ -1,0 +1,107 @@
+"""Host discovery + blacklisting for elastic mode.
+
+Re-conception of ref: runner/elastic/discovery.py:1-186 (HostManager,
+HostDiscoveryScript, blacklisting).  The discovery source is a user
+executable printing one "host[:slots]" line per available host — on TPU
+this typically wraps ``gcloud compute tpus tpu-vm list`` or a queued
+-resource poll.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..hosts import HostInfo
+
+__all__ = ["HostState", "HostManager", "DiscoveredHosts"]
+
+
+class HostState:
+    """Per-host blacklist state (ref: discovery.py HostState)."""
+
+    def __init__(self) -> None:
+        self._blacklisted = False
+        self._lock = threading.Lock()
+
+    def blacklist(self) -> None:
+        with self._lock:
+            self._blacklisted = True
+
+    @property
+    def is_blacklisted(self) -> bool:
+        with self._lock:
+            return self._blacklisted
+
+
+class DiscoveredHosts:
+    """Immutable snapshot of discovery output minus blacklisted hosts."""
+
+    def __init__(self, hosts: List[HostInfo]):
+        self.hosts = hosts
+
+    @property
+    def available_slots(self) -> int:
+        return sum(h.slots for h in self.hosts)
+
+    def host_names(self) -> List[str]:
+        return [h.hostname for h in self.hosts]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, DiscoveredHosts) and \
+            self.hosts == other.hosts
+
+    def __repr__(self) -> str:
+        return f"DiscoveredHosts({self.hosts})"
+
+
+class HostManager:
+    """Runs the discovery function, applies the blacklist, reports diffs
+    (ref: discovery.py HostManager.update_available_hosts)."""
+
+    def __init__(self, discover: Callable[[], List[HostInfo]],
+                 default_slots: int = 1):
+        self._discover = discover
+        self._default_slots = default_slots
+        self._states: Dict[str, HostState] = {}
+        self.current = DiscoveredHosts([])
+
+    @classmethod
+    def from_script(cls, script: str, default_slots: int = 1
+                    ) -> "HostManager":
+        def discover() -> List[HostInfo]:
+            out = subprocess.run(
+                script, shell=True, capture_output=True, text=True,
+                timeout=60)
+            if out.returncode != 0:
+                raise RuntimeError(
+                    f"discovery script failed ({out.returncode}): "
+                    f"{out.stderr.strip()}")
+            hosts = []
+            for line in out.stdout.splitlines():
+                line = line.strip()
+                if line:
+                    h = HostInfo.from_string(line)
+                    if h.slots == 1 and ":" not in line:
+                        h = HostInfo(h.hostname, default_slots)
+                    hosts.append(h)
+            return hosts
+        return cls(discover, default_slots)
+
+    def blacklist(self, hostname: str) -> None:
+        self._states.setdefault(hostname, HostState()).blacklist()
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        st = self._states.get(hostname)
+        return st is not None and st.is_blacklisted
+
+    def update_available_hosts(self) -> bool:
+        """Re-run discovery; returns True if the usable host set changed."""
+        raw = self._discover()
+        usable = [h for h in raw if not self.is_blacklisted(h.hostname)]
+        snapshot = DiscoveredHosts(usable)
+        changed = snapshot != self.current
+        self.current = snapshot
+        return changed

@@ -1,0 +1,302 @@
+"""Elastic driver: discovery loop, slot reassignment, worker lifecycle.
+
+Re-conception of ref: runner/elastic/driver.py:1-314 (ElasticDriver:
+discovery thread :181, host-assignment update + worker notify :203-265,
+worker spawn :277, exit handling :297).  Differences for TPU: worker
+notification rides the rendezvous KV (workers poll a version key at
+commit points) instead of a per-worker RPC service, and re-rendezvous
+re-initializes the JAX coordination service rather than re-bootstrapping
+Gloo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import socket
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import hosts as hosts_mod
+from ..http_kv import RendezvousServer, new_secret
+from ..safe_shell_exec import safe_execute
+from .discovery import HostManager
+from .registration import WorkerStateRegistry, READY, SUCCESS, FAILURE
+
+__all__ = ["ElasticDriver", "run_elastic"]
+
+_DISCOVERY_INTERVAL_S = 1.0
+
+
+@dataclasses.dataclass
+class _WorkerProc:
+    slot: hosts_mod.SlotInfo
+    thread: threading.Thread
+    generation: int
+
+
+class ElasticDriver:
+    """Drives elastic worker generations.
+
+    ``spawn_fn(slot, generation)`` starts one worker and returns when it
+    exits, reporting the exit code — injectable so unit tests can fake
+    whole clusters (ref test strategy: test/single/test_elastic_driver.py,
+    SURVEY.md §4 tier 2).
+    """
+
+    def __init__(self,
+                 host_manager: HostManager,
+                 min_np: int,
+                 max_np: Optional[int] = None,
+                 spawn_fn: Optional[Callable[..., int]] = None,
+                 reset_limit: Optional[int] = None,
+                 discovery_interval: float = _DISCOVERY_INTERVAL_S,
+                 kv_server: Optional[RendezvousServer] = None):
+        self._hm = host_manager
+        self._kv = kv_server
+        self._min_np = min_np
+        self._max_np = max_np or min_np
+        self._spawn_fn = spawn_fn or (lambda slot, gen: 0)
+        self._interval = discovery_interval
+        self.registry = WorkerStateRegistry(self._on_barrier,
+                                            reset_limit=reset_limit)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._generation = 0
+        self._assignments: List[hosts_mod.SlotInfo] = []
+        self._workers: Dict[int, _WorkerProc] = {}
+        self._shutdown = threading.Event()
+        self._result: Optional[int] = None
+        self._discovery_thread: Optional[threading.Thread] = None
+        self._rendezvous_cb: Optional[Callable[[List[hosts_mod.SlotInfo],
+                                                int], None]] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, rendezvous_cb=None) -> None:
+        """rendezvous_cb(assignments, generation) publishes the new cluster
+        spec (KV) before workers of that generation spawn."""
+        self._rendezvous_cb = rendezvous_cb
+        self._hm.update_available_hosts()
+        self._discovery_thread = threading.Thread(
+            target=self._discovery_loop, daemon=True, name="hvdt-elastic")
+        self._discovery_thread.start()
+        self._rendezvous()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Block until the job finishes; returns the exit code."""
+        deadline = (time.monotonic() + timeout) if timeout else None
+        with self._cond:
+            while self._result is None and not self._shutdown.is_set():
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cond.wait(remaining if remaining else 1.0)
+            return self._result
+
+    # -- discovery ---------------------------------------------------------
+
+    def _discovery_loop(self) -> None:
+        while not self._shutdown.wait(self._interval):
+            try:
+                changed = self._hm.update_available_hosts()
+            except Exception as e:   # discovery scripts may flake
+                print(f"elastic: discovery failed: {e}", file=sys.stderr)
+                continue
+            if changed:
+                self._notify_hosts_updated()
+            self._poll_worker_registry()
+
+    def _poll_worker_registry(self) -> None:
+        """Feed KV-reported worker states (workers put
+        /registry/<generation>/<rank> = READY|SUCCESS|FAILURE at commit
+        points — the KV replaces the reference's in-worker RPC listener,
+        ref: runner/elastic/worker.py WorkerNotificationService)."""
+        if self._kv is None:
+            return
+        gen = self.generation
+        prefix = f"/registry/{gen}/"
+        with self._kv.lock:
+            items = {k: v for k, v in self._kv.store.items()
+                     if k.startswith(prefix)}
+        for key, val in items.items():
+            try:
+                rank = int(key.rsplit("/", 1)[1])
+            except ValueError:
+                continue
+            state = val.decode()
+            if state == READY:
+                self.registry.record_ready(rank)
+            elif state == SUCCESS:
+                self.registry.record_success(rank)
+            elif state == FAILURE:
+                self.registry.record_failure(rank)
+
+    def record_ready(self, rank: int) -> None:
+        """A live worker requests re-rendezvous (HostsUpdatedInterrupt or
+        collective failure recovery in its training loop)."""
+        self.registry.record_ready(rank)
+
+    def _notify_hosts_updated(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait_for_available_slots(self, min_np: int,
+                                 timeout: float = 600.0) -> None:
+        """(ref: driver.py:145) block until discovery reports >= min_np."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._hm.current.available_slots < min_np:
+                if self._shutdown.is_set():
+                    raise RuntimeError("driver shut down while waiting")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out waiting for {min_np} slots; discovered "
+                        f"{self._hm.current.available_slots}")
+                self._cond.wait(min(remaining, self._interval))
+
+    # -- rendezvous / spawn ------------------------------------------------
+
+    def _rendezvous(self) -> None:
+        self.wait_for_available_slots(self._min_np)
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+            self._assignments = hosts_mod.get_host_assignments(
+                self._hm.current.hosts, self._min_np, self._max_np)
+            self.registry.reset(len(self._assignments))
+        if self._rendezvous_cb:
+            self._rendezvous_cb(self._assignments, gen)
+        for slot in self._assignments:
+            self._start_worker(slot, gen)
+
+    def _start_worker(self, slot: hosts_mod.SlotInfo, gen: int) -> None:
+        def _run():
+            try:
+                code = self._spawn_fn(slot, gen)
+            except Exception as e:
+                print(f"elastic: worker {slot.rank} spawn error: {e}",
+                      file=sys.stderr)
+                code = 1
+            self.record_exit(slot, gen, code)
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"hvdt-worker-{slot.rank}")
+        with self._lock:
+            self._workers[slot.rank] = _WorkerProc(slot, t, gen)
+        t.start()
+
+    def record_exit(self, slot: hosts_mod.SlotInfo, gen: int,
+                    code: int) -> None:
+        with self._lock:
+            if gen != self._generation:
+                return   # stale worker from a previous generation
+        if code == 0:
+            self.registry.record_success(slot.rank)
+        else:
+            # Failed worker ⇒ suspect host (ref: driver.py:297 exit
+            # handling + discovery blacklist).
+            self._hm.blacklist(slot.hostname)
+            self._hm.update_available_hosts()
+            self.registry.record_failure(slot.rank)
+
+    # -- barrier -----------------------------------------------------------
+
+    def _on_barrier(self, states: Dict[str, set]) -> None:
+        if states[READY]:
+            if self.registry.reset_limit_reached():
+                self._finish(1)
+                return
+            threading.Thread(target=self._rendezvous, daemon=True).start()
+        elif states[FAILURE] and not states[READY]:
+            if len(states[FAILURE]) >= len(self._assignments):
+                self._finish(1)
+            else:
+                # Partial failure: survivors need a new, smaller rendezvous.
+                threading.Thread(target=self._safe_rerendezvous,
+                                 daemon=True).start()
+        else:
+            self._finish(0)
+
+    def _safe_rerendezvous(self) -> None:
+        try:
+            self._rendezvous()
+        except (TimeoutError, RuntimeError) as e:
+            print(f"elastic: cannot re-rendezvous: {e}", file=sys.stderr)
+            self._finish(1)
+
+    def _finish(self, code: int) -> None:
+        with self._cond:
+            if self._result is None:
+                self._result = code
+            self._cond.notify_all()
+
+    # -- introspection (tests) --------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def assignments(self) -> List[hosts_mod.SlotInfo]:
+        with self._lock:
+            return list(self._assignments)
+
+
+def run_elastic(args) -> int:
+    """CLI entry for ``hvdtrun --host-discovery-script ...``
+    (ref: launch.py:621 _run_elastic → gloo_run.py:340)."""
+    hm = HostManager.from_script(args.host_discovery_script,
+                                 default_slots=args.slots_per_host)
+    min_np = args.min_np or args.num_proc or 1
+    max_np = args.max_np or args.num_proc or min_np
+
+    server = RendezvousServer(secret=new_secret())
+    port = server.start()
+    addr = socket.gethostbyname(socket.gethostname())
+    coordinator_port = args.coordinator_port
+
+    def rendezvous_cb(slots: List[hosts_mod.SlotInfo], gen: int) -> None:
+        spec = "\n".join(
+            f"{s.rank},{s.hostname},{s.local_rank},{s.cross_rank},"
+            f"{s.size},{s.local_size},{s.cross_size}" for s in slots)
+        server.put_local(f"/rendezvous/{gen}/spec", spec.encode())
+        server.put_local("/rendezvous/version", str(gen).encode())
+
+    def spawn_fn(slot: hosts_mod.SlotInfo, gen: int) -> int:
+        from ..launch import _build_command
+
+        coord = slot.hostname if slot.rank != slot.rank else slot.hostname
+        base_env = {
+            "HVDT_RENDEZVOUS_ADDR": addr,
+            "HVDT_RENDEZVOUS_PORT": str(port),
+            "HVDT_SECRET": server.secret.hex(),
+            "HVDT_COORDINATOR_ADDR": f"{coord}:{coordinator_port}",
+            "HVDT_ELASTIC": "1",
+            "HVDT_GENERATION": str(gen),
+        }
+        cmd, env = _build_command(args, slot, base_env, args.command)
+        prefix = f"[{slot.rank}]" if args.verbose else ""
+        return safe_execute(cmd, env=env, prefix=prefix)
+
+    driver = ElasticDriver(hm, min_np, max_np, spawn_fn,
+                           reset_limit=args.reset_limit)
+    try:
+        driver.start(rendezvous_cb)
+        code = driver.wait()
+        return code if code is not None else 1
+    finally:
+        driver.stop()
+        server.stop()

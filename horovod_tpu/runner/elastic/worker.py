@@ -1,0 +1,60 @@
+"""Worker-side host-update notification.
+
+Re-conception of ref: runner/elastic/worker.py:1-119
+(WorkerNotificationService/Manager — an RPC listener inside the worker).
+TPU-native simplification: workers *poll* the rendezvous KV's
+``/rendezvous/version`` key at commit points; a version newer than the
+worker's generation means the driver re-keyed the cluster ⇒
+``HostsUpdatedInterrupt`` (consumed by horovod_tpu.elastic.run).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ...common.exceptions import HostsUpdatedInterrupt
+from ..http_kv import KVClient
+
+__all__ = ["WorkerNotificationManager"]
+
+
+class WorkerNotificationManager:
+    def __init__(self, client: Optional[KVClient] = None,
+                 generation: Optional[int] = None):
+        self._client = client
+        self._generation = generation
+        self._lock = threading.Lock()
+        self._pending = False
+
+    def init(self) -> None:
+        if self._client is None and "HVDT_RENDEZVOUS_ADDR" in os.environ:
+            self._client = KVClient.from_env()
+        if self._generation is None:
+            self._generation = int(os.environ.get("HVDT_GENERATION", 0))
+
+    def poll(self) -> bool:
+        """True if the driver published a newer cluster generation."""
+        if self._client is None:
+            return False
+        try:
+            raw = self._client.get("/rendezvous/version")
+        except (ConnectionError, OSError):
+            return False
+        if raw is None:
+            return False
+        with self._lock:
+            newer = int(raw) > (self._generation or 0)
+            self._pending = self._pending or newer
+            return self._pending
+
+    def check_for_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt when a newer generation exists
+        (called from State.commit — ref: common/elastic.py:73-97)."""
+        if self.poll():
+            with self._lock:
+                self._pending = False
+            raise HostsUpdatedInterrupt(
+                "cluster membership changed; re-rendezvous required")

@@ -1,0 +1,111 @@
+"""Host parsing and rank/slot assignment.
+
+Re-conception of ref: runner/common/util/hosts.py:1-155 (parse_hosts,
+get_host_assignments → SlotInfo{rank, local_rank, cross_rank, sizes}) for
+the TPU process model: one process per TPU VM (host), each controlling its
+local chips, so "slots" default to 1 per host but remain configurable for
+multi-process-per-host layouts (e.g. one process per chip on v4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Sequence
+
+__all__ = ["HostInfo", "SlotInfo", "parse_hosts", "parse_host_files",
+           "get_host_assignments"]
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    hostname: str
+    slots: int
+
+    @classmethod
+    def from_string(cls, s: str) -> "HostInfo":
+        m = re.match(r"^(?P<host>[^:]+)(:(?P<slots>\d+))?$", s.strip())
+        if not m:
+            raise ValueError(f"bad host string: {s!r}")
+        return cls(m.group("host"), int(m.group("slots") or 1))
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+    def to_env(self) -> Dict[str, str]:
+        """The launcher→worker env contract (analog of the reference's
+        HOROVOD_RANK/... set at runner/gloo_run.py:65-76)."""
+        return {
+            "HVDT_HOSTNAME": self.hostname,
+            "HVDT_RANK": str(self.rank),
+            "HVDT_SIZE": str(self.size),
+            "HVDT_LOCAL_RANK": str(self.local_rank),
+            "HVDT_LOCAL_SIZE": str(self.local_size),
+            "HVDT_CROSS_RANK": str(self.cross_rank),
+            "HVDT_CROSS_SIZE": str(self.cross_size),
+        }
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse "host1:2,host2:4" (ref: hosts.py parse_hosts)."""
+    return [HostInfo.from_string(part)
+            for part in hosts_string.split(",") if part.strip()]
+
+
+def parse_host_files(filename: str) -> List[HostInfo]:
+    """Parse a hostfile with "hostname slots=N" lines (mpirun-style)."""
+    hosts = []
+    with open(filename) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+)(\s+slots\s*=\s*(\d+))?", line)
+            if m:
+                hosts.append(HostInfo(m.group(1), int(m.group(3) or 1)))
+    return hosts
+
+
+def get_host_assignments(hosts: Sequence[HostInfo], min_np: int,
+                         max_np: int = 0) -> List[SlotInfo]:
+    """Round-robin-free contiguous rank assignment: fill each host's slots
+    in order (ref: hosts.py get_host_assignments — same contiguous layout,
+    which keeps local ranks adjacent for hierarchical collectives).
+
+    Raises if fewer than ``min_np`` slots are available; assigns at most
+    ``max_np`` (default: min_np) slots.
+    """
+    total = sum(h.slots for h in hosts)
+    if total < min_np:
+        raise ValueError(
+            f"requested {min_np} processes but only {total} slots available "
+            f"on {len(hosts)} hosts")
+    want = min(max_np or min_np, total)
+    assignments: List[SlotInfo] = []
+    rank = 0
+    cross_size = 0
+    for h in hosts:
+        if rank >= want:
+            break
+        cross_size += 1
+        for local_rank in range(min(h.slots, want - rank)):
+            assignments.append(SlotInfo(
+                hostname=h.hostname, rank=rank, local_rank=local_rank,
+                cross_rank=cross_size - 1, size=want,
+                local_size=0, cross_size=0))
+            rank += 1
+    # Fix up local/cross sizes now that the layout is known.
+    local_sizes: Dict[str, int] = {}
+    for a in assignments:
+        local_sizes[a.hostname] = local_sizes.get(a.hostname, 0) + 1
+    return [dataclasses.replace(a, local_size=local_sizes[a.hostname],
+                                cross_size=cross_size)
+            for a in assignments]

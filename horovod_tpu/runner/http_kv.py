@@ -1,0 +1,219 @@
+"""Rendezvous key-value HTTP server + client.
+
+Re-conception of ref: runner/http/http_server.py:1-259 (KVStoreHandler,
+RendezvousServer with scoped KV per rank group) and http/http_client.py.
+Used by the launcher to publish slot assignments, by elastic workers to
+discover re-rendezvous info, and by the host-collective fallback backend
+as its bootstrap store (the analog of gloo's HTTPStore,
+ref: gloo/http_store.{h,cc}).
+
+Security note: like the reference, requests carry an HMAC digest derived
+from a per-launch secret key (ref: common/util/secret.py, network.py:58-99
+Wire) so stray processes can't join the job.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import http.client
+import http.server
+import os
+import secrets as _secrets
+import socket
+import socketserver
+import threading
+import time
+import urllib.parse
+from typing import Dict, Optional, Tuple
+
+__all__ = ["RendezvousServer", "KVClient", "new_secret"]
+
+_DIGEST_HEADER = "X-HVDT-Digest"
+
+
+def new_secret() -> bytes:
+    return _secrets.token_bytes(32)
+
+
+def _digest(secret: bytes, payload: bytes) -> str:
+    return hmac.new(secret, payload, hashlib.sha256).hexdigest()
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "RendezvousServer"
+
+    def log_message(self, *args):   # silence default stderr noise
+        pass
+
+    def _check_auth(self, payload: bytes) -> bool:
+        want = _digest(self.server.secret, payload)
+        got = self.headers.get(_DIGEST_HEADER, "")
+        return hmac.compare_digest(want, got)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        if not self._check_auth(payload):
+            self.send_error(403)
+            return
+        key = urllib.parse.unquote(self.path)
+        with self.server.lock:
+            self.server.store[key] = payload
+            self.server.cond.notify_all()
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._check_auth(b""):
+            self.send_error(403)
+            return
+        key = urllib.parse.unquote(self.path)
+        with self.server.lock:
+            val = self.server.store.get(key)
+        if val is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_DELETE(self):
+        if not self._check_auth(b""):
+            self.send_error(403)
+            return
+        key = urllib.parse.unquote(self.path)
+        with self.server.lock:
+            removed = self.server.store.pop(key, None)
+        self.send_response(200 if removed is not None else 404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
+    """Threaded in-memory KV over HTTP (ref: RendezvousServer
+    http_server.py:112-218).  start() binds an ephemeral (or given) port;
+    the launcher passes addr/port to workers via HVDT_RENDEZVOUS_ADDR/PORT.
+    """
+
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, secret: Optional[bytes] = None, port: int = 0,
+                 addr: str = "0.0.0.0"):
+        super().__init__((addr, port), _Handler)
+        self.secret = secret if secret is not None else new_secret()
+        self.store: Dict[str, bytes] = {}
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="hvdt-rendezvous", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # Server-side convenience for the in-process driver.
+    def put_local(self, key: str, value: bytes) -> None:
+        with self.lock:
+            self.store[key] = value
+            self.cond.notify_all()
+
+    def get_local(self, key: str) -> Optional[bytes]:
+        with self.lock:
+            return self.store.get(key)
+
+    def wait_for(self, key: str, timeout: float) -> Optional[bytes]:
+        deadline = time.monotonic() + timeout
+        with self.lock:
+            while key not in self.store:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self.cond.wait(remaining)
+            return self.store[key]
+
+
+class KVClient:
+    """Worker-side client (ref: http/http_client.py read/write_data_from_kvstore)."""
+
+    def __init__(self, addr: str, port: int, secret: bytes,
+                 timeout: float = 30.0):
+        self.addr, self.port, self.secret = addr, port, secret
+        self.timeout = timeout
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "KVClient":
+        e = env or os.environ
+        return cls(e["HVDT_RENDEZVOUS_ADDR"],
+                   int(e["HVDT_RENDEZVOUS_PORT"]),
+                   bytes.fromhex(e["HVDT_SECRET"]))
+
+    def _conn(self) -> http.client.HTTPConnection:
+        return http.client.HTTPConnection(self.addr, self.port,
+                                          timeout=self.timeout)
+
+    def put(self, key: str, value: bytes) -> None:
+        c = self._conn()
+        try:
+            c.request("PUT", urllib.parse.quote(key), body=value,
+                      headers={_DIGEST_HEADER: _digest(self.secret, value)})
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                raise ConnectionError(f"KV put {key}: HTTP {r.status}")
+        finally:
+            c.close()
+
+    def get(self, key: str) -> Optional[bytes]:
+        c = self._conn()
+        try:
+            c.request("GET", urllib.parse.quote(key),
+                      headers={_DIGEST_HEADER: _digest(self.secret, b"")})
+            r = c.getresponse()
+            body = r.read()
+            if r.status == 404:
+                return None
+            if r.status != 200:
+                raise ConnectionError(f"KV get {key}: HTTP {r.status}")
+            return body
+        finally:
+            c.close()
+
+    def delete(self, key: str) -> None:
+        c = self._conn()
+        try:
+            c.request("DELETE", urllib.parse.quote(key),
+                      headers={_DIGEST_HEADER: _digest(self.secret, b"")})
+            c.getresponse().read()
+        finally:
+            c.close()
+
+    def wait(self, key: str, timeout: float = 60.0,
+             poll: float = 0.1) -> bytes:
+        """Poll until the key appears (bootstrap barrier helper)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            val = self.get(key)
+            if val is not None:
+                return val
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"KV key {key!r} not published "
+                                   f"within {timeout}s")
+            time.sleep(poll)

@@ -1,0 +1,188 @@
+"""``hvdtrun`` — the horovodrun-equivalent CLI.
+
+Re-conception of ref: runner/launch.py:1-774 (parse_args :242-527,
+_run_static :528, _run_elastic :621) + runner/gloo_run.py:240 launch_gloo
+for the TPU process model: one worker process per TPU VM host, rendezvous
+via our HTTP KV (bootstrap) + the JAX coordination service (runtime), no
+MPI anywhere.
+
+Flow (static):
+  parse hosts → SlotInfo assignments (hosts.py) → start RendezvousServer →
+  publish cluster spec → spawn one shell per slot (local exec or ssh) with
+  the HVDT_* env contract → stream rank-prefixed output → first non-zero
+  exit terminates the job (ref: gloo_run.py:134-197 terminate_all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from . import hosts as hosts_mod
+from .http_kv import RendezvousServer, new_secret
+from .safe_shell_exec import safe_execute
+
+__all__ = ["main", "parse_args", "run_static"]
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdtrun",
+        description="Launch distributed training on TPU hosts "
+                    "(horovodrun-equivalent).")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="Total number of worker processes.")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='Comma-separated "host:slots" list.')
+    p.add_argument("--hostfile", default=None,
+                   help='Hostfile with "host slots=N" lines.')
+    p.add_argument("-p", "--ssh-port", type=int, default=None)
+    p.add_argument("--ssh-identity-file", default=None)
+    p.add_argument("--coordinator-port", type=int, default=29500,
+                   help="Port for the JAX coordination service on rank 0's "
+                        "host.")
+    p.add_argument("--start-timeout", type=float, default=600.0)
+    p.add_argument("--output-filename", default=None,
+                   help="Mux per-rank output into <dir>/rank.<N> files.")
+    p.add_argument("--verbose", "-v", action="store_true")
+    # Elastic flags (ref: launch.py elastic group)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="Executable printing current 'host:slots' lines; "
+                        "enables elastic mode.")
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--slots-per-host", type=int, default=1)
+    p.add_argument("--reset-limit", type=int, default=None,
+                   help="Max worker resets before aborting the elastic job.")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Training command, e.g. python train.py")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no training command given")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _is_local(hostname: str) -> bool:
+    return (hostname in _LOCAL_NAMES
+            or hostname == socket.gethostname()
+            or hostname == socket.getfqdn())
+
+
+def _ssh_prefix(args, hostname: str) -> str:
+    opts = "-o StrictHostKeyChecking=no -o BatchMode=yes"
+    if args.ssh_port:
+        opts += f" -p {args.ssh_port}"
+    if args.ssh_identity_file:
+        opts += f" -i {shlex.quote(args.ssh_identity_file)}"
+    return f"ssh {opts} {shlex.quote(hostname)}"
+
+
+def _build_command(args, slot: hosts_mod.SlotInfo, base_env: Dict[str, str],
+                   command: List[str]) -> (str, Dict[str, str]):
+    env = dict(os.environ)
+    env.update(base_env)
+    env.update(slot.to_env())
+    cmd = " ".join(shlex.quote(c) for c in command)
+    if _is_local(slot.hostname):
+        return cmd, env
+    # Remote: forward the contract env explicitly through ssh.
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in {**base_env,
+                                             **slot.to_env()}.items())
+    return (f"{_ssh_prefix(args, slot.hostname)} "
+            f"{shlex.quote(f'cd {os.getcwd()} && env {exports} {cmd}')}",
+            dict(os.environ))
+
+
+def run_static(args) -> int:
+    """Static launch (ref: launch.py:528 _run_static + gloo_run.py:240)."""
+    if args.hostfile:
+        host_list = hosts_mod.parse_host_files(args.hostfile)
+    elif args.hosts:
+        host_list = hosts_mod.parse_hosts(args.hosts)
+    else:
+        host_list = [hosts_mod.HostInfo("localhost",
+                                        args.num_proc or 1)]
+    np_ = args.num_proc or sum(h.slots for h in host_list)
+    slots = hosts_mod.get_host_assignments(host_list, np_)
+
+    server = RendezvousServer(secret=new_secret())
+    port = server.start()
+    my_addr = socket.gethostbyname(socket.gethostname()) \
+        if any(not _is_local(s.hostname) for s in slots) else "127.0.0.1"
+    coord_host = slots[0].hostname
+    if _is_local(coord_host):
+        coord_host = "127.0.0.1"
+    base_env = {
+        "HVDT_RENDEZVOUS_ADDR": my_addr,
+        "HVDT_RENDEZVOUS_PORT": str(port),
+        "HVDT_SECRET": server.secret.hex(),
+        "HVDT_COORDINATOR_ADDR": f"{coord_host}:{args.coordinator_port}",
+    }
+    server.put_local("/cluster/size", str(np_).encode())
+
+    terminate = threading.Event()
+    exit_codes: Dict[int, int] = {}
+    lock = threading.Lock()
+
+    def _run_slot(slot: hosts_mod.SlotInfo):
+        cmd, env = _build_command(args, slot, base_env, args.command)
+        out = err = None
+        if args.output_filename:
+            os.makedirs(args.output_filename, exist_ok=True)
+            out = open(os.path.join(args.output_filename,
+                                    f"rank.{slot.rank}"), "w")
+            err = out
+        prefix = f"[{slot.rank}]<stdout>:" if args.verbose else ""
+        code = safe_execute(cmd, env=env, stdout=out, stderr=err,
+                            prefix=prefix, terminate_event=terminate)
+        with lock:
+            exit_codes[slot.rank] = code
+        if code != 0:
+            terminate.set()
+        if out is not None:
+            out.close()
+
+    threads = [threading.Thread(target=_run_slot, args=(s,), daemon=True)
+               for s in slots]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join()
+    except KeyboardInterrupt:
+        terminate.set()
+        for t in threads:
+            t.join(timeout=10)
+        return 130
+    finally:
+        server.stop()
+    failed = {r: c for r, c in exit_codes.items() if c != 0}
+    if failed:
+        rank, code = sorted(failed.items())[0]
+        print(f"hvdtrun: rank {rank} exited with code {code}",
+              file=sys.stderr)
+        return code
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.host_discovery_script:
+        from .elastic.driver import run_elastic
+
+        return run_elastic(args)
+    return run_static(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,246 @@
+"""Launcher tests — mirrors the reference's tier-2 strategy (SURVEY.md §4):
+pure-Python unit tests of launcher/elastic logic with fake discovery, plus
+a real-subprocess programmatic-run integration test.
+"""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner.http_kv import RendezvousServer, KVClient, new_secret
+from horovod_tpu.runner.safe_shell_exec import safe_execute
+from horovod_tpu.runner.launch import parse_args
+from horovod_tpu.runner.elastic.discovery import HostManager
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+from horovod_tpu.runner.elastic.registration import (WorkerStateRegistry,
+                                                     READY)
+from horovod_tpu.runner.hosts import HostInfo
+
+
+class TestHosts:
+    def test_parse_hosts(self):
+        hs = hosts_mod.parse_hosts("a:2,b:4,c")
+        assert [(h.hostname, h.slots) for h in hs] == [
+            ("a", 2), ("b", 4), ("c", 1)]
+
+    def test_assignments_contiguous(self):
+        hs = hosts_mod.parse_hosts("a:2,b:2")
+        slots = hosts_mod.get_host_assignments(hs, 4)
+        assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+                for s in slots] == [
+            ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+        assert all(s.size == 4 and s.cross_size == 2 and s.local_size == 2
+                   for s in slots)
+
+    def test_assignments_insufficient(self):
+        with pytest.raises(ValueError):
+            hosts_mod.get_host_assignments(hosts_mod.parse_hosts("a:1"), 2)
+
+    def test_env_contract(self):
+        s = hosts_mod.get_host_assignments(
+            hosts_mod.parse_hosts("x:1"), 1)[0]
+        env = s.to_env()
+        assert env["HVDT_RANK"] == "0"
+        assert env["HVDT_SIZE"] == "1"
+        assert env["HVDT_HOSTNAME"] == "x"
+
+
+class TestKV:
+    def test_put_get_roundtrip(self):
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            c = KVClient("127.0.0.1", port, server.secret)
+            c.put("/a/b", b"hello")
+            assert c.get("/a/b") == b"hello"
+            assert c.get("/missing") is None
+            c.delete("/a/b")
+            assert c.get("/a/b") is None
+        finally:
+            server.stop()
+
+    def test_auth_rejected(self):
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            bad = KVClient("127.0.0.1", port, new_secret())
+            with pytest.raises(ConnectionError):
+                bad.put("/x", b"v")
+        finally:
+            server.stop()
+
+    def test_wait(self):
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            c = KVClient("127.0.0.1", port, server.secret)
+            threading.Timer(0.2, lambda: server.put_local("/k", b"v")).start()
+            assert c.wait("/k", timeout=5.0) == b"v"
+            with pytest.raises(TimeoutError):
+                c.wait("/nope", timeout=0.3)
+        finally:
+            server.stop()
+
+
+class TestSafeExec:
+    def test_exit_code_and_output(self, capfd):
+        code = safe_execute("echo out1; echo err1 >&2; exit 3")
+        assert code == 3
+        cap = capfd.readouterr()
+        assert "out1" in cap.out
+        assert "err1" in cap.err
+
+    def test_prefix(self, capfd):
+        safe_execute("echo hi", prefix="[0]:")
+        assert "[0]:hi" in capfd.readouterr().out
+
+    def test_terminate_event_kills_group(self):
+        ev = threading.Event()
+        t0 = time.monotonic()
+        threading.Timer(0.3, ev.set).start()
+        code = safe_execute("sleep 30", terminate_event=ev, graceful_s=1.0)
+        assert time.monotonic() - t0 < 10
+        assert code != 0
+
+
+class TestParseArgs:
+    def test_basic(self):
+        a = parse_args(["-np", "4", "-H", "h1:2,h2:2", "--",
+                        "python", "train.py"])
+        assert a.num_proc == 4
+        assert a.hosts == "h1:2,h2:2"
+        assert a.command == ["python", "train.py"]
+
+    def test_elastic_flags(self):
+        a = parse_args(["--host-discovery-script", "./d.sh", "--min-np", "2",
+                        "--max-np", "4", "python", "t.py"])
+        assert a.host_discovery_script == "./d.sh"
+        assert a.min_np == 2 and a.max_np == 4
+
+
+class _FakeCluster:
+    """Scripted discovery + worker behavior for driver tests
+    (ref: test/single/test_elastic_driver.py mock style)."""
+
+    def __init__(self, hosts):
+        self.hosts = {h: s for h, s in hosts}
+        self.fail_ranks = set()
+        self.exited = {}
+        self.running = threading.Semaphore(0)
+
+    def discover(self):
+        return [HostInfo(h, s) for h, s in sorted(self.hosts.items())]
+
+    def spawn(self, slot, gen):
+        self.running.release()
+        # Workers run until told to exit (simulate a training process).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (slot.rank, gen) in self.exited:
+                return self.exited[(slot.rank, gen)]
+            if slot.rank in self.fail_ranks and \
+                    slot.hostname in self.hosts:
+                return 1
+            time.sleep(0.02)
+        return 0
+
+
+class TestElasticDriver:
+    def test_rank_and_size_with_host_failure(self):
+        """Host dies → blacklist → re-rendezvous with fewer hosts
+        (ref: test_elastic_driver.py:83 test_rank_and_size_with_host_failure)."""
+        cluster = _FakeCluster([("a", 2), ("b", 2)])
+        hm = HostManager(cluster.discover)
+        driver = ElasticDriver(hm, min_np=2, max_np=4,
+                               spawn_fn=cluster.spawn,
+                               discovery_interval=0.05)
+        gens = []
+        driver.start(lambda slots, gen: gens.append(
+            (gen, [(s.hostname, s.rank) for s in slots])))
+        try:
+            assert driver.generation == 1
+            assert len(driver.assignments) == 4
+            # Kill host b's workers: both report failure, b blacklisted.
+            cluster.hosts.pop("b")
+            survivors = []
+            for w in driver.assignments:
+                if w.hostname == "b":
+                    cluster.exited[(w.rank, 1)] = 1
+                else:
+                    survivors.append(w.rank)
+            # Surviving workers hit the collective failure and request a
+            # new rendezvous (the READY path).
+            time.sleep(0.3)
+            for r in survivors:
+                driver.record_ready(r)
+            deadline = time.monotonic() + 5
+            while driver.generation < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert driver.generation == 2
+            assign2 = driver.assignments
+            assert all(s.hostname == "a" for s in assign2)
+            assert [s.rank for s in assign2] == [0, 1]
+            assert hm.is_blacklisted("b")
+        finally:
+            driver.stop()
+
+    def test_all_success_finishes_zero(self):
+        cluster = _FakeCluster([("a", 2)])
+        hm = HostManager(cluster.discover)
+        driver = ElasticDriver(hm, min_np=2, spawn_fn=cluster.spawn,
+                               discovery_interval=0.05)
+        driver.start()
+        try:
+            for r in (0, 1):
+                cluster.exited[(r, 1)] = 0
+            assert driver.wait(timeout=5.0) == 0
+        finally:
+            driver.stop()
+
+    def test_total_failure_finishes_nonzero(self):
+        cluster = _FakeCluster([("a", 2)])
+        hm = HostManager(cluster.discover)
+        driver = ElasticDriver(hm, min_np=2, spawn_fn=cluster.spawn,
+                               discovery_interval=0.05)
+        driver.start()
+        try:
+            for r in (0, 1):
+                cluster.exited[(r, 1)] = 1
+            assert driver.wait(timeout=5.0) == 1
+        finally:
+            driver.stop()
+
+
+class TestRegistry:
+    def test_barrier_fires_once_all_reported(self):
+        fired = []
+        reg = WorkerStateRegistry(lambda s: fired.append(s))
+        reg.reset(3)
+        reg.record_success(0)
+        reg.record_success(1)
+        assert not fired
+        reg.record_ready(2)
+        assert len(fired) == 1
+        assert fired[0][READY] == {2}
+        assert reg.reset_count == 1
+
+    def test_reset_limit(self):
+        reg = WorkerStateRegistry(lambda s: None, reset_limit=1)
+        reg.reset(1)
+        reg.record_ready(0)
+        assert reg.reset_limit_reached()
+
+
+class TestProgrammaticRun:
+    def test_run_two_local_workers(self):
+        import horovod_tpu.runner as runner
+
+        # Lambda ⇒ cloudpickle serializes by value (test modules are not
+        # importable from the worker processes).
+        results = runner.run(
+            lambda: [int(__import__("os").environ["HVDT_RANK"]),
+                     int(__import__("os").environ["HVDT_SIZE"])], np=2)
+        assert sorted(results) == [[0, 2], [1, 2]]

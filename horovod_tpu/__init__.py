@@ -91,7 +91,9 @@ def __getattr__(name):
             from .ops.compression import Compression
 
             return Compression
-        if name in ("elastic", "timeline"):
+        if name in ("elastic", "timeline", "models", "parallel", "runner",
+                    "callbacks", "sync_batch_norm", "optimizer", "autotune",
+                    "data"):
             import importlib
 
             return importlib.import_module(f".{name}", __name__)

@@ -1,0 +1,239 @@
+"""Autotuning: Bayesian optimization of communication knobs.
+
+Re-conception of ref: common/parameter_manager.{h,cc} (ParameterManager,
+joint Bayesian knobs :178-220) + common/optim/bayesian_optimization.{h,cc}
+and gaussian_process.{h,cc} (GP regression + expected-improvement
+acquisition) — in Python/NumPy, since on TPU the tuning loop runs host-side
+between steps, far off the hot path.
+
+Tuned knobs (the TPU analogs of fusion-threshold/cycle-time):
+
+* ``log2_bucket_bytes`` — gradient fusion bucket size for
+  ``fused_allreduce`` (bigger ⇒ fewer collectives, less overlap);
+* ``overlap_buckets`` — how many buckets to keep in flight (the cycle-time
+  analog: scheduling granularity of comm/compute overlap).
+
+Score = bytes/sec of gradient traffic, synchronized across ranks by
+construction (every rank sees the same step timings via the same jit
+program; for eager use, scores can be fed per-rank and the argmax is
+deterministic given identical samples — ref: parameter_manager.cc
+SynchronizeParameters broadcast is replaced by deterministic replay).
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .common import config
+from .common.logging_util import get_logger
+
+log = get_logger(__name__)
+
+__all__ = ["GaussianProcess", "BayesianOptimizer", "ParameterManager"]
+
+
+class GaussianProcess:
+    """RBF-kernel GP regression (ref: optim/gaussian_process.{h,cc}).
+
+    Hyperparameters are fixed (length_scale per-dim, signal/noise variance)
+    rather than L-BFGS-optimized — adequate for the handful of samples the
+    tuner sees, and dependency-free.
+    """
+
+    def __init__(self, length_scale: float = 1.0, signal_var: float = 1.0,
+                 noise: float = 0.1):
+        self.length_scale = length_scale
+        self.signal_var = signal_var
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._y: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._l_chol: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_var * np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = np.atleast_2d(np.asarray(x, float))
+        self._y = np.asarray(y, float).reshape(-1)
+        k = self._kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.noise
+        self._l_chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._l_chol.T, np.linalg.solve(self._l_chol, self._y))
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior mean and stddev at query points."""
+        x = np.atleast_2d(np.asarray(x, float))
+        if self._x is None:
+            return np.zeros(len(x)), np.full(len(x),
+                                             math.sqrt(self.signal_var))
+        ks = self._kernel(x, self._x)
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._l_chol, ks.T)
+        var = self.signal_var - (v ** 2).sum(0)
+        return mean, np.sqrt(np.maximum(var, 1e-12))
+
+
+class BayesianOptimizer:
+    """Expected-improvement acquisition over a candidate grid
+    (ref: optim/bayesian_optimization.{h,cc})."""
+
+    def __init__(self, candidates: np.ndarray, noise: float = 0.1,
+                 xi: float = 0.01):
+        self.candidates = np.atleast_2d(np.asarray(candidates, float))
+        self.gp = GaussianProcess(noise=noise)
+        self.xi = xi
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+
+    def observe(self, x: Sequence[float], y: float) -> None:
+        self._xs.append(np.asarray(x, float))
+        self._ys.append(float(y))
+        self.gp.fit(np.stack(self._xs), np.asarray(self._ys))
+
+    def suggest(self) -> np.ndarray:
+        if not self._xs:
+            return self.candidates[0]
+        mean, std = self.gp.predict(self.candidates)
+        best = max(self._ys)
+        z = (mean - best - self.xi) / std
+        phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+        cdf = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = (mean - best - self.xi) * cdf + std * phi
+        # Avoid re-suggesting seen points by zeroing their EI.
+        for seen in self._xs:
+            ei[np.all(np.isclose(self.candidates, seen), axis=1)] = -1
+        if np.all(ei <= 0):
+            return self.candidates[int(np.argmax(mean))]
+        return self.candidates[int(np.argmax(ei))]
+
+    @property
+    def best(self) -> Tuple[np.ndarray, float]:
+        i = int(np.argmax(self._ys))
+        return self._xs[i], self._ys[i]
+
+
+@dataclasses.dataclass
+class _Sample:
+    point: np.ndarray
+    bytes_total: float = 0.0
+    seconds: float = 0.0
+    steps: int = 0
+
+    @property
+    def score(self) -> float:
+        return self.bytes_total / self.seconds if self.seconds > 0 else 0.0
+
+
+class ParameterManager:
+    """Online tuner with warmup → sample → done lifecycle
+    (ref: common/parameter_manager.cc Update/Tune/LogParameters).
+
+    Usage::
+
+        pm = ParameterManager()
+        for step in range(...):
+            t0 = time.perf_counter()
+            ...train step using pm.bucket_bytes...
+            pm.record(grad_bytes, time.perf_counter() - t0)
+    """
+
+    LOG2_BUCKET_CANDIDATES = tuple(range(20, 29))     # 1 MiB .. 256 MiB
+    OVERLAP_CANDIDATES = (1, 2, 4)
+
+    def __init__(self,
+                 warmup_samples: Optional[int] = None,
+                 steps_per_sample: Optional[int] = None,
+                 max_samples: Optional[int] = None,
+                 log_file: Optional[str] = None,
+                 noise: Optional[float] = None):
+        self.warmup = (warmup_samples if warmup_samples is not None
+                       else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
+        self.steps_per_sample = (
+            steps_per_sample if steps_per_sample is not None
+            else config.get_int("HVDT_AUTOTUNE_STEPS_PER_SAMPLE"))
+        self.max_samples = (
+            max_samples if max_samples is not None
+            else config.get_int("HVDT_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"))
+        noise = (noise if noise is not None
+                 else config.get_float("HVDT_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"))
+        self._log_file = log_file or config.get_str("HVDT_AUTOTUNE_LOG") or None
+        grid = np.array([[b, o] for b in self.LOG2_BUCKET_CANDIDATES
+                         for o in self.OVERLAP_CANDIDATES], float)
+        self._bo = BayesianOptimizer(grid, noise=noise)
+        self._current = np.array(
+            [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0])
+        self._sample = _Sample(self._current)
+        self._samples_done = 0
+        self._warmups_done = 0
+        self._done = False
+        self._best: Optional[np.ndarray] = None
+
+    # -- knob views --------------------------------------------------------
+
+    @property
+    def bucket_bytes(self) -> int:
+        return int(2 ** self._current[0])
+
+    @property
+    def overlap_buckets(self) -> int:
+        return int(self._current[1])
+
+    @property
+    def tuning_complete(self) -> bool:
+        return self._done
+
+    # -- feeding -----------------------------------------------------------
+
+    def record(self, grad_bytes: float, seconds: float) -> bool:
+        """Record one step; returns True when knob values just changed
+        (caller should rebuild/re-jit its buckets)."""
+        if self._done:
+            return False
+        s = self._sample
+        s.bytes_total += grad_bytes
+        s.seconds += seconds
+        s.steps += 1
+        if s.steps < self.steps_per_sample:
+            return False
+        return self._finish_sample()
+
+    def _finish_sample(self) -> bool:
+        s = self._sample
+        if self._warmups_done < self.warmup:
+            self._warmups_done += 1
+            self._sample = _Sample(self._current)
+            return False
+        self._bo.observe(s.point, s.score)
+        self._log(s)
+        self._samples_done += 1
+        if self._samples_done >= self.max_samples:
+            best_x, best_y = self._bo.best
+            self._current = best_x
+            self._done = True
+            log.info("autotune done: bucket=%d MiB overlap=%d (%.1f MB/s)",
+                     self.bucket_bytes // 2 ** 20, self.overlap_buckets,
+                     best_y / 1e6)
+            return True
+        self._current = self._bo.suggest()
+        self._sample = _Sample(self._current)
+        return True
+
+    def _log(self, s: _Sample) -> None:
+        if not self._log_file:
+            return
+        try:
+            with open(self._log_file, "a", newline="") as f:
+                csv.writer(f).writerow(
+                    [time.time(), int(2 ** s.point[0]), int(s.point[1]),
+                     f"{s.score:.1f}"])
+        except OSError as e:
+            log.warning("autotune log write failed: %s", e)

@@ -1,0 +1,232 @@
+"""Elastic training: State snapshot/commit/restore + the run() retry loop.
+
+Re-conception of ref: horovod/common/elastic.py:1-175 (State, ObjectState,
+run_fn retry loop :151-175) and torch/elastic/state.py (TorchState pytree
+handlers) for JAX: state lives in pytrees, snapshots are host-memory copies
+(``jax.device_get``), restore re-places them on device with the current
+sharding, and reset re-initializes the framework topology after a
+re-rendezvous.
+
+The contract (ref: docs/elastic.rst):
+
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state, batch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < N:
+            state.params, state.opt_state = step(state.params, ...)
+            state.batch += 1
+            if state.batch % 100 == 0:
+                state.commit()
+
+* ``HorovodInternalError`` (a collective died — peer preempted): restore
+  from the last commit, re-rendezvous, continue.
+* ``HostsUpdatedInterrupt`` (driver announced membership change at a
+  commit point): keep current state, re-rendezvous, continue.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+from .common.basics import is_initialized, rank
+from .common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt)
+from .common.logging_util import get_logger
+
+log = get_logger(__name__)
+
+__all__ = ["State", "ObjectState", "JaxState", "run"]
+
+
+class State:
+    """Base elastic state (ref: common/elastic.py:26 State).
+
+    Subclasses implement save/restore/sync of their payload; this class
+    carries the reset-callback machinery and host-update polling.
+    """
+
+    def __init__(self) -> None:
+        self._reset_callbacks: List[Callable[[], None]] = []
+        self._notification_manager = None
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self._host_messages_pending = False
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self) -> None:
+        pass
+
+    def commit(self) -> None:
+        """Snapshot + check for pending host updates
+        (ref: common/elastic.py:60-71 commit/check_host_updates)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        if self._notification_manager is None:
+            from .runner.elastic.worker import WorkerNotificationManager
+
+            self._notification_manager = WorkerNotificationManager()
+            self._notification_manager.init()
+        self._notification_manager.check_for_updates()
+
+    # -- subclass payload hooks -------------------------------------------
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """Elastic state of arbitrary picklable attributes
+    (ref: common/elastic.py:101 ObjectState)."""
+
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.save()
+
+    def _payload_keys(self) -> List[str]:
+        return [k for k in self.__dict__
+                if not k.startswith("_")]
+
+    def save(self) -> None:
+        self._saved = {k: copy.deepcopy(getattr(self, k))
+                       for k in self._payload_keys()}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        """Broadcast payload from rank 0 so joining workers align
+        (ref: ObjectState.sync → broadcast_object)."""
+        if not is_initialized():
+            return
+        from .functions import broadcast_object
+
+        payload = {k: getattr(self, k) for k in self._payload_keys()}
+        payload = broadcast_object(payload, root_rank=0, name="elastic_state")
+        for k, v in payload.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Elastic state whose array-valued attributes are JAX pytrees
+    (ref: torch/elastic/state.py TorchState with Model/Optimizer handlers).
+
+    Snapshots pull arrays to host memory (`jax.device_get`) so a committed
+    state survives device loss; restore pushes them back (the next jitted
+    step re-shards them under the then-current mesh).
+    """
+
+    def _split(self, payload: Dict[str, Any]):
+        import jax
+
+        arrays, objects = {}, {}
+        for k, v in payload.items():
+            leaves = jax.tree.leaves(v)
+            if leaves and all(hasattr(l, "shape") and hasattr(l, "dtype")
+                              for l in leaves):
+                arrays[k] = v
+            else:
+                objects[k] = v
+        return arrays, objects
+
+    def save(self) -> None:
+        import jax
+
+        payload = {k: getattr(self, k) for k in self._payload_keys()}
+        arrays, objects = self._split(payload)
+        saved = {k: copy.deepcopy(v) for k, v in objects.items()}
+        for k, v in arrays.items():
+            saved[k] = jax.device_get(v)   # host-memory numpy snapshot
+        self._saved = saved
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        if not is_initialized():
+            return
+        import jax
+
+        from .functions import broadcast_object, broadcast_parameters
+
+        payload = {k: getattr(self, k) for k in self._payload_keys()}
+        arrays, objects = self._split(payload)
+        if objects:
+            objects = broadcast_object(objects, root_rank=0,
+                                       name="elastic_objs")
+            for k, v in objects.items():
+                setattr(self, k, v)
+        for k, tree in arrays.items():
+            leaves, treedef = jax.tree.flatten(tree)
+            leaves = broadcast_parameters(leaves, root_rank=0)
+            setattr(self, k, jax.tree.unflatten(treedef, leaves))
+        self.save()
+
+
+def run(func: Callable) -> Callable:
+    """Elastic retry-loop decorator (ref: common/elastic.py:151 run_fn).
+
+    ``func(state, *args, **kwargs)`` is re-entered after recoverable
+    failures: HorovodInternalError ⇒ restore-from-commit;
+    HostsUpdatedInterrupt ⇒ continue with current state.  Each re-entry
+    re-initializes the framework and calls state.on_reset()/sync().
+    """
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        skip_sync = False
+        while True:
+            if not skip_sync:
+                state.sync()
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                log.info("collective failure — restoring last commit")
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                log.info("hosts updated — re-rendezvous without rollback")
+                skip_sync = e.skip_sync
+            _reset(state)
+
+    return wrapper
+
+
+def _reset(state: State) -> None:
+    """Tear down and re-initialize the runtime for the new cluster
+    (ref: common/elastic.py reset() → shutdown + re-init; on TPU this
+    re-reads the launcher contract and rebuilds topology/mesh)."""
+    from .common import basics
+    from .ops import eager
+
+    try:
+        eager.shutdown_controller()
+    except Exception:
+        pass
+    if basics.is_initialized():
+        basics.shutdown()
+    basics.init()
+    state.on_reset()

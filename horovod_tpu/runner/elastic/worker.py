@@ -56,5 +56,4 @@ class WorkerNotificationManager:
         if self.poll():
             with self._lock:
                 self._pending = False
-            raise HostsUpdatedInterrupt(
-                "cluster membership changed; re-rendezvous required")
+            raise HostsUpdatedInterrupt()

@@ -1,0 +1,106 @@
+"""Elastic state machine tests (ref: common/elastic.py run_fn semantics +
+torch/elastic/state.py snapshot behavior; SURVEY.md §3.4, §5.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.elastic import JaxState, ObjectState, run
+
+
+class TestObjectState:
+    def test_commit_restore(self, hvd):
+        s = ObjectState(batch=0, epoch=0)
+        s.batch = 5
+        s.commit()
+        s.batch = 9
+        s.restore()
+        assert s.batch == 5
+
+    def test_restore_without_commit_returns_initial(self, hvd):
+        s = ObjectState(batch=3)
+        s.batch = 10
+        s.restore()
+        assert s.batch == 3
+
+
+class TestJaxState:
+    def test_array_snapshot_is_host_copy(self, hvd):
+        params = {"w": jnp.ones((4, 4))}
+        s = JaxState(params=params, batch=0)
+        s.params = jax.tree.map(lambda x: x * 7, s.params)
+        s.restore()
+        np.testing.assert_array_equal(np.asarray(s.params["w"]),
+                                      np.ones((4, 4)))
+
+    def test_mixed_payload(self, hvd):
+        s = JaxState(params={"w": jnp.zeros(3)}, sched={"lr": 0.1}, step=2)
+        s.params = {"w": jnp.ones(3)}
+        s.sched = {"lr": 0.9}
+        s.step = 11
+        s.commit()
+        s.params = {"w": jnp.full(3, 5.0)}
+        s.sched = {"lr": 0.5}
+        s.step = 99
+        s.restore()
+        np.testing.assert_array_equal(np.asarray(s.params["w"]), np.ones(3))
+        assert s.sched == {"lr": 0.9}
+        assert s.step == 11
+
+
+class TestRunLoop:
+    def test_internal_error_restores_and_retries(self, hvd):
+        calls = []
+
+        @run
+        def train(state):
+            calls.append(state.batch)
+            if len(calls) == 1:
+                state.batch = 77    # uncommitted progress, must roll back
+                raise HorovodInternalError("peer died")
+            return state.batch
+
+        s = ObjectState(batch=1)
+        assert train(s) == 1
+        assert calls == [1, 1]     # second entry saw restored state
+
+    def test_hosts_updated_keeps_state(self, hvd):
+        calls = []
+
+        @run
+        def train(state):
+            calls.append(state.batch)
+            if len(calls) == 1:
+                state.batch = 50    # progress kept (no rollback)
+                raise HostsUpdatedInterrupt()
+            return state.batch
+
+        s = ObjectState(batch=1)
+        assert train(s) == 50
+        assert calls == [1, 50]
+
+    def test_reset_callbacks_fire(self, hvd):
+        fired = []
+
+        @run
+        def train(state):
+            if not fired:
+                raise HostsUpdatedInterrupt()
+            return "done"
+
+        s = ObjectState(x=0)
+        s.register_reset_callbacks([lambda: fired.append(True)])
+        assert train(s) == "done"
+        assert fired == [True]
+
+    def test_unrecoverable_error_propagates(self, hvd):
+        @run
+        def train(state):
+            raise ValueError("real bug")
+
+        with pytest.raises(ValueError):
+            train(ObjectState(x=0))

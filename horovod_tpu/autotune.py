@@ -97,22 +97,35 @@ class BayesianOptimizer:
     def observe(self, x: Sequence[float], y: float) -> None:
         self._xs.append(np.asarray(x, float))
         self._ys.append(float(y))
-        self.gp.fit(np.stack(self._xs), np.asarray(self._ys))
+        # Z-score-normalize scores before fitting: raw bytes/sec (~1e9)
+        # against a unit-variance kernel would collapse EI to 0 everywhere
+        # (the reference normalizes in ParameterManager too).
+        ys = np.asarray(self._ys)
+        std = float(ys.std())
+        self._y_scale = std if std > 0 else 1.0
+        self._y_shift = float(ys.mean())
+        self._yn = (ys - self._y_shift) / self._y_scale
+        self.gp.fit(np.stack(self._xs), self._yn)
 
     def suggest(self) -> np.ndarray:
         if not self._xs:
             return self.candidates[0]
         mean, std = self.gp.predict(self.candidates)
-        best = max(self._ys)
+        best = float(self._yn.max())
         z = (mean - best - self.xi) / std
         phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
         cdf = 0.5 * (1 + np.vectorize(math.erf)(z / math.sqrt(2)))
         ei = (mean - best - self.xi) * cdf + std * phi
-        # Avoid re-suggesting seen points by zeroing their EI.
+        # Avoid re-suggesting seen points (in EI and in the fallback).
+        seen_mask = np.zeros(len(self.candidates), bool)
         for seen in self._xs:
-            ei[np.all(np.isclose(self.candidates, seen), axis=1)] = -1
+            seen_mask |= np.all(np.isclose(self.candidates, seen), axis=1)
+        ei[seen_mask] = -1
         if np.all(ei <= 0):
-            return self.candidates[int(np.argmax(mean))]
+            fallback = np.where(seen_mask, -np.inf, mean)
+            if np.all(np.isneginf(fallback)):  # every candidate visited
+                return self.candidates[int(np.argmax(mean))]
+            return self.candidates[int(np.argmax(fallback))]
         return self.candidates[int(np.argmax(ei))]
 
     @property
@@ -175,7 +188,6 @@ class ParameterManager:
         self._samples_done = 0
         self._warmups_done = 0
         self._done = False
-        self._best: Optional[np.ndarray] = None
 
     # -- knob views --------------------------------------------------------
 

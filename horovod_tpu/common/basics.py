@@ -244,6 +244,8 @@ def shutdown() -> None:
     """Tear down (ref: operations.cc horovod_shutdown)."""
     from ..timeline import stop_timeline
 
+    from ..ops import tcp_backend
+
     with _state.lock:
         if not _state.initialized:
             stop_timeline()  # a timeline may exist without init
@@ -251,6 +253,7 @@ def shutdown() -> None:
         if _state.eager_controller is not None:
             _state.eager_controller.shutdown()
         _state.reset()
+    tcp_backend.shutdown_groups()
     stop_timeline()
 
 

@@ -83,6 +83,16 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_LOG_LEVEL", "warning", str,
            "trace|debug|info|warning|error|fatal"),
         _k("HVDT_LOG_HIDE_TIME", False, _parse_bool, "Hide timestamps in log lines."),
+        # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
+        #     LibType selection env_parser.cc) ---
+        _k("HVDT_CPU_OPERATIONS", "xla", str,
+           "Host-collective data plane: 'xla' (host tensors ride the device "
+           "mesh) or 'tcp' (native C++ socket-mesh backend, the Gloo analog)."),
+        _k("HVDT_TCP_ADDRS", "", str,
+           "Rank-ordered host:port list for the native TCP backend (set by "
+           "the launcher; process set k listens on port+k)."),
+        _k("HVDT_TCP_TIMEOUT_MS", 30000, int,
+           "Connect timeout for the native TCP backend mesh bootstrap."),
         # --- elastic (ref: HOROVOD_ELASTIC common.h:139) ---
         _k("HVDT_ELASTIC", False, _parse_bool, "Elastic (fault-tolerant) mode."),
         # --- topology / rendezvous (set by the launcher; ref env contract

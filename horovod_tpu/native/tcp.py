@@ -1,0 +1,185 @@
+"""TcpProcessGroup — numpy front end of the native TCP collective backend.
+
+Host-CPU fallback data plane (ref: ops/gloo_operations.cc + the gloo
+context bootstrap gloo/gloo_context.cc), carried by the C++ core
+(native/src/tcp_group.cc) over a full TCP socket mesh: ring allreduce,
+ring allgatherv, broadcast, pairwise alltoallv, barrier, and Adasum VHDD.
+
+Used where XLA collectives are not the right tool: eager host tensors in
+multi-process runs without a TPU mesh, launcher/control traffic, and
+CPU-only CI.  All calls release the GIL (blocking socket IO happens in
+C++), so in-process multi-rank tests can drive N ranks from N threads.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import NativeError, _check, load
+from ..common.types import ReduceOp, data_type_of
+
+__all__ = ["TcpProcessGroup", "adasum_combine"]
+
+# ReduceOp (horovod_tpu.common.types) -> hvdt_reduce_op (native/include/hvdt.h)
+_OP_MAP = {
+    ReduceOp.SUM: 0,
+    ReduceOp.AVERAGE: 0,  # sum on the wire; caller divides (prescale/postscale)
+    ReduceOp.PRODUCT: 1,
+    ReduceOp.MIN: 2,
+    ReduceOp.MAX: 3,
+}
+
+
+def _dtype_code(arr: np.ndarray) -> int:
+    return int(data_type_of(arr.dtype))
+
+
+def _as_c(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def _counts_arr(counts: Sequence[int]):
+    a = (ctypes.c_int64 * len(counts))(*counts)
+    return a
+
+
+class TcpProcessGroup:
+    """One rank's handle on a full-mesh TCP group.
+
+    ``addrs`` is the rank-ordered list of "host:port" endpoints; every rank
+    passes the same list (the launcher provides it through the env
+    contract, mirroring how the reference's gloo context reads
+    HOROVOD_GLOO_RENDEZVOUS_ADDR — runner/gloo_run.py:65-76).
+    """
+
+    def __init__(self, rank: int, size: int, addrs: Sequence[str],
+                 timeout_ms: int = 30000):
+        self._lib = load()
+        handle = ctypes.c_void_p()
+        rc = self._lib.hvdt_tcp_group_create(
+            rank, size, ",".join(addrs).encode(), timeout_ms,
+            ctypes.byref(handle))
+        _check(self._lib, rc)
+        self._h = handle
+        self.rank = rank
+        self.size = size
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvdt_tcp_group_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- collectives (all in element counts, numpy in/out) --
+
+    def allreduce(self, tensor: np.ndarray,
+                  op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        """Returns the reduced array (input is not mutated)."""
+        if op == ReduceOp.ADASUM:
+            return self.adasum_allreduce(tensor)
+        out = np.ascontiguousarray(tensor).copy()
+        _check(self._lib, self._lib.hvdt_allreduce(
+            self._h, _as_c(out), out.size, _dtype_code(out),
+            _OP_MAP[ReduceOp(op)]))
+        if op == ReduceOp.AVERAGE:
+            out = (out / self.size).astype(tensor.dtype)
+        return out
+
+    def allgather(self, tensor: np.ndarray) -> np.ndarray:
+        """Variable-first-dimension allgather (ref semantics: concatenate
+        along axis 0; other dims must match)."""
+        t = np.ascontiguousarray(tensor)
+        row = int(np.prod(t.shape[1:], dtype=np.int64)) if t.ndim else 1
+        my_rows = t.shape[0] if t.ndim else 1
+        rows = self._exchange_counts(my_rows)
+        counts = [r * row for r in rows]
+        out = np.empty((sum(rows),) + t.shape[1:], dtype=t.dtype)
+        _check(self._lib, self._lib.hvdt_allgatherv(
+            self._h, _as_c(t), t.size, _as_c(out), _counts_arr(counts),
+            _dtype_code(t)))
+        return out
+
+    def broadcast(self, tensor: np.ndarray, root: int = 0) -> np.ndarray:
+        out = np.ascontiguousarray(tensor).copy()
+        _check(self._lib, self._lib.hvdt_broadcast(
+            self._h, _as_c(out), out.nbytes, root))
+        return out
+
+    def alltoall(self, tensor: np.ndarray,
+                 splits: Optional[Sequence[int]] = None) -> np.ndarray:
+        """Scatter row-splits of ``tensor`` to each rank, gather theirs
+        (ref: AlltoallOp::PrepareOutputAndParams recv-split exchange,
+        ops/collective_operations.cc:209-273)."""
+        t = np.ascontiguousarray(tensor)
+        row = int(np.prod(t.shape[1:], dtype=np.int64)) if t.ndim > 1 else 1
+        if splits is None:
+            base, extra = divmod(t.shape[0], self.size)
+            splits = [base + (1 if i < extra else 0)
+                      for i in range(self.size)]
+        if sum(splits) != t.shape[0]:
+            raise ValueError("splits must sum to dim 0")
+        # Exchange split tables so each rank knows its recv layout.
+        split_mat = self._exchange_splits(splits)
+        recv_rows = [split_mat[src][self.rank] for src in range(self.size)]
+        send_counts = [s * row for s in splits]
+        recv_counts = [r * row for r in recv_rows]
+        out = np.empty((sum(recv_rows),) + t.shape[1:], dtype=t.dtype)
+        _check(self._lib, self._lib.hvdt_alltoallv(
+            self._h, _as_c(t), _counts_arr(send_counts), _as_c(out),
+            _counts_arr(recv_counts), _dtype_code(t)))
+        return out
+
+    def barrier(self) -> None:
+        _check(self._lib, self._lib.hvdt_barrier(self._h))
+
+    def adasum_allreduce(self, tensor: np.ndarray) -> np.ndarray:
+        t = np.ascontiguousarray(tensor)
+        work = t.astype(np.float64 if t.dtype == np.float64 else np.float32)
+        _check(self._lib, self._lib.hvdt_adasum_allreduce(
+            self._h, _as_c(work), work.size, _dtype_code(work)))
+        return work.astype(t.dtype)
+
+    # -- helpers --
+
+    def _exchange_counts(self, mine: int) -> list:
+        buf = np.empty(self.size, dtype=np.int64)
+        me = np.array([mine], dtype=np.int64)
+        _check(self._lib, self._lib.hvdt_allgatherv(
+            self._h, _as_c(me), 1, _as_c(buf),
+            _counts_arr([1] * self.size), int(_dtype_code(me))))
+        return [int(x) for x in buf]
+
+    def _exchange_splits(self, splits: Sequence[int]) -> np.ndarray:
+        mine = np.asarray(splits, dtype=np.int64)
+        buf = np.empty(self.size * self.size, dtype=np.int64)
+        _check(self._lib, self._lib.hvdt_allgatherv(
+            self._h, _as_c(mine), self.size, _as_c(buf),
+            _counts_arr([self.size] * self.size), int(_dtype_code(mine))))
+        return buf.reshape(self.size, self.size)
+
+
+def adasum_combine(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Local pairwise Adasum combine — the C++ reference math
+    (native/src/adasum.cc), used to validate the JAX implementation."""
+    lib = load()
+    if a.shape != b.shape or a.dtype != b.dtype:
+        raise ValueError("operands must match")
+    out = np.ascontiguousarray(a).copy()
+    bb = np.ascontiguousarray(b)
+    _check(lib, lib.hvdt_adasum_combine(
+        _as_c(out), _as_c(bb), out.size, _dtype_code(out)))
+    return out

@@ -1,0 +1,74 @@
+"""NativeTimeline — Python handle on the C++ async timeline writer.
+
+Off-loads Chrome-trace JSON formatting and file IO to the native writer
+thread (native/src/timeline.cc; ref: common/timeline.h:48-102
+TimelineWriter), so per-event cost on the training path is one queue push.
+The pure-Python Timeline (horovod_tpu/timeline.py) remains the fallback
+and the two emit the same event vocabulary.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Optional
+
+from . import _check, load
+
+__all__ = ["NativeTimeline"]
+
+
+class NativeTimeline:
+    """Chrome-trace writer; one 'process' row per tensor name
+    (ref: timeline.cc:244-266 'tensors as pids')."""
+
+    def __init__(self, path: str):
+        import ctypes
+
+        self._lib = load()
+        handle = ctypes.c_void_p()
+        _check(self._lib,
+               self._lib.hvdt_timeline_create(path.encode(),
+                                              ctypes.byref(handle)))
+        self._h = handle
+        self._t0 = time.monotonic_ns()
+
+    def _now_us(self) -> int:
+        return (time.monotonic_ns() - self._t0) // 1000
+
+    def _emit(self, pid_name: str, name: str, ph: str, ts_us: int,
+              dur_us: int = 0, args: Optional[dict] = None) -> None:
+        if self._h is None:
+            return
+        args_json = json.dumps(args) if args else None
+        _check(self._lib, self._lib.hvdt_timeline_event(
+            self._h, pid_name.encode(), name.encode(), ph.encode(),
+            ts_us, dur_us,
+            args_json.encode() if args_json else None))
+
+    def begin(self, tensor: str, phase: str,
+              args: Optional[dict] = None) -> None:
+        self._emit(tensor, phase, "B", self._now_us(), 0, args)
+
+    def end(self, tensor: str, phase: str,
+            args: Optional[dict] = None) -> None:
+        self._emit(tensor, phase, "E", self._now_us(), 0, args)
+
+    def complete(self, tensor: str, phase: str, start_us: int, dur_us: int,
+                 args: Optional[dict] = None) -> None:
+        self._emit(tensor, phase, "X", start_us, dur_us, args)
+
+    def instant(self, tensor: str, name: str,
+                args: Optional[dict] = None) -> None:
+        self._emit(tensor, name, "i", self._now_us(), 0, args)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.hvdt_timeline_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

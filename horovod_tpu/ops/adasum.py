@@ -67,10 +67,16 @@ def host_adasum(flat: np.ndarray, process_set) -> np.ndarray:
     computes the identical tree reduction locally (deterministic).  The
     bandwidth-optimal path is the jit-side ``adasum_allreduce``."""
     from . import host_collectives as hostc
+    from . import tcp_backend
 
     p = process_set.size()
     if p == 1:
         return flat
+    if tcp_backend.enabled() and not (p & (p - 1)):
+        # Native VHDD (native/src/adasum.cc) — bandwidth shape of the
+        # reference's recursive halving, O(G) wire bytes per rank.
+        return tcp_backend.tcp_adasum(np.ascontiguousarray(flat),
+                                      process_set)
     orig_dtype = flat.dtype
     stacked = hostc.host_allgather(flat[None, :], process_set,
                                    [1] * p)  # (p, n)

@@ -123,6 +123,11 @@ def _contribution_rows(mesh, value: np.ndarray, identity_val: float):
 
 def host_allreduce(value: np.ndarray, process_set, op: ReduceOp) -> np.ndarray:
     """Allreduce ``value`` across the processes of ``process_set``."""
+    from . import tcp_backend
+
+    if tcp_backend.enabled():
+        return tcp_backend.tcp_allreduce(np.ascontiguousarray(value),
+                                         process_set, op)
     mesh = _flat_mesh(process_set.mesh)
     value = np.ascontiguousarray(value)
     calc_dtype = value.dtype
@@ -139,6 +144,13 @@ def host_broadcast(value: Optional[np.ndarray], root_rank: int, process_set,
                    shape: Tuple[int, ...], dtype) -> np.ndarray:
     """Broadcast from set-relative ``root_rank``.  Non-root processes pass
     value=None and receive the root's tensor."""
+    from . import tcp_backend
+
+    if tcp_backend.enabled():
+        is_root = process_set.rank() == root_rank
+        contrib = (np.ascontiguousarray(value) if is_root
+                   else np.zeros(shape, dtype))
+        return tcp_backend.tcp_broadcast(contrib, process_set, root_rank)
     mesh = _flat_mesh(process_set.mesh)
     is_root = process_set.rank() == root_rank
     contrib = (np.ascontiguousarray(value) if is_root
@@ -154,6 +166,11 @@ def host_allgather(value: np.ndarray, process_set,
     """Ragged allgather: concat along dim 0 with per-rank sizes
     ``all_dim0`` (negotiated by the controller — the analog of the
     allgather displacement math in ops/collective_operations.h:129)."""
+    from . import tcp_backend
+
+    if tcp_backend.enabled():
+        return tcp_backend.tcp_allgather(np.ascontiguousarray(value),
+                                         process_set)
     mesh = _flat_mesh(process_set.mesh)
     value = np.ascontiguousarray(value)
     max0 = max(all_dim0) if all_dim0 else 0
@@ -188,7 +205,13 @@ def host_alltoall(value: np.ndarray, splits: Sequence[int], process_set,
 
     Implemented as ragged allgather + local slicing: correctness-first (the
     jit path's lax.all_to_all is the performance path)."""
+    from . import tcp_backend
+
     my_rank = process_set.rank()
+    if tcp_backend.enabled():
+        out = tcp_backend.tcp_alltoall(np.ascontiguousarray(value),
+                                       process_set, list(splits))
+        return out, [int(s[my_rank]) for s in all_splits]
     dim0s = [int(sum(s)) for s in all_splits]
     gathered = host_allgather(value, process_set, dim0s)
     out_pieces = []

@@ -1,0 +1,110 @@
+"""Selectable native-TCP data plane for eager host collectives.
+
+Analog of the reference's CPU-operations backend selection
+(ref: HOROVOD_CPU_OPERATIONS, common.h:127-128, parsed in
+utils/env_parser.cc → LibType MPI/GLOO/CCL; dispatch priority
+operations.cc:144-253).  Here there are two host data planes:
+
+* ``xla`` (default) — host tensors ride the XLA device mesh
+  (ops/host_collectives.py), so eager bytes use ICI/DCN like the jit path.
+* ``tcp`` — the native C++ backend (native/src/tcp_group.cc): a full TCP
+  socket mesh between processes, no accelerator involvement.  This is the
+  Gloo-analog fallback for CPU-only fleets, host-side control traffic, or
+  debugging without touching devices.
+
+Selection: ``HVDT_CPU_OPERATIONS=tcp`` + ``HVDT_TCP_ADDRS`` (rank-ordered
+``host:port`` list, set by the launcher alongside the rest of the env
+contract — runner/launch.py).  Each process set gets its own socket mesh;
+its members listen on ``base_port + process_set_id`` so concurrent groups
+never collide (ports are per-listener).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..common import config
+from ..common.types import ReduceOp
+
+__all__ = ["enabled", "group_for", "shutdown_groups"]
+
+_lock = threading.Lock()
+_groups: Dict[int, "object"] = {}
+
+
+def enabled() -> bool:
+    if config.get_str("HVDT_CPU_OPERATIONS").lower() != "tcp":
+        return False
+    if not config.get_str("HVDT_TCP_ADDRS"):
+        return False
+    from .. import native
+
+    return native.available()
+
+
+def group_for(process_set):
+    """TcpProcessGroup for this process set (cached; lazily connected).
+
+    The socket-mesh bootstrap happens OUTSIDE the cache lock — every
+    member must be connecting concurrently for the mesh to form (in
+    production one process is one rank; in tests several rank threads
+    share the process, hence also the (set, rank) cache key)."""
+    from ..native import TcpProcessGroup
+
+    key = (process_set.id, process_set.rank())
+    with _lock:
+        g = _groups.get(key)
+    if g is not None:
+        return g
+    addrs_all = [a.strip() for a in
+                 config.get_str("HVDT_TCP_ADDRS").split(",") if a.strip()]
+    offset = process_set.id
+    member_addrs = []
+    for r in process_set.ranks:
+        host, port = addrs_all[r].rsplit(":", 1)
+        member_addrs.append(f"{host}:{int(port) + offset}")
+    g = TcpProcessGroup(process_set.rank(), process_set.size(), member_addrs,
+                        timeout_ms=config.get_int("HVDT_TCP_TIMEOUT_MS"))
+    with _lock:
+        existing = _groups.setdefault(key, g)
+    if existing is not g:
+        g.close()
+        return existing
+    return g
+
+
+def shutdown_groups() -> None:
+    with _lock:
+        for g in _groups.values():
+            try:
+                g.close()
+            except Exception:
+                pass
+        _groups.clear()
+
+
+# -- collective entry points mirroring ops/host_collectives signatures --
+
+
+def tcp_allreduce(value: np.ndarray, process_set, op: ReduceOp) -> np.ndarray:
+    return group_for(process_set).allreduce(value, op=op)
+
+
+def tcp_allgather(value: np.ndarray, process_set) -> np.ndarray:
+    return group_for(process_set).allgather(value)
+
+
+def tcp_broadcast(value: np.ndarray, process_set, root: int) -> np.ndarray:
+    return group_for(process_set).broadcast(value, root=root)
+
+
+def tcp_alltoall(value: np.ndarray, process_set,
+                 splits: Optional[list] = None) -> np.ndarray:
+    return group_for(process_set).alltoall(value, splits=splits)
+
+
+def tcp_adasum(flat: np.ndarray, process_set) -> np.ndarray:
+    return group_for(process_set).adasum_allreduce(flat)

@@ -28,6 +28,7 @@ class WorkerNotificationManager:
         self._generation = generation
         self._lock = threading.Lock()
         self._pending = False
+        self._latest: Optional[int] = None
 
     def init(self) -> None:
         if self._client is None and "HVDT_RENDEZVOUS_ADDR" in os.environ:
@@ -46,14 +47,23 @@ class WorkerNotificationManager:
         if raw is None:
             return False
         with self._lock:
-            newer = int(raw) > (self._generation or 0)
+            version = int(raw)
+            newer = version > (self._generation or 0)
+            if newer:
+                self._latest = version
             self._pending = self._pending or newer
             return self._pending
 
     def check_for_updates(self) -> None:
         """Raise HostsUpdatedInterrupt when a newer generation exists
-        (called from State.commit — ref: common/elastic.py:73-97)."""
+        (called from State.commit — ref: common/elastic.py:73-97).
+
+        Adopts the observed version as the new generation before raising,
+        so after the re-rendezvous the next commits don't re-trigger on the
+        same version (the env's HVDT_GENERATION is stale by then)."""
         if self.poll():
             with self._lock:
                 self._pending = False
+                if self._latest is not None:
+                    self._generation = self._latest
             raise HostsUpdatedInterrupt()

@@ -1,0 +1,108 @@
+/* hvdt.h — C API of the native runtime core.
+ *
+ * TPU-native re-conception of the reference's native runtime pieces
+ * (ref: horovod/common/operations.h C API; horovod/common/ops/
+ * gloo_operations.cc host-CPU collectives; horovod/common/timeline.{h,cc}
+ * async Chrome-trace writer; horovod/common/ops/adasum/adasum.h VHDD).
+ *
+ * On TPU the accelerator data plane is XLA collectives over ICI/DCN (no
+ * native kernels needed there); what remains native is the *host* side:
+ *   - a CPU fallback/control collective backend over TCP (Gloo analog),
+ *   - the timeline writer (async, off the hot path),
+ *   - Adasum host math (reference implementation + cross-host combine).
+ *
+ * Loaded from Python via ctypes (horovod_tpu/native/__init__.py).
+ * All functions return 0 on success, nonzero on failure; the error text is
+ * retrievable per-thread via hvdt_last_error().
+ */
+#ifndef HVDT_H_
+#define HVDT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- dtypes / reduce ops (mirror horovod_tpu.common.types) ---- */
+
+enum hvdt_dtype {
+  HVDT_UINT8 = 0,
+  HVDT_INT8 = 1,
+  HVDT_UINT16 = 2,
+  HVDT_INT16 = 3,
+  HVDT_INT32 = 4,
+  HVDT_INT64 = 5,
+  HVDT_FLOAT16 = 6,
+  HVDT_FLOAT32 = 7,
+  HVDT_FLOAT64 = 8,
+  HVDT_BOOL = 9,
+  HVDT_BFLOAT16 = 10,
+};
+
+enum hvdt_reduce_op {
+  HVDT_OP_SUM = 0,
+  HVDT_OP_PRODUCT = 1,
+  HVDT_OP_MIN = 2,
+  HVDT_OP_MAX = 3,
+};
+
+const char* hvdt_last_error(void);
+int64_t hvdt_dtype_size(int dtype);
+
+/* ---- TCP process group (host collective backend) ---- */
+
+typedef void* hvdt_group_t;
+
+/* addrs_csv: "host:port,host:port,..." — one entry per rank; each rank
+ * listens on its own entry's port and a full socket mesh is built
+ * (lower rank accepts, higher rank connects). */
+int hvdt_tcp_group_create(int rank, int size, const char* addrs_csv,
+                          int timeout_ms, hvdt_group_t* out);
+int hvdt_tcp_group_destroy(hvdt_group_t g);
+int hvdt_group_rank(hvdt_group_t g);
+int hvdt_group_size(hvdt_group_t g);
+
+/* In-place ring allreduce (reduce-scatter + allgather). */
+int hvdt_allreduce(hvdt_group_t g, void* buf, int64_t count, int dtype,
+                   int op);
+/* Variable allgather; counts[size] in elements, out is the concatenation
+ * in rank order. */
+int hvdt_allgatherv(hvdt_group_t g, const void* in, int64_t in_count,
+                    void* out, const int64_t* counts, int dtype);
+/* In-place broadcast from root (direct sends over the mesh). */
+int hvdt_broadcast(hvdt_group_t g, void* buf, int64_t nbytes, int root);
+/* Pairwise-exchange alltoallv; send/recv counts are per-destination /
+ * per-source element counts. */
+int hvdt_alltoallv(hvdt_group_t g, const void* in,
+                   const int64_t* send_counts, void* out,
+                   const int64_t* recv_counts, int dtype);
+int hvdt_barrier(hvdt_group_t g);
+
+/* Adasum allreduce (vector-halving distance-doubling; ref:
+ * ops/adasum/adasum.h FusedAllreduce). dtype must be float32/float64;
+ * size must be a power of two (ref: adasum.h:33). */
+int hvdt_adasum_allreduce(hvdt_group_t g, void* buf, int64_t count,
+                          int dtype);
+/* Local pairwise Adasum combine: a <- (1 - ab/2aa) a + (1 - ab/2bb) b.
+ * Reference math for tests and for the JAX implementation to match. */
+int hvdt_adasum_combine(void* a, const void* b, int64_t count, int dtype);
+
+/* ---- timeline (async Chrome-trace writer) ---- */
+
+typedef void* hvdt_timeline_t;
+
+int hvdt_timeline_create(const char* path, hvdt_timeline_t* out);
+/* ph: 'B' begin, 'E' end, 'X' complete (uses dur_us), 'i' instant.
+ * pid_name groups events (the reference uses one pid per tensor,
+ * timeline.cc:244-266); args_json may be NULL or a JSON object literal. */
+int hvdt_timeline_event(hvdt_timeline_t t, const char* pid_name,
+                        const char* name, char ph, int64_t ts_us,
+                        int64_t dur_us, const char* args_json);
+int hvdt_timeline_close(hvdt_timeline_t t);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* HVDT_H_ */

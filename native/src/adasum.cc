@@ -1,0 +1,196 @@
+// Adasum host math: pairwise scale-invariant combine and the recursive
+// vector-halving distance-doubling (VHDD) allreduce over a TcpGroup.
+//
+// Re-conception of the reference's Adasum core
+// (ref: horovod/common/ops/adasum/adasum.h — FusedAllreduce recursive
+// VHDD; dot-product-based scale mixing; power-of-two rank requirement
+// adasum.h:33).  The combine rule for two gradients a, b:
+//
+//   a' = (1 - a.b / (2 a.a)) a  +  (1 - a.b / (2 b.b)) b
+//
+// which reduces to plain (a+b)/1 when a ⟂ b and to averaging when a = b —
+// the scale-invariant interpolation Adasum is built on.  In VHDD each of
+// log2(p) levels halves the vector (partner takes the other half) and
+// doubles the partner distance; dot products are computed distributively:
+// each side computes partial dots over the half it kept, the pair sums
+// them, so the coefficients reflect the *full* vectors.  The reverse
+// sweep allgathers the halves back.
+//
+// The same math is implemented in JAX (horovod_tpu/ops/adasum.py) on
+// reduce-scattered shards; this host version is the reference
+// implementation the tests compare against (and the eager CPU path).
+
+#include <cmath>
+#include <cstring>
+#include <vector>
+
+#include "common.h"
+#include "tcp_group.h"
+
+namespace hvdt {
+
+namespace {
+
+template <typename T>
+void partial_dots(const T* a, const T* b, int64_t n, double* aa, double* bb,
+                  double* ab) {
+  double saa = 0, sbb = 0, sab = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double x = static_cast<double>(a[i]), y = static_cast<double>(b[i]);
+    saa += x * x;
+    sbb += y * y;
+    sab += x * y;
+  }
+  *aa = saa;
+  *bb = sbb;
+  *ab = sab;
+}
+
+template <typename T>
+void combine_with(T* a, const T* b, int64_t n, double aa, double bb,
+                  double ab) {
+  // Guard degenerate zero-norm operands (ref adasum.h handles via eps):
+  // if either vector is 0, the combine degenerates to addition.
+  double ca = aa > 0 ? 1.0 - ab / (2.0 * aa) : 1.0;
+  double cb = bb > 0 ? 1.0 - ab / (2.0 * bb) : 1.0;
+  for (int64_t i = 0; i < n; ++i)
+    a[i] = static_cast<T>(ca * static_cast<double>(a[i]) +
+                          cb * static_cast<double>(b[i]));
+}
+
+template <typename T>
+int vhdd(TcpGroup* g, T* buf, int64_t count) {
+  int rank = g->rank(), size = g->size();
+  if (size & (size - 1))
+    return fail("adasum VHDD requires power-of-two ranks (ref adasum.h:33)");
+  if (size == 1) return 0;
+
+  // Forward sweep: at each level my segment [off, off+len) is split; the
+  // lower partner keeps the first half.
+  int64_t off = 0, len = count;
+  std::vector<T> recv_half(static_cast<size_t>((count + 1) / 2));
+  std::vector<int64_t> offs, lens;  // stack for the reverse sweep
+  for (int dist = 1; dist < size; dist <<= 1) {
+    int partner = rank ^ dist;
+    offs.push_back(off);
+    lens.push_back(len);
+    int64_t first = len / 2;
+    int64_t keep_off, keep_len, give_off, give_len;
+    if (rank < partner) {
+      keep_off = off;
+      keep_len = first;
+      give_off = off + first;
+      give_len = len - first;
+    } else {
+      keep_off = off + first;
+      keep_len = len - first;
+      give_off = off;
+      give_len = first;
+    }
+    // Exchange halves: I receive the partner's copy of the half I keep.
+    if (g->SendRecv(partner, buf + give_off, give_len * sizeof(T), partner,
+                    recv_half.data(), keep_len * sizeof(T)))
+      return 1;
+    // Distributed dots.  At this level the group of 2*dist ranks sharing
+    // the high rank bits jointly holds the two subgroup vectors A (bit
+    // `dist` clear) and B (bit set); each rank's kept half is a disjoint
+    // slice, so the full-vector (A.A, B.B, A.B) is the SUM of oriented
+    // partials over the whole group — the reference allreduces the dots
+    // over per-level reduction communicators (ref adasum.h
+    // reduction_comms_), here via recursive doubling on the triple.
+    bool lower = (rank & dist) == 0;
+    double maa, mbb, mab;
+    partial_dots(buf + keep_off, recv_half.data(), keep_len, &maa, &mbb,
+                 &mab);
+    double t[3];
+    if (lower) {
+      t[0] = maa;  // my half belongs to A
+      t[1] = mbb;
+      t[2] = mab;
+    } else {
+      t[0] = mbb;  // my half belongs to B
+      t[1] = maa;
+      t[2] = mab;
+    }
+    for (int mask = 1; mask <= dist; mask <<= 1) {
+      int peer = rank ^ mask;
+      double pt[3];
+      if (g->SendRecv(peer, t, sizeof(t), peer, pt, sizeof(pt))) return 1;
+      t[0] += pt[0];
+      t[1] += pt[1];
+      t[2] += pt[2];
+    }
+    double ca = t[0] > 0 ? 1.0 - t[2] / (2.0 * t[0]) : 1.0;
+    double cb = t[1] > 0 ? 1.0 - t[2] / (2.0 * t[1]) : 1.0;
+    // ca scales A, cb scales B; orient onto (mine, received).
+    double cm = lower ? ca : cb, cr = lower ? cb : ca;
+    T* mine = buf + keep_off;
+    const T* recv = recv_half.data();
+    for (int64_t i = 0; i < keep_len; ++i)
+      mine[i] = static_cast<T>(cm * static_cast<double>(mine[i]) +
+                               cr * static_cast<double>(recv[i]));
+    off = keep_off;
+    len = keep_len;
+  }
+  // Reverse sweep: allgather the combined halves back out.
+  for (int dist = size >> 1; dist >= 1; dist >>= 1) {
+    int partner = rank ^ dist;
+    int64_t lv_off = offs.back(), lv_len = lens.back();
+    offs.pop_back();
+    lens.pop_back();
+    int64_t first = lv_len / 2;
+    int64_t mine_off, mine_len, theirs_off, theirs_len;
+    if (rank < partner) {
+      mine_off = lv_off;
+      mine_len = first;
+      theirs_off = lv_off + first;
+      theirs_len = lv_len - first;
+    } else {
+      mine_off = lv_off + first;
+      mine_len = lv_len - first;
+      theirs_off = lv_off;
+      theirs_len = first;
+    }
+    if (g->SendRecv(partner, buf + mine_off, mine_len * sizeof(T), partner,
+                    buf + theirs_off, theirs_len * sizeof(T)))
+      return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+int AdasumAllreduce(TcpGroup* g, void* buf, int64_t count, int dtype) {
+  switch (dtype) {
+    case HVDT_FLOAT32:
+      return vhdd(g, static_cast<float*>(buf), count);
+    case HVDT_FLOAT64:
+      return vhdd(g, static_cast<double*>(buf), count);
+    default:
+      return fail("adasum supports float32/float64 only");
+  }
+}
+
+int AdasumCombine(void* a, const void* b, int64_t count, int dtype) {
+  double aa, bb, ab;
+  switch (dtype) {
+    case HVDT_FLOAT32: {
+      float* fa = static_cast<float*>(a);
+      const float* fb = static_cast<const float*>(b);
+      partial_dots(fa, fb, count, &aa, &bb, &ab);
+      combine_with(fa, fb, count, aa, bb, ab);
+      return 0;
+    }
+    case HVDT_FLOAT64: {
+      double* da = static_cast<double*>(a);
+      const double* db = static_cast<const double*>(b);
+      partial_dots(da, db, count, &aa, &bb, &ab);
+      combine_with(da, db, count, aa, bb, ab);
+      return 0;
+    }
+    default:
+      return fail("adasum supports float32/float64 only");
+  }
+}
+
+}  // namespace hvdt

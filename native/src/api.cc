@@ -1,0 +1,116 @@
+// C API surface — the ctypes boundary (ref: the reference exposes its core
+// through a C API in horovod/common/operations.cc:887-1353, loaded from
+// Python via ctypes in horovod/common/basics.py:33-34; same pattern here).
+
+#include <cstring>
+#include <string>
+
+#include "../include/hvdt.h"
+#include "common.h"
+#include "tcp_group.h"
+#include "timeline.h"
+
+namespace hvdt {
+int AdasumAllreduce(TcpGroup* g, void* buf, int64_t count, int dtype);
+int AdasumCombine(void* a, const void* b, int64_t count, int dtype);
+}  // namespace hvdt
+
+using hvdt::TcpGroup;
+using hvdt::TimelineWriter;
+
+extern "C" {
+
+const char* hvdt_last_error(void) { return hvdt::last_error().c_str(); }
+
+int64_t hvdt_dtype_size(int dtype) { return hvdt::dtype_size(dtype); }
+
+int hvdt_tcp_group_create(int rank, int size, const char* addrs_csv,
+                          int timeout_ms, hvdt_group_t* out) {
+  if (rank < 0 || size <= 0 || rank >= size || !out)
+    return hvdt::fail("invalid rank/size");
+  auto* g = new TcpGroup();
+  int rc = g->Connect(rank, size, addrs_csv ? addrs_csv : "", timeout_ms);
+  if (rc) {
+    delete g;
+    return rc;
+  }
+  *out = g;
+  return 0;
+}
+
+int hvdt_tcp_group_destroy(hvdt_group_t g) {
+  delete static_cast<TcpGroup*>(g);
+  return 0;
+}
+
+int hvdt_group_rank(hvdt_group_t g) { return static_cast<TcpGroup*>(g)->rank(); }
+int hvdt_group_size(hvdt_group_t g) { return static_cast<TcpGroup*>(g)->size(); }
+
+int hvdt_allreduce(hvdt_group_t g, void* buf, int64_t count, int dtype,
+                   int op) {
+  return static_cast<TcpGroup*>(g)->Allreduce(buf, count, dtype, op);
+}
+
+int hvdt_allgatherv(hvdt_group_t g, const void* in, int64_t in_count,
+                    void* out, const int64_t* counts, int dtype) {
+  return static_cast<TcpGroup*>(g)->Allgatherv(in, in_count, out, counts,
+                                               dtype);
+}
+
+int hvdt_broadcast(hvdt_group_t g, void* buf, int64_t nbytes, int root) {
+  return static_cast<TcpGroup*>(g)->Broadcast(buf, nbytes, root);
+}
+
+int hvdt_alltoallv(hvdt_group_t g, const void* in, const int64_t* send_counts,
+                   void* out, const int64_t* recv_counts, int dtype) {
+  return static_cast<TcpGroup*>(g)->Alltoallv(in, send_counts, out,
+                                              recv_counts, dtype);
+}
+
+int hvdt_barrier(hvdt_group_t g) { return static_cast<TcpGroup*>(g)->Barrier(); }
+
+int hvdt_adasum_allreduce(hvdt_group_t g, void* buf, int64_t count,
+                          int dtype) {
+  return hvdt::AdasumAllreduce(static_cast<TcpGroup*>(g), buf, count, dtype);
+}
+
+int hvdt_adasum_combine(void* a, const void* b, int64_t count, int dtype) {
+  return hvdt::AdasumCombine(a, b, count, dtype);
+}
+
+int hvdt_timeline_create(const char* path, hvdt_timeline_t* out) {
+  if (!path || !out) return hvdt::fail("timeline: null path/out");
+  auto* t = new TimelineWriter(path);
+  int rc = t->Start();
+  if (rc) {
+    delete t;
+    return rc;
+  }
+  *out = t;
+  return 0;
+}
+
+int hvdt_timeline_event(hvdt_timeline_t t, const char* pid_name,
+                        const char* name, char ph, int64_t ts_us,
+                        int64_t dur_us, const char* args_json) {
+  if (!t) return hvdt::fail("timeline: null handle");
+  TimelineWriter::Event ev;
+  ev.pid_name = pid_name ? pid_name : "";
+  ev.name = name ? name : "";
+  ev.ph = ph;
+  ev.ts_us = ts_us;
+  ev.dur_us = dur_us;
+  ev.args_json = args_json ? args_json : "";
+  static_cast<TimelineWriter*>(t)->Enqueue(std::move(ev));
+  return 0;
+}
+
+int hvdt_timeline_close(hvdt_timeline_t t) {
+  if (!t) return 0;
+  auto* w = static_cast<TimelineWriter*>(t);
+  int rc = w->Close();
+  delete w;
+  return rc;
+}
+
+}  // extern "C"

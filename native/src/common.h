@@ -1,0 +1,203 @@
+// Internal shared helpers for the native core: per-thread error state,
+// dtype size/dispatch, fp16/bf16 <-> fp32 conversion, elementwise reduce.
+// (ref concepts: horovod/common/common.h DataType; horovod/common/half.cc
+// CPU fp16 math — here bf16/fp16 segments are widened to fp32, reduced,
+// and narrowed, which is also what the TPU VPU does for bf16 accumulate.)
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "../include/hvdt.h"
+
+namespace hvdt {
+
+// Per-thread error message, surfaced through hvdt_last_error().
+std::string& last_error();
+
+inline int fail(const std::string& msg) {
+  last_error() = msg;
+  return 1;
+}
+
+inline int64_t dtype_size(int dtype) {
+  switch (dtype) {
+    case HVDT_UINT8:
+    case HVDT_INT8:
+    case HVDT_BOOL:
+      return 1;
+    case HVDT_UINT16:
+    case HVDT_INT16:
+    case HVDT_FLOAT16:
+    case HVDT_BFLOAT16:
+      return 2;
+    case HVDT_INT32:
+    case HVDT_FLOAT32:
+      return 4;
+    case HVDT_INT64:
+    case HVDT_FLOAT64:
+      return 8;
+    default:
+      return -1;
+  }
+}
+
+// ---- half-precision conversions (round-to-nearest-even for narrowing) ----
+
+inline float bf16_to_f32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  if ((bits & 0x7fffffffu) > 0x7f800000u) return (bits >> 16) | 0x0040;  // NaN
+  uint32_t lsb = (bits >> 16) & 1u;
+  bits += 0x7fffu + lsb;
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+inline float fp16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(man & 0x400u)) {
+        man <<= 1;
+        ++shift;
+      }
+      man &= 0x3ffu;
+      bits = sign | ((127 - 15 - shift) << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp + 127 - 15) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t f32_to_fp16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (((bits >> 23) & 0xff) == 0xff)  // inf/NaN
+    return static_cast<uint16_t>(sign | 0x7c00u | (man ? 0x200u : 0));
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u);  // overflow
+  if (exp <= 0) {  // subnormal or zero
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    man |= 0x800000u;
+    int shift = 14 - exp;
+    uint32_t half = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (half & 1))) ++half;
+    return static_cast<uint16_t>(sign | half);
+  }
+  uint32_t half = sign | (exp << 10) | (man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
+  return static_cast<uint16_t>(half);
+}
+
+// ---- elementwise reduce: acc[i] = acc[i] OP in[i] ----
+
+template <typename T>
+void reduce_typed(T* acc, const T* in, int64_t n, int op) {
+  switch (op) {
+    case HVDT_OP_SUM:
+      for (int64_t i = 0; i < n; ++i) acc[i] = acc[i] + in[i];
+      break;
+    case HVDT_OP_PRODUCT:
+      for (int64_t i = 0; i < n; ++i) acc[i] = acc[i] * in[i];
+      break;
+    case HVDT_OP_MIN:
+      for (int64_t i = 0; i < n; ++i) acc[i] = in[i] < acc[i] ? in[i] : acc[i];
+      break;
+    case HVDT_OP_MAX:
+      for (int64_t i = 0; i < n; ++i) acc[i] = in[i] > acc[i] ? in[i] : acc[i];
+      break;
+  }
+}
+
+template <uint16_t (*Narrow)(float), float (*Widen)(uint16_t)>
+void reduce_half(uint16_t* acc, const uint16_t* in, int64_t n, int op) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = Widen(acc[i]), b = Widen(in[i]);
+    float r;
+    switch (op) {
+      case HVDT_OP_SUM: r = a + b; break;
+      case HVDT_OP_PRODUCT: r = a * b; break;
+      case HVDT_OP_MIN: r = b < a ? b : a; break;
+      default: r = b > a ? b : a; break;
+    }
+    acc[i] = Narrow(r);
+  }
+}
+
+// Reduce `in` into `acc`, both holding n elements of `dtype`.
+inline int reduce_buffers(void* acc, const void* in, int64_t n, int dtype,
+                          int op) {
+  switch (dtype) {
+    case HVDT_UINT8:
+    case HVDT_BOOL:
+      reduce_typed(static_cast<uint8_t*>(acc),
+                   static_cast<const uint8_t*>(in), n, op);
+      return 0;
+    case HVDT_INT8:
+      reduce_typed(static_cast<int8_t*>(acc), static_cast<const int8_t*>(in),
+                   n, op);
+      return 0;
+    case HVDT_UINT16:
+      reduce_typed(static_cast<uint16_t*>(acc),
+                   static_cast<const uint16_t*>(in), n, op);
+      return 0;
+    case HVDT_INT16:
+      reduce_typed(static_cast<int16_t*>(acc),
+                   static_cast<const int16_t*>(in), n, op);
+      return 0;
+    case HVDT_INT32:
+      reduce_typed(static_cast<int32_t*>(acc),
+                   static_cast<const int32_t*>(in), n, op);
+      return 0;
+    case HVDT_INT64:
+      reduce_typed(static_cast<int64_t*>(acc),
+                   static_cast<const int64_t*>(in), n, op);
+      return 0;
+    case HVDT_FLOAT32:
+      reduce_typed(static_cast<float*>(acc), static_cast<const float*>(in),
+                   n, op);
+      return 0;
+    case HVDT_FLOAT64:
+      reduce_typed(static_cast<double*>(acc), static_cast<const double*>(in),
+                   n, op);
+      return 0;
+    case HVDT_FLOAT16:
+      reduce_half<f32_to_fp16, fp16_to_f32>(
+          static_cast<uint16_t*>(acc), static_cast<const uint16_t*>(in), n,
+          op);
+      return 0;
+    case HVDT_BFLOAT16:
+      reduce_half<f32_to_bf16, bf16_to_f32>(
+          static_cast<uint16_t*>(acc), static_cast<const uint16_t*>(in), n,
+          op);
+      return 0;
+    default:
+      return fail("unsupported dtype for reduce: " + std::to_string(dtype));
+  }
+}
+
+}  // namespace hvdt

@@ -1,0 +1,397 @@
+// TCP host-collective backend — the Gloo analog of the native core.
+//
+// Re-conception of the reference's host-CPU data plane
+// (ref: horovod/common/ops/gloo_operations.cc ring allreduce/allgatherv/
+// broadcast/alltoallv; horovod/common/gloo/gloo_context.cc full-mesh
+// bootstrap from a rendezvous).  On TPU the accelerator collectives are
+// XLA programs over ICI; this backend carries *host* tensors (eager
+// fallback, control traffic, CPU-only tests) over plain TCP with no MPI,
+// NCCL, or Gloo dependency.
+//
+// Topology: one listening socket per rank; for each pair (i, j) with
+// i < j, rank j connects to rank i and identifies itself with a 4-byte
+// rank handshake — a full socket mesh.  Sockets are full-duplex; a
+// poll()-based sendrecv makes pairwise exchanges deadlock-free for
+// arbitrary message sizes.
+//
+// Algorithms:
+//   allreduce  — ring reduce-scatter + ring allgather (bandwidth-optimal,
+//                2*(p-1)/p * bytes on the wire per rank).
+//   allgatherv — ring passing of rank blocks, p-1 steps.
+//   broadcast  — direct sends over the mesh (root fan-out).
+//   alltoallv  — p-1 pairwise sendrecv rounds, peer = (rank ± step) % p.
+//   barrier    — 1-byte ring allreduce.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "tcp_group.h"
+
+namespace hvdt {
+
+std::string& last_error() {
+  static thread_local std::string err;
+  return err;
+}
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int set_nodelay(int fd) {
+  int one = 1;
+  return setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+// Read/write exactly n bytes on a blocking socket.
+int read_full(int fd, void* buf, int64_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, static_cast<size_t>(n), 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return fail("recv failed: " + std::string(r == 0 ? "peer closed"
+                                                       : strerror(errno)));
+    }
+    p += r;
+    n -= r;
+  }
+  return 0;
+}
+
+int write_full(int fd, const void* buf, int64_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, static_cast<size_t>(n), MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return fail("send failed: " + std::string(strerror(errno)));
+    }
+    p += r;
+    n -= r;
+  }
+  return 0;
+}
+
+struct Addr {
+  std::string host;
+  int port = 0;
+};
+
+bool parse_addrs(const std::string& csv, std::vector<Addr>* out) {
+  size_t pos = 0;
+  while (pos <= csv.size()) {
+    size_t comma = csv.find(',', pos);
+    std::string item = csv.substr(
+        pos, comma == std::string::npos ? std::string::npos : comma - pos);
+    if (!item.empty()) {
+      size_t colon = item.rfind(':');
+      if (colon == std::string::npos) return false;
+      Addr a;
+      a.host = item.substr(0, colon);
+      a.port = std::atoi(item.c_str() + colon + 1);
+      out->push_back(a);
+    }
+    if (comma == std::string::npos) break;
+    pos = comma + 1;
+  }
+  return true;
+}
+
+}  // namespace
+
+TcpGroup::~TcpGroup() {
+  for (int fd : fds_)
+    if (fd >= 0) ::close(fd);
+}
+
+int TcpGroup::Connect(int rank, int size, const std::string& addrs_csv,
+                      int timeout_ms) {
+  rank_ = rank;
+  size_ = size;
+  fds_.assign(size, -1);
+  if (size == 1) return 0;
+
+  std::vector<Addr> addrs;
+  if (!parse_addrs(addrs_csv, &addrs) || static_cast<int>(addrs.size()) != size)
+    return fail("bad addrs list (need " + std::to_string(size) +
+                " host:port entries): " + addrs_csv);
+
+  auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
+
+  // Listen on our own port; ranks below us will be accepted here.
+  int listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd < 0) return fail("socket: " + std::string(strerror(errno)));
+  int one = 1;
+  setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in self{};
+  self.sin_family = AF_INET;
+  self.sin_addr.s_addr = INADDR_ANY;
+  self.sin_port = htons(static_cast<uint16_t>(addrs[rank].port));
+  if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&self), sizeof(self)) < 0 ||
+      ::listen(listen_fd, size) < 0) {
+    ::close(listen_fd);
+    return fail("bind/listen on port " + std::to_string(addrs[rank].port) +
+                ": " + strerror(errno));
+  }
+
+  // Higher ranks dial lower ranks: we accept size-1-rank peers and dial
+  // `rank` peers; interleave so no ordering constraint exists.
+  int need_accept = size - 1 - rank;
+  int accepted = 0;
+  for (int peer = 0; peer < rank; ++peer) {
+    // Dial peer (it has a lower rank, so it accepts).
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_port = htons(static_cast<uint16_t>(addrs[peer].port));
+    hostent* he = ::gethostbyname(addrs[peer].host.c_str());
+    if (!he) {
+      ::close(listen_fd);
+      return fail("cannot resolve host " + addrs[peer].host);
+    }
+    std::memcpy(&sa.sin_addr, he->h_addr, he->h_length);
+    int fd = -1;
+    while (true) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) break;
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0)
+        break;
+      ::close(fd);
+      fd = -1;
+      if (Clock::now() > deadline) {
+        ::close(listen_fd);
+        return fail("timeout connecting to rank " + std::to_string(peer));
+      }
+      ::usleep(20 * 1000);  // peer may not be listening yet
+    }
+    if (fd < 0) {
+      ::close(listen_fd);
+      return fail("connect: " + std::string(strerror(errno)));
+    }
+    set_nodelay(fd);
+    int32_t my_rank = rank;
+    if (write_full(fd, &my_rank, 4)) {
+      ::close(fd);
+      ::close(listen_fd);
+      return 1;
+    }
+    fds_[peer] = fd;
+  }
+  while (accepted < need_accept) {
+    pollfd pfd{listen_fd, POLLIN, 0};
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - Clock::now())
+                    .count();
+    if (left <= 0 || ::poll(&pfd, 1, static_cast<int>(left)) <= 0) {
+      ::close(listen_fd);
+      return fail("timeout accepting peers (" + std::to_string(accepted) +
+                  "/" + std::to_string(need_accept) + ")");
+    }
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    set_nodelay(fd);
+    int32_t peer_rank = -1;
+    if (read_full(fd, &peer_rank, 4)) {
+      ::close(fd);
+      continue;
+    }
+    if (peer_rank <= rank || peer_rank >= size || fds_[peer_rank] != -1) {
+      ::close(fd);
+      ::close(listen_fd);
+      return fail("bad handshake rank " + std::to_string(peer_rank));
+    }
+    fds_[peer_rank] = fd;
+    ++accepted;
+  }
+  ::close(listen_fd);
+  return 0;
+}
+
+// Full-duplex pairwise exchange on one socket: interleave send and recv
+// with poll so large messages can't deadlock (both sides sending first
+// would fill kernel buffers).
+int TcpGroup::SendRecv(int send_peer, const void* send_buf, int64_t send_n,
+                       int recv_peer, void* recv_buf, int64_t recv_n) {
+  if (send_peer == rank_ && recv_peer == rank_) {
+    if (send_buf != recv_buf && recv_n > 0)
+      std::memcpy(recv_buf, send_buf, static_cast<size_t>(recv_n));
+    return 0;
+  }
+  if (send_peer == rank_ || recv_peer == rank_)
+    return fail("sendrecv: one-sided self exchange is not defined");
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  int64_t to_send = send_n, to_recv = recv_n;
+  int sfd = fds_[send_peer];
+  int rfd = fds_[recv_peer];
+  while (to_send > 0 || to_recv > 0) {
+    pollfd pfds[2];
+    int n = 0;
+    int si = -1, ri = -1;
+    if (to_send > 0 && sfd >= 0) {
+      pfds[n] = {sfd, POLLOUT, 0};
+      si = n++;
+    }
+    if (to_recv > 0 && rfd >= 0) {
+      pfds[n] = {rfd, POLLIN, 0};
+      ri = n++;
+    }
+    if (n == 0) return fail("sendrecv: no progress possible");
+    if (::poll(pfds, n, -1) < 0) {
+      if (errno == EINTR) continue;
+      return fail("poll: " + std::string(strerror(errno)));
+    }
+    if (si >= 0 && (pfds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t r = ::send(sfd, sp, static_cast<size_t>(to_send), MSG_NOSIGNAL);
+      if (r < 0 && errno != EINTR && errno != EAGAIN)
+        return fail("send: " + std::string(strerror(errno)));
+      if (r > 0) {
+        sp += r;
+        to_send -= r;
+      }
+    }
+    if (ri >= 0 && (pfds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t r = ::recv(rfd, rp, static_cast<size_t>(to_recv), 0);
+      if (r == 0) return fail("sendrecv: peer closed");
+      if (r < 0 && errno != EINTR && errno != EAGAIN)
+        return fail("recv: " + std::string(strerror(errno)));
+      if (r > 0) {
+        rp += r;
+        to_recv -= r;
+      }
+    }
+  }
+  return 0;
+}
+
+int TcpGroup::Send(int peer, const void* buf, int64_t n) {
+  return write_full(fds_[peer], buf, n);
+}
+
+int TcpGroup::Recv(int peer, void* buf, int64_t n) {
+  return read_full(fds_[peer], buf, n);
+}
+
+// Segment k of a count-element buffer split into size_ near-equal parts.
+void TcpGroup::Segment(int64_t count, int k, int64_t* off, int64_t* len) const {
+  *off = count * k / size_;
+  *len = count * (k + 1) / size_ - *off;
+}
+
+int TcpGroup::Allreduce(void* buf, int64_t count, int dtype, int op) {
+  if (size_ == 1) return 0;
+  int64_t esize = dtype_size(dtype);
+  if (esize < 0) return fail("bad dtype " + std::to_string(dtype));
+  char* data = static_cast<char*>(buf);
+  int left = (rank_ - 1 + size_) % size_;
+  int right = (rank_ + 1) % size_;
+  int64_t max_seg = count / size_ + 1;
+  std::vector<char> tmp(static_cast<size_t>(max_seg * esize));
+
+  // Phase 1: ring reduce-scatter.  After p-1 steps rank r owns the fully
+  // reduced segment (r+1) % p.
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_seg = (rank_ - step + size_) % size_;
+    int recv_seg = (rank_ - step - 1 + 2 * size_) % size_;
+    int64_t soff, slen, roff, rlen;
+    Segment(count, send_seg, &soff, &slen);
+    Segment(count, recv_seg, &roff, &rlen);
+    if (SendRecv(right, data + soff * esize, slen * esize, left, tmp.data(),
+                 rlen * esize))
+      return 1;
+    if (rlen > 0 &&
+        reduce_buffers(data + roff * esize, tmp.data(), rlen, dtype, op))
+      return 1;
+  }
+  // Phase 2: ring allgather of the reduced segments.
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_seg = (rank_ + 1 - step + 2 * size_) % size_;
+    int recv_seg = (rank_ - step + 2 * size_) % size_;
+    int64_t soff, slen, roff, rlen;
+    Segment(count, send_seg, &soff, &slen);
+    Segment(count, recv_seg, &roff, &rlen);
+    if (SendRecv(right, data + soff * esize, slen * esize, left,
+                 data + roff * esize, rlen * esize))
+      return 1;
+  }
+  return 0;
+}
+
+int TcpGroup::Allgatherv(const void* in, int64_t in_count, void* out,
+                         const int64_t* counts, int dtype) {
+  int64_t esize = dtype_size(dtype);
+  if (esize < 0) return fail("bad dtype " + std::to_string(dtype));
+  if (counts[rank_] != in_count)
+    return fail("allgatherv: counts[rank] != in_count");
+  std::vector<int64_t> offs(size_, 0);
+  for (int i = 1; i < size_; ++i) offs[i] = offs[i - 1] + counts[i - 1];
+  char* o = static_cast<char*>(out);
+  std::memcpy(o + offs[rank_] * esize, in,
+              static_cast<size_t>(in_count * esize));
+  if (size_ == 1) return 0;
+  int left = (rank_ - 1 + size_) % size_;
+  int right = (rank_ + 1) % size_;
+  // Ring: at step s we forward the block originally from (rank - s).
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_blk = (rank_ - step + size_) % size_;
+    int recv_blk = (rank_ - step - 1 + 2 * size_) % size_;
+    if (SendRecv(right, o + offs[send_blk] * esize, counts[send_blk] * esize,
+                 left, o + offs[recv_blk] * esize, counts[recv_blk] * esize))
+      return 1;
+  }
+  return 0;
+}
+
+int TcpGroup::Broadcast(void* buf, int64_t nbytes, int root) {
+  if (size_ == 1) return 0;
+  if (rank_ == root) {
+    for (int peer = 0; peer < size_; ++peer)
+      if (peer != rank_ && Send(peer, buf, nbytes)) return 1;
+    return 0;
+  }
+  return Recv(root, buf, nbytes);
+}
+
+int TcpGroup::Alltoallv(const void* in, const int64_t* send_counts, void* out,
+                        const int64_t* recv_counts, int dtype) {
+  int64_t esize = dtype_size(dtype);
+  if (esize < 0) return fail("bad dtype " + std::to_string(dtype));
+  std::vector<int64_t> soffs(size_, 0), roffs(size_, 0);
+  for (int i = 1; i < size_; ++i) {
+    soffs[i] = soffs[i - 1] + send_counts[i - 1];
+    roffs[i] = roffs[i - 1] + recv_counts[i - 1];
+  }
+  const char* ip = static_cast<const char*>(in);
+  char* op_ = static_cast<char*>(out);
+  std::memcpy(op_ + roffs[rank_] * esize, ip + soffs[rank_] * esize,
+              static_cast<size_t>(send_counts[rank_] * esize));
+  // p-1 pairwise rounds: send to (rank+s), recv from (rank-s) — a
+  // deadlock-free schedule for any p given full-duplex sendrecv.
+  for (int step = 1; step < size_; ++step) {
+    int to = (rank_ + step) % size_;
+    int from = (rank_ - step + size_) % size_;
+    if (SendRecv(to, ip + soffs[to] * esize, send_counts[to] * esize, from,
+                 op_ + roffs[from] * esize, recv_counts[from] * esize))
+      return 1;
+  }
+  return 0;
+}
+
+int TcpGroup::Barrier() {
+  uint8_t b = 1;
+  return Allreduce(&b, 1, HVDT_UINT8, HVDT_OP_MAX);
+}
+
+}  // namespace hvdt

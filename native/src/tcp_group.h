@@ -1,0 +1,46 @@
+// TcpGroup — full-mesh TCP process group with ring collectives.
+// See tcp_group.cc for design notes (Gloo analog of the native core).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdt {
+
+class TcpGroup {
+ public:
+  TcpGroup() = default;
+  ~TcpGroup();
+  TcpGroup(const TcpGroup&) = delete;
+  TcpGroup& operator=(const TcpGroup&) = delete;
+
+  int Connect(int rank, int size, const std::string& addrs_csv,
+              int timeout_ms);
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  int Allreduce(void* buf, int64_t count, int dtype, int op);
+  int Allgatherv(const void* in, int64_t in_count, void* out,
+                 const int64_t* counts, int dtype);
+  int Broadcast(void* buf, int64_t nbytes, int root);
+  int Alltoallv(const void* in, const int64_t* send_counts, void* out,
+                const int64_t* recv_counts, int dtype);
+  int Barrier();
+
+  // Pairwise primitives (used by collectives and Adasum VHDD).
+  int SendRecv(int send_peer, const void* send_buf, int64_t send_n,
+               int recv_peer, void* recv_buf, int64_t recv_n);
+  int Send(int peer, const void* buf, int64_t n);
+  int Recv(int peer, void* buf, int64_t n);
+
+ private:
+  void Segment(int64_t count, int k, int64_t* off, int64_t* len) const;
+
+  int rank_ = 0;
+  int size_ = 1;
+  std::vector<int> fds_;  // fds_[peer] — full mesh, -1 for self
+};
+
+}  // namespace hvdt

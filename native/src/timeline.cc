@@ -1,0 +1,133 @@
+// Async Chrome-trace timeline writer.
+//
+// Re-conception of the reference's Timeline
+// (ref: horovod/common/timeline.{h,cc} — TimelineWriter with a dedicated
+// writer thread timeline.h:48-102, "tensors as pids" JSON emit
+// timeline.cc:217-294).  Events are queued under a mutex and flushed by a
+// background thread so instrumentation never blocks the training path;
+// pid metadata records are emitted lazily per tensor name, matching the
+// reference's per-tensor process rows in chrome://tracing.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+#include "common.h"
+#include "timeline.h"
+
+namespace hvdt {
+
+namespace {
+
+// Minimal JSON string escaping for event/tensor names.
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+TimelineWriter::TimelineWriter(const std::string& path) : path_(path) {}
+
+int TimelineWriter::Start() {
+  file_ = std::fopen(path_.c_str(), "w");
+  if (!file_) return fail("cannot open timeline file " + path_);
+  // Unterminated JSON array — the chrome trace format explicitly allows a
+  // missing ']' so writers can append forever (same as the reference).
+  std::fputs("[\n", file_);
+  running_.store(true);
+  thread_ = std::thread([this] { Loop(); });
+  return 0;
+}
+
+void TimelineWriter::Enqueue(Event ev) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push_back(std::move(ev));
+  }
+  cv_.notify_one();
+}
+
+void TimelineWriter::Loop() {
+  std::deque<Event> batch;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return !queue_.empty() || !running_.load(); });
+      batch.swap(queue_);
+    }
+    for (const Event& ev : batch) WriteEvent(ev);
+    batch.clear();
+    if (!running_.load()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (queue_.empty()) break;
+    }
+    std::fflush(file_);
+  }
+}
+
+void TimelineWriter::WriteEvent(const Event& ev) {
+  // One "process" per tensor/pid-name (ref timeline.cc:244-266): emit the
+  // process_name metadata record on first sight.
+  auto it = pids_.find(ev.pid_name);
+  int pid;
+  if (it == pids_.end()) {
+    pid = static_cast<int>(pids_.size());
+    pids_.emplace(ev.pid_name, pid);
+    std::fprintf(file_,
+                 "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
+                 "\"args\":{\"name\":\"%s\"}},\n",
+                 pid, json_escape(ev.pid_name).c_str());
+  } else {
+    pid = it->second;
+  }
+  std::fprintf(file_, "{\"name\":\"%s\",\"ph\":\"%c\",\"pid\":%d,\"ts\":%lld",
+               json_escape(ev.name).c_str(), ev.ph, pid,
+               static_cast<long long>(ev.ts_us));
+  if (ev.ph == 'X')
+    std::fprintf(file_, ",\"dur\":%lld", static_cast<long long>(ev.dur_us));
+  if (ev.ph == 'i') std::fputs(",\"s\":\"p\"", file_);
+  if (!ev.args_json.empty())
+    std::fprintf(file_, ",\"args\":%s", ev.args_json.c_str());
+  std::fputs("},\n", file_);
+}
+
+int TimelineWriter::Close() {
+  if (!running_.exchange(false)) return 0;
+  cv_.notify_one();
+  if (thread_.joinable()) thread_.join();
+  // Drain anything enqueued after the final loop pass.
+  for (const Event& ev : queue_) WriteEvent(ev);
+  queue_.clear();
+  std::fclose(file_);
+  file_ = nullptr;
+  return 0;
+}
+
+TimelineWriter::~TimelineWriter() {
+  if (running_.load()) Close();
+}
+
+}  // namespace hvdt

@@ -1,0 +1,48 @@
+// TimelineWriter — async Chrome-trace writer (see timeline.cc).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvdt {
+
+class TimelineWriter {
+ public:
+  struct Event {
+    std::string pid_name;
+    std::string name;
+    char ph;
+    int64_t ts_us;
+    int64_t dur_us;
+    std::string args_json;
+  };
+
+  explicit TimelineWriter(const std::string& path);
+  ~TimelineWriter();
+
+  int Start();
+  void Enqueue(Event ev);
+  int Close();
+
+ private:
+  void Loop();
+  void WriteEvent(const Event& ev);
+
+  std::string path_;
+  std::FILE* file_ = nullptr;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  std::atomic<bool> running_{false};
+  std::unordered_map<std::string, int> pids_;  // writer thread only
+};
+
+}  // namespace hvdt

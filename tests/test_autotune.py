@@ -97,3 +97,32 @@ class TestParameterManager:
         pm.record(1e6, 0.01)
         lines = log.read_text().strip().splitlines()
         assert len(lines) == 2
+
+
+class TestBayesianOptimizerExploration:
+    def test_explores_beyond_start_at_raw_throughput_scale(self):
+        """Regression: un-normalized ~1e9 scores collapsed EI to 0 and the
+        tuner never left its starting point."""
+        import numpy as np
+
+        from horovod_tpu.autotune import BayesianOptimizer
+
+        grid = np.array([[float(b), 1.0] for b in range(20, 28)])
+        bo = BayesianOptimizer(grid, noise=0.8)
+        bo.observe([26.0, 1.0], 1.1e9)
+        seen = {26.0}
+        for _ in range(6):
+            x = bo.suggest()
+            seen.add(float(x[0]))
+            bo.observe(x, 1e9 * (1 - 0.01 * abs(x[0] - 24)))
+        assert len(seen) >= 4, f"tuner stuck: only visited {seen}"
+
+    def test_fallback_skips_seen_points(self):
+        import numpy as np
+
+        from horovod_tpu.autotune import BayesianOptimizer
+
+        grid = np.array([[0.0], [1.0]])
+        bo = BayesianOptimizer(grid, noise=1e-3, xi=10.0)  # huge xi: EI<=0
+        bo.observe([0.0], 5.0)
+        assert float(bo.suggest()[0]) == 1.0

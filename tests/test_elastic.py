@@ -104,3 +104,33 @@ class TestRunLoop:
 
         with pytest.raises(ValueError):
             train(ObjectState(x=0))
+
+
+class TestWorkerNotificationGeneration:
+    def test_generation_advances_after_interrupt(self):
+        """Regression: after HostsUpdatedInterrupt the manager must adopt
+        the observed version, or every later commit re-raises forever."""
+        import pytest
+
+        from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+        from horovod_tpu.runner.elastic.worker import (
+            WorkerNotificationManager)
+
+        class FakeKV:
+            def __init__(self):
+                self.version = "1"
+
+            def get(self, key):
+                return self.version
+
+        kv = FakeKV()
+        mgr = WorkerNotificationManager(client=kv, generation=0)
+        with pytest.raises(HostsUpdatedInterrupt):
+            mgr.check_for_updates()
+        # Same version again: no new interrupt.
+        mgr.check_for_updates()
+        # Driver publishes generation 2: interrupt fires once more.
+        kv.version = "2"
+        with pytest.raises(HostsUpdatedInterrupt):
+            mgr.check_for_updates()
+        mgr.check_for_updates()

@@ -1,0 +1,14 @@
+"""Data subsystem: loaders, async prefetch, device prefetch, samplers.
+
+Ref analog: horovod/data/data_loader_base.py + torch/elastic/sampler.py
+(SURVEY.md §2.6); the device-prefetch iterator is the TPU-native addition
+(input pipeline overlap matters more than host threading on TPU).
+"""
+
+from .loader import (AsyncDataLoader, AsyncDataLoaderMixin, BaseDataLoader,
+                     prefetch_to_device)
+from .sampler import DistributedSampler, ElasticSampler, shard_batch_indices
+
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "AsyncDataLoader",
+           "prefetch_to_device", "DistributedSampler", "ElasticSampler",
+           "shard_batch_indices"]

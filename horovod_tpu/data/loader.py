@@ -1,0 +1,153 @@
+"""Data loading: base iterable, background-thread prefetch, device prefetch.
+
+TPU-native re-conception of the reference's data-loading layer
+(ref: data/data_loader_base.py — BaseDataLoader and AsyncDataLoaderMixin,
+a background thread pushing batches through a bounded queue).  The
+TPU-specific addition is ``prefetch_to_device``: while step N computes,
+batch N+1 is already being transferred to HBM with its target sharding —
+hiding host→device latency behind compute, which on TPU matters more than
+the host-side thread (infeed is the usual input bottleneck).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "AsyncDataLoader",
+           "prefetch_to_device"]
+
+
+class BaseDataLoader:
+    """Iterable over batches (ref: data_loader_base.py BaseDataLoader).
+
+    Subclasses implement ``_iterate``; ``_process_batch`` is the trainer
+    hook applied to every batch (kept for API parity)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def _process_batch(self, batch: Any) -> Any:
+        return batch
+
+    def __iter__(self) -> Iterator[Any]:
+        for batch in self._iterate():
+            yield self._process_batch(batch)
+
+
+class _Done:
+    pass
+
+
+class _Raised:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class AsyncDataLoaderMixin:
+    """Background-thread prefetch mixin (ref: data_loader_base.py
+    AsyncDataLoaderMixin; queue size 0 disables async, same contract).
+
+    Use as ``class MyAsyncLoader(AsyncDataLoaderMixin, MyLoader)``.  The
+    producer thread runs ``super()._iterate()`` and pushes into a bounded
+    queue; iteration pops.  Exceptions in the producer re-raise in the
+    consumer; ``close()`` joins the thread.
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 64, **kwargs):
+        self._queue_size = async_loader_queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            # Drain so a blocked producer can observe the stop flag.
+            while self._thread.is_alive():
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(0.01)
+            self._thread = None
+
+    def _producer(self) -> None:
+        try:
+            for batch in super()._iterate():
+                if self._stop.is_set():
+                    break
+                self._queue.put(batch)
+            self._queue.put(_Done())
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            self._queue.put(_Raised(e))
+
+    def _iterate(self) -> Iterator[Any]:
+        if self._queue_size == 0:  # async disabled (ref contract)
+            yield from super()._iterate()
+            return
+        self.close()
+        self._stop.clear()
+        self._queue = queue.Queue(self._queue_size)
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._queue.get()
+            if isinstance(item, _Done):
+                break
+            if isinstance(item, _Raised):
+                raise item.exc
+            yield item
+
+
+class _ListLoader(BaseDataLoader):
+    def __init__(self, batches: Iterable[Any]):
+        self._batches = list(batches)
+
+    def __len__(self) -> int:
+        return len(self._batches)
+
+    def _iterate(self) -> Iterator[Any]:
+        yield from self._batches
+
+
+class AsyncDataLoader(AsyncDataLoaderMixin, _ListLoader):
+    """Ready-made async loader over any finite iterable of batches."""
+
+
+def prefetch_to_device(it: Iterable[Any], size: int = 2,
+                       sharding: Optional[Any] = None,
+                       put: Optional[Callable[[Any], Any]] = None
+                       ) -> Iterator[Any]:
+    """Double-buffer batches onto device ahead of consumption.
+
+    Keeps ``size`` batches in flight: each is ``jax.device_put`` (with
+    ``sharding`` — e.g. NamedSharding(mesh, P('dp'))) before the previous
+    one is consumed, so the h2d transfer of batch N+1 overlaps step N.
+    ``put`` overrides the transfer fn (e.g. for pytrees of mixed
+    shardings).
+    """
+    import collections
+
+    import jax
+
+    if put is None:
+        def put(batch):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, sharding), batch)
+
+    buf: collections.deque = collections.deque()
+    it = iter(it)
+    try:
+        while True:
+            while len(buf) < size:
+                buf.append(put(next(it)))
+            yield buf.popleft()
+    except StopIteration:
+        while buf:
+            yield buf.popleft()

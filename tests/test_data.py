@@ -1,0 +1,152 @@
+"""Data subsystem tests (ref analog: reference exercises samplers in
+test/parallel/test_torch_elastic.py and loaders in spark tests)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data import (AsyncDataLoader, AsyncDataLoaderMixin,
+                              BaseDataLoader, DistributedSampler,
+                              ElasticSampler, prefetch_to_device,
+                              shard_batch_indices)
+
+
+class TestAsyncLoader:
+    def test_preserves_order_and_count(self):
+        batches = [np.full((2,), i) for i in range(50)]
+        loader = AsyncDataLoader(batches, async_loader_queue_size=4)
+        out = list(loader)
+        assert len(out) == 50
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(b, np.full((2,), i))
+        loader.close()
+
+    def test_queue_size_zero_is_sync(self):
+        loader = AsyncDataLoader([1, 2, 3], async_loader_queue_size=0)
+        assert list(loader) == [1, 2, 3]
+        assert loader._thread is None  # never started a producer
+
+    def test_producer_exception_reraises_in_consumer(self):
+        class Exploding(AsyncDataLoaderMixin, BaseDataLoader):
+            def _iterate(self):
+                yield 1
+                raise RuntimeError("boom in producer")
+
+            def __len__(self):
+                return 2
+
+        loader = Exploding(async_loader_queue_size=2)
+        it = iter(loader)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom in producer"):
+            list(it)
+
+    def test_process_batch_hook(self):
+        class Doubler(AsyncDataLoader):
+            def _process_batch(self, batch):
+                return batch * 2
+
+        assert list(Doubler([1, 2], async_loader_queue_size=2)) == [2, 4]
+
+    def test_close_joins_blocked_producer(self):
+        loader = AsyncDataLoader(list(range(1000)),
+                                 async_loader_queue_size=1)
+        it = iter(loader)
+        next(it)  # producer now blocked on the full queue
+        loader.close()
+        assert loader._thread is None
+
+    def test_reiteration_restarts(self):
+        loader = AsyncDataLoader([1, 2, 3], async_loader_queue_size=2)
+        assert list(loader) == [1, 2, 3]
+        assert list(loader) == [1, 2, 3]
+
+
+class TestPrefetchToDevice:
+    def test_yields_all_on_device(self, hvd):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = hvd.mesh()
+        sharding = NamedSharding(mesh, P("dp"))
+        batches = [np.arange(8.0) + i for i in range(7)]
+        out = list(prefetch_to_device(batches, size=2, sharding=sharding))
+        assert len(out) == 7
+        for i, b in enumerate(out):
+            assert isinstance(b, jax.Array)
+            assert b.sharding == sharding
+            np.testing.assert_array_equal(np.asarray(b), batches[i])
+
+    def test_keeps_ahead(self):
+        puts = []
+
+        def put(x):
+            puts.append(x)
+            return x
+
+        it = prefetch_to_device(range(5), size=3, put=put)
+        next(it)
+        # After one pop, the buffer should have been filled 3 deep +1 refill.
+        assert len(puts) >= 3
+
+
+class TestDistributedSampler:
+    def test_partition_covers_all_no_overlap(self):
+        parts = [list(DistributedSampler(10, shuffle=False, rank=r, size=3))
+                 for r in range(3)]
+        assert all(len(p) == 4 for p in parts)  # ceil(10/3)=4 padded
+        covered = set()
+        for p in parts:
+            covered.update(p)
+        assert covered == set(range(10))
+
+    def test_drop_last(self):
+        parts = [list(DistributedSampler(10, shuffle=False, rank=r, size=3,
+                                         drop_last=True)) for r in range(3)]
+        assert all(len(p) == 3 for p in parts)
+        assert len({i for p in parts for i in p}) == 9
+
+    def test_shuffle_deterministic_and_epoch_varies(self):
+        s = DistributedSampler(100, shuffle=True, seed=5, rank=0, size=2)
+        a = list(s)
+        assert a == list(s)
+        s.set_epoch(1)
+        assert a != list(s)
+
+
+class TestElasticSampler:
+    def test_repartitions_remaining_after_rescale(self):
+        # 2 workers process 2 batches of 4 (16 samples), then rescale to 4.
+        s0 = ElasticSampler(64, shuffle=False, rank=0, size=2)
+        s0.record_batch(0, 4)
+        s0.record_batch(1, 4)
+        state = s0.state_dict()
+        assert state["processed_num"] == 16
+        new = [ElasticSampler(64, shuffle=False, rank=r, size=4)
+               for r in range(4)]
+        for s in new:
+            s.load_state_dict(state)
+        remaining = {i for s in new for i in s}
+        assert remaining == set(range(16, 64))
+        assert all(len(s) == 12 for s in new)
+
+    def test_set_epoch_clears_progress(self):
+        s = ElasticSampler(8, shuffle=True, seed=1, rank=0, size=1)
+        s.record_batch(0, 4)
+        s.set_epoch(1)
+        assert s.processed_num == 0
+        assert len(list(s)) == 8
+
+    def test_shuffled_split_consistent_across_ranks(self):
+        samplers = [ElasticSampler(30, shuffle=True, seed=3, rank=r, size=3)
+                    for r in range(3)]
+        seen = [i for s in samplers for i in s]
+        assert sorted(seen) == sorted(list(range(30)))
+
+
+def test_shard_batch_indices():
+    assert shard_batch_indices(8, rank=1, size=4) == slice(2, 4)
+    with pytest.raises(ValueError, match="divisible"):
+        shard_batch_indices(10, rank=0, size=4)

@@ -1,0 +1,110 @@
+"""Elastic training: survive scale-up/down and preemption mid-epoch.
+
+Re-conception of ref: examples/elastic/pytorch/pytorch_mnist_elastic.py —
+the State/commit/restore pattern (SURVEY.md §3.4): wrap training in
+``hvd.elastic.run``; commit state at intervals; on membership change the
+loop re-rendezvouses, re-broadcasts state, and the ElasticSampler
+repartitions the *remaining* samples of the epoch over the new world.
+
+Launch under the elastic driver:
+    hvdtrun --elastic --host-discovery-script ./discover.sh \
+        python examples/jax_mnist_elastic.py
+(also runs standalone single-process for a smoke test).
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--batches-per-commit", type=int, default=10)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import ElasticSampler
+    from horovod_tpu.models import mlp_init, mlp_loss
+
+    hvd.init()
+
+    # Synthetic learnable data (see jax_mnist.py).
+    centers = np.random.default_rng(1234).normal(size=(10, 784)).astype(
+        np.float32)
+    rng = np.random.default_rng(0)
+    labels_all = rng.integers(0, 10, size=4096).astype(np.int32)
+    x_all = (centers[labels_all]
+             + 0.3 * rng.normal(size=(4096, 784))).astype(np.float32)
+
+    params = mlp_init(jax.random.PRNGKey(0))
+    opt = hvd.DistributedOptimizer(optax.sgd(args.lr * hvd.size(),
+                                             momentum=0.9))
+    opt_state = opt.init(params)
+    sampler = ElasticSampler(len(x_all), shuffle=True, seed=0)
+
+    # Everything that must survive a re-rendezvous lives on the state.
+    state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                 sampler=sampler, epoch=0, batch_idx=0)
+
+    def make_step():
+        mesh = hvd.mesh()
+
+        def local_step(params, opt_state, x, y):
+            loss, grads = jax.value_and_grad(
+                lambda pp: mlp_loss(pp, x, y))(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    jax.lax.pmean(loss, "dp"))
+
+        step = jax.jit(jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P())))
+        return mesh, step
+
+    @hvd.elastic.run
+    def train(state):
+        # (Re)build mesh + step for the current topology: after a reset
+        # the device set changed, so compiled programs must be rebuilt.
+        mesh, step = make_step()
+        batch_sharding = NamedSharding(mesh, P("dp"))
+        per_proc = args.batch_size  # per-process batch rows
+        while state.epoch < args.epochs:
+            state.sampler.reset()
+            idx = np.fromiter(state.sampler, np.int64)
+            steps_total = len(idx) // per_proc
+            for b in range(state.batch_idx, steps_total):
+                sel = idx[b * per_proc:(b + 1) * per_proc]
+                xb = jax.device_put(x_all[sel], batch_sharding)
+                yb = jax.device_put(labels_all[sel], batch_sharding)
+                state.params, state.opt_state, loss = step(
+                    state.params, state.opt_state, xb, yb)
+                state.sampler.record_batch(b, per_proc)
+                state.batch_idx = b + 1
+                if (b + 1) % args.batches_per_commit == 0:
+                    # Snapshot + host-update check; raises
+                    # HostsUpdatedInterrupt on membership change.
+                    state.commit()
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss={float(loss):.4f} "
+                      f"world={hvd.size()}")
+            state.epoch += 1
+            state.batch_idx = 0
+            state.sampler.set_epoch(state.epoch)
+            state.commit()
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic run complete.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Synthetic throughput benchmark — images/sec with stddev.
+
+Re-conception of ref: examples/pytorch/pytorch_synthetic_benchmark.py
+(same CLI: --model/--batch-size/--num-iters/--num-batches-per-iter/
+--num-warmup-batches/--use-adasum/--fp16-allreduce; same output shape:
+per-iter img/sec lines, then totals).  TPU-native: bf16 compute, NHWC,
+jitted train step with donated buffers, optional dp sharding over all
+local devices via shard_map.
+
+Single chip (or CPU sim):
+    python examples/jax_synthetic_benchmark.py --num-iters 3
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "mlp", "transformer"])
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-device batch size")
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--use-adasum", action="store_true")
+    p.add_argument("--fp16-allreduce", action="store_true")
+    p.add_argument("--no-shard", action="store_true",
+                   help="single-device step (no dp axis)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n_dev = 1 if args.no_shard else mesh.devices.size
+    global_batch = args.batch_size * n_dev
+
+    key = jax.random.PRNGKey(0)
+    if args.model == "resnet50":
+        from horovod_tpu.models import (ResNetConfig, resnet50_init,
+                                        resnet_loss)
+
+        cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16)
+        params, stats = resnet50_init(key, cfg)
+        data = jax.random.normal(
+            key, (global_batch, args.image_size, args.image_size, 3),
+            jnp.bfloat16)
+        labels = jnp.zeros((global_batch,), jnp.int32)
+
+        def loss_fn(p, xb, yb):
+            loss, _ = resnet_loss(p, stats, xb, yb, cfg)
+            return loss
+    elif args.model == "transformer":
+        from horovod_tpu.models import (TransformerConfig, transformer_init,
+                                        transformer_loss)
+
+        cfg = TransformerConfig(vocab=32000, layers=12, d_model=768,
+                                heads=12, kv_heads=12, d_ff=3072,
+                                max_seq=512, dtype=jnp.bfloat16)
+        params = transformer_init(key, cfg)
+        data = jax.random.randint(key, (global_batch, 512), 0, 32000)
+        labels = None
+
+        def loss_fn(p, xb, yb):
+            return transformer_loss(p, xb, cfg)
+    else:
+        from horovod_tpu.models import mlp_init, mlp_loss
+
+        params = mlp_init(key)
+        data = jax.random.normal(key, (global_batch, 784))
+        labels = jnp.zeros((global_batch,), jnp.int32)
+        loss_fn = mlp_loss
+
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9),
+        op=hvd.Adasum if args.use_adasum else hvd.Average,
+        compression=(hvd.Compression.bf16 if args.fp16_allreduce
+                     else hvd.Compression.none))
+    opt_state = opt.init(params)
+
+    def local_step(params, opt_state, xb, yb):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, xb, yb))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        if not args.no_shard:
+            loss = jax.lax.pmean(loss, "dp")
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    if args.no_shard:
+        step = jax.jit(local_step, donate_argnums=(0, 1))
+    else:
+        step = jax.jit(jax.shard_map(
+            local_step, mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P() if labels is None else P("dp")),
+            out_specs=(P(), P(), P())),
+            donate_argnums=(0, 1))
+        data = jax.device_put(data, NamedSharding(mesh, P("dp")))
+        if labels is not None:
+            labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
+
+    dev = jax.devices()[0]
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}")
+        print(f"Batch size: {global_batch} ({args.batch_size}/device, "
+              f"{n_dev} devices)")
+        print(f"Device: {dev.platform}:{dev.device_kind}")
+
+    def run_batches(n):
+        nonlocal params, opt_state
+        for _ in range(n):
+            params, opt_state, loss = step(params, opt_state, data, labels)
+        jax.block_until_ready(loss)
+
+    run_batches(args.num_warmup_batches)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        run_batches(args.num_batches_per_iter)
+        dt = time.perf_counter() - t0
+        rate = global_batch * args.num_batches_per_iter / dt
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec total")
+        img_secs.append(rate)
+
+    if hvd.rank() == 0:
+        mean, std = np.mean(img_secs), np.std(img_secs)
+        print(f"Img/sec total: {mean:.1f} +- {1.96 * std:.1f}")
+        print(f"Img/sec/device: {mean / n_dev:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,27 @@ def microbatch_gradients(grad_fn, params, batch, num_microbatches: int,
                                threshold_bytes=threshold_bytes)
 
 
+def pvary_tree(tree, axis="dp"):
+    """Mark a replicated pytree as per-rank *varying* over ``axis``.
+
+    Differentiating w.r.t. unvarying params under shard_map inserts the
+    gradient psum automatically — which destroys the per-rank gradients
+    Adasum (and custom reductions) need.  Differentiate w.r.t. the
+    *varying* params (pcast applied OUTSIDE the loss closure — its
+    transpose is itself a psum)::
+
+        loss, grads = jax.value_and_grad(loss_fn)(
+            hvd.optimizer.pvary_tree(params, "dp"))
+
+    then pass the varying grads to DistributedOptimizer(op=hvd.Adasum).
+    """
+    import jax
+    from jax import lax
+
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    return jax.tree.map(lambda t: lax.pcast(t, axes, to="varying"), tree)
+
+
 def _axis_bound(axis) -> bool:
     """True when ``axis`` is a bound manual mesh axis (i.e. we are inside a
     shard_map body).  Under plain auto-sharded jit/pjit there are no bound

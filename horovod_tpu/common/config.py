@@ -83,6 +83,9 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_LOG_LEVEL", "warning", str,
            "trace|debug|info|warning|error|fatal"),
         _k("HVDT_LOG_HIDE_TIME", False, _parse_bool, "Hide timestamps in log lines."),
+        # --- kernels ---
+        _k("HVDT_FLASH_ATTENTION", "auto", str,
+           "Pallas flash-attention kernel: auto (TPU only), on, off."),
         # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
         #     LibType selection env_parser.cc) ---
         _k("HVDT_CPU_OPERATIONS", "xla", str,

@@ -174,6 +174,12 @@ def _attention(p, x, positions, cfg: TransformerConfig):
         # Manual island: the sequence dim is the local sp shard here (the
         # caller's shard_map over {'sp'} has already split it).
         o = ring_attention(q, k, v, axis="sp", causal=True)
+    elif _flash_enabled(l, dh):
+        # Pallas fused attention on TPU: O(L·D) HBM traffic instead of a
+        # materialized [B,H,L,L] score matrix (ops/pallas_kernels.py).
+        from ..ops.pallas_kernels import flash_attention
+
+        o = flash_attention(q, k, v, causal=True)
     else:
         scale = dh ** -0.5
         if h != hk:
@@ -186,6 +192,20 @@ def _attention(p, x, positions, cfg: TransformerConfig):
         w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
         o = jnp.einsum("bhqk,bkhd->bqhd", w, v)
     return o.reshape(b, l, h * dh) @ p["wo"].astype(x.dtype)
+
+
+def _flash_enabled(seq_len: int, head_dim: int) -> bool:
+    """Flash kernel policy: HVDT_FLASH_ATTENTION=auto|on|off.  'auto'
+    (default) uses it on TPU when block shapes divide cleanly."""
+    from ..common import config
+
+    mode = config.get_str("HVDT_FLASH_ATTENTION").lower()
+    if mode == "off":
+        return False
+    shapes_ok = seq_len % min(128, seq_len) == 0 and seq_len >= 8
+    if mode == "on":
+        return shapes_ok
+    return shapes_ok and jax.devices()[0].platform == "tpu"
 
 
 def _mlp(p, x):

@@ -1,0 +1,229 @@
+"""Pallas TPU kernels for the attention hot path.
+
+The framework's compute plane is XLA; Pallas is reserved for the ops
+where profiling shows XLA's fusion isn't enough (SURVEY.md §7: "Pallas
+only if profiling demands").  Attention is that op: the naive einsum
+materializes the [B,H,Lq,Lk] score matrix in HBM, while the flash kernel
+streams K/V blocks through VMEM with an online softmax — HBM traffic
+drops from O(L²) to O(L·D), which is the difference between
+bandwidth-bound and MXU-bound at long sequence.
+
+Two entry points:
+
+* ``flash_attention(q, k, v)`` — fused causal/full attention for the
+  non-ring path (one device holds the whole sequence).
+* ``flash_block_update(...)`` — one ring-attention step: takes the
+  running (acc, row_max, row_sum) online-softmax carry and a K/V block
+  (with its global position offset), returns the updated carry.
+  ``parallel/ring_attention.py`` composes it around ``lax.ppermute``.
+
+Both run in Pallas interpret mode off-TPU, so the CPU test suite
+exercises the very same kernel code (tests/test_pallas.py compares
+against the jnp reference).
+
+Layout: kernels work in [B, H, L, D]; wrappers accept the framework's
+[B, L, H, D] and transpose.  GQA/MQA is handled in the BlockSpec index
+maps (kv head = q head // group) — K/V are never materially expanded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "flash_block_update", "attention_reference"]
+
+_NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+            oacc_ref, om_ref, ol_ref, acc_s, m_s, l_s, *, causal: bool,
+            scale: float):
+    """Grid program (b, h, iq, ik): one K/V block per step, online softmax.
+
+    The canonical TPU flash layout: ik is the innermost (sequential) grid
+    dim, so K/V stream through VMEM with pipelined double-buffering while
+    the (acc, m, l) state lives in persistent VMEM scratch — initialized
+    from the carry inputs at ik==0, flushed to the outputs at the last ik.
+    qo/ko: scalar-prefetch global position offsets (SMEM) for the causal
+    mask; q_ref: [1,1,bq,d]; k_ref/v_ref: [1,1,bk,d].
+    """
+    import jax.experimental.pallas as pl
+
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_s[...] = acc_ref[0, 0, :, :].astype(jnp.float32)
+        m_s[...] = m_ref[0, 0, :, :].astype(jnp.float32)
+        l_s[...] = l_ref[0, 0, :, :].astype(jnp.float32)
+
+    q = q_ref[0, 0, :, :]                       # [bq, d]
+    k_blk = k_ref[0, 0, :, :]                   # [bk, d]
+    v_blk = v_ref[0, 0, :, :]
+    s = jax.lax.dot_general(
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [bq, bk]
+    if causal:
+        q_pos = (qo_ref[0] + iq * bq
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+        k_pos = (ko_ref[0] + ik * bk
+                 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+        mask = q_pos >= k_pos                   # [bq, bk]
+        s = jnp.where(mask, s, _NEG_INF)
+    m = m_s[...]
+    l = l_s[...]
+    acc = acc_s[...]
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    acc_s[...] = acc * corr + jax.lax.dot_general(
+        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    l_s[...] = l * corr + p.sum(axis=-1, keepdims=True)
+    m_s[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        oacc_ref[0, 0, :, :] = acc_s[...]
+        om_ref[0, 0, :, :] = m_s[...]
+        ol_ref[0, 0, :, :] = l_s[...]
+
+
+def _flash_call(q, k, v, acc, m, l, q_offset, k_offset, *, causal, scale,
+                block_q, block_k):
+    """pallas_call plumbing shared by both entry points.  All operands in
+    [B, H(q or kv), L, D] / [B, H, L, 1] layout; returns (acc, m, l)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, lq, d = q.shape
+    _, hkv, lk, _ = k.shape
+    group = h // hkv
+    if lq % block_q or lk % block_k:
+        raise ValueError(
+            f"seq lens (q={lq}, k={lk}) must divide block sizes "
+            f"({block_q}, {block_k})")
+    grid = (b, h, lq // block_q, lk // block_k)
+
+    qspec = pl.BlockSpec((1, 1, block_q, d),
+                         lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
+    kvspec = pl.BlockSpec((1, 1, block_k, d),
+                          lambda bb, hh, qq, kk, *_: (bb, hh // group, kk, 0))
+    carry_d = pl.BlockSpec((1, 1, block_q, d),
+                           lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
+    carry_1 = pl.BlockSpec((1, 1, block_q, 1),
+                           lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
+
+    kernel = functools.partial(_kernel, causal=causal, scale=scale)
+    # Inside shard_map (check_vma) out types must carry the varying-axes
+    # set; outputs vary over every axis any operand varies over.
+    vma = frozenset()
+    for op in (q, k, v, acc, m, l):
+        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
+    kw = {"vma": vma} if vma else {}
+    out_shapes = (
+        jax.ShapeDtypeStruct((b, h, lq, d), jnp.float32, **kw),
+        jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32, **kw),
+        jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32, **kw),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec, carry_d, carry_1, carry_1],
+        out_specs=[carry_d, carry_1, carry_1],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)])
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=_use_interpret(),
+    )(jnp.atleast_1d(q_offset).astype(jnp.int32),
+      jnp.atleast_1d(k_offset).astype(jnp.int32),
+      q, k, v, acc, m, l)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Fused flash attention; layouts/API match
+    parallel.ring_attention (q,k,v: [B, L, H, D]; GQA via fewer kv heads).
+    """
+    b, lq, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, lq)
+    block_k = min(block_k, k.shape[1])
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    acc = jnp.zeros((b, h, lq, d), jnp.float32)
+    m = jnp.full((b, h, lq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, lq, 1), jnp.float32)
+    acc, m, l = _flash_call(qt, kt, vt, acc, m, l, 0, 0, causal=causal,
+                            scale=scale, block_q=block_q, block_k=block_k)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
+                       acc: jax.Array, row_max: jax.Array,
+                       row_sum: jax.Array, *, q_offset, k_offset,
+                       causal: bool, scale: float,
+                       block_q: int = 128, block_k: int = 128
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One ring step in ring-attention layout.
+
+    q/acc: [B, Lq, H, D]; k_blk/v_blk: [B, Lk, Hkv, D];
+    row_max/row_sum: [B, H, Lq].  ``q_offset``/``k_offset`` are the global
+    positions of the local shards (traced values are fine — they ride the
+    scalar-prefetch arguments).
+    """
+    b, lq, h, d = q.shape
+    block_q = min(block_q, lq)
+    block_k = min(block_k, k_blk.shape[1])
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k_blk.transpose(0, 2, 1, 3)
+    vt = v_blk.transpose(0, 2, 1, 3)
+    acc_t = acc.transpose(0, 2, 1, 3).astype(jnp.float32)
+    m_t = row_max[..., None].astype(jnp.float32)
+    l_t = row_sum[..., None].astype(jnp.float32)
+    acc_t, m_t, l_t = _flash_call(
+        qt, kt, vt, acc_t, m_t, l_t, q_offset, k_offset, causal=causal,
+        scale=scale, block_q=block_q, block_k=block_k)
+    return (acc_t.transpose(0, 2, 1, 3), m_t[..., 0], l_t[..., 0])
+
+
+def attention_reference(q, k, v, *, causal=True, scale=None):
+    """Naive jnp attention (materializes scores) — the correctness oracle."""
+    b, lq, h, d = q.shape
+    hkv = k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    if h != hkv:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lk = k.shape[1]
+        mask = jnp.arange(lq)[:, None] >= jnp.arange(lk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

@@ -58,7 +58,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    axis: str = "sp",
                    causal: bool = True,
                    scale: Optional[float] = None,
-                   segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                   segment_ids: Optional[jax.Array] = None,
+                   use_pallas: Optional[bool] = None) -> jax.Array:
     """Exact (optionally causal) attention over a sequence-sharded ring.
 
     Args:
@@ -71,6 +72,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       segment_ids: optional ``[batch, local_seq]`` int segment labels for
         packed sequences; attention is masked to equal segments.  The key
         side's labels rotate around the ring with K/V.
+      use_pallas: run each ring step through the Pallas flash kernel
+        (ops/pallas_kernels.flash_block_update) instead of the jnp block
+        update.  Default: on TPU, when segment_ids is None and shapes
+        tile cleanly.
 
     Returns ``[batch, local_seq, heads, head_dim]`` in q's dtype.
     """
@@ -83,6 +88,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     sp = lax.axis_size(axis)
     my = lax.axis_index(axis)
     lk = k.shape[1]
+
+    if use_pallas is None:
+        use_pallas = (jax.devices()[0].platform == "tpu"
+                      and segment_ids is None
+                      and lq % min(128, lq) == 0 and lk % min(128, lk) == 0)
 
     q_pos = my * lq + jnp.arange(lq)                      # global q positions
 
@@ -106,16 +116,48 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k_blk, v_blk, k_seg, acc, row_max, row_sum = carry
         # After s rotations the resident block originated at rank (my - s).
         src = (my - s) % sp
-        k_pos = src * lk + jnp.arange(lk)
-        if causal:
-            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        if use_pallas:
+            # Fused VMEM-resident block update (ops/pallas_kernels.py).
+            # Ring blocks need only three mask cases — source block fully
+            # visible (src < my), the causal diagonal (src == my), or
+            # fully in the future (identity) — so the kernel's position
+            # offsets stay static and lax.switch picks the case.
+            from ..ops.pallas_kernels import flash_block_update
+
+            def _full(ops):
+                qq, kb, vb, a, m_, s_ = ops
+                return flash_block_update(qq, kb, vb, a, m_, s_,
+                                          q_offset=0, k_offset=0,
+                                          causal=False, scale=scale)
+
+            def _diag(ops):
+                qq, kb, vb, a, m_, s_ = ops
+                return flash_block_update(qq, kb, vb, a, m_, s_,
+                                          q_offset=0, k_offset=0,
+                                          causal=True, scale=scale)
+
+            def _skip(ops):
+                _, _, _, a, m_, s_ = ops
+                return a, m_, s_
+
+            ops_in = (q, k_blk, v_blk, acc, row_max, row_sum)
+            if causal:
+                case = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+                acc, row_max, row_sum = lax.switch(
+                    case, [_full, _diag, _skip], ops_in)
+            else:
+                acc, row_max, row_sum = _full(ops_in)
         else:
-            mask = jnp.ones((1, 1, 1, 1), bool)
-        if k_seg is not None:
-            same = segment_ids[:, :, None] == k_seg[:, None, :]
-            mask = jnp.logical_and(mask, same[:, None, :, :])
-        acc, row_max, row_sum = _block_update(
-            q, k_blk, v_blk, acc, row_max, row_sum, mask, scale)
+            k_pos = src * lk + jnp.arange(lk)
+            if causal:
+                mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            else:
+                mask = jnp.ones((1, 1, 1, 1), bool)
+            if k_seg is not None:
+                same = segment_ids[:, :, None] == k_seg[:, None, :]
+                mask = jnp.logical_and(mask, same[:, None, :, :])
+            acc, row_max, row_sum = _block_update(
+                q, k_blk, v_blk, acc, row_max, row_sum, mask, scale)
         # Rotate K/V (and its segment labels) forward for the next step.
         k_nxt = lax.ppermute(k_blk, axis, fwd)
         v_nxt = lax.ppermute(v_blk, axis, fwd)

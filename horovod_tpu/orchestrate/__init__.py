@@ -1,0 +1,16 @@
+"""Orchestrator adapters: actor-pool executor, Ray adapter, estimator.
+
+Re-conception of ref: horovod/ray/runner.py (RayExecutor actor pool) and
+horovod/spark (Estimator fit/transform) — SURVEY.md §2.6.  The core is a
+cluster-agnostic ``Executor`` over persistent worker processes wired with
+the launcher's env contract; ``RayExecutor`` preserves the reference's
+API surface on top (Ray actors when Ray is importable, local processes
+otherwise), and ``JaxEstimator`` gives the sklearn-ish fit/transform
+wrapper the Spark estimators provided.
+"""
+
+from .executor import Executor
+from .ray_adapter import RayExecutor
+from .estimator import JaxEstimator
+
+__all__ = ["Executor", "RayExecutor", "JaxEstimator"]

@@ -1,0 +1,81 @@
+"""JaxEstimator — the Spark-estimator fit/transform shape without Spark.
+
+Re-conception of ref: spark/keras & spark/torch estimators
+(spark/common/params.py, runner.py — Spark ML fit/transform over
+distributed workers).  Petastorm/DataFrame plumbing collapses to numpy
+arrays sharded across the Executor pool; what survives is the contract:
+``est.fit(X, y) -> model`` trains data-parallel across workers, and the
+returned model is a plain local object with ``transform``/``predict``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .executor import Executor
+
+__all__ = ["JaxEstimator", "JaxModel"]
+
+
+class JaxModel:
+    """Trained model handle (ref: spark estimators return a Model whose
+    transform() runs the predict path)."""
+
+    def __init__(self, params: Any, predict_fn: Callable[[Any, np.ndarray],
+                                                         np.ndarray]):
+        self.params = params
+        self._predict_fn = predict_fn
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._predict_fn(self.params, x))
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        return self.predict(x)
+
+
+def _worker_fit(train_fn, xs, ys, fit_kwargs):
+    import os
+
+    rank = int(os.environ.get("HVDT_RANK", 0))
+    return train_fn(xs[rank], ys[rank], **fit_kwargs)
+
+
+class JaxEstimator:
+    """Data-parallel fit over an Executor pool.
+
+    Args:
+      train_fn: ``train_fn(x_shard, y_shard, **fit_kwargs) -> params`` —
+        runs inside each worker process (it may hvd.init() and allreduce
+        itself, or train purely locally; rank/size come from the env
+        contract).  Rank 0's returned params become the model.
+      predict_fn: ``predict_fn(params, x) -> y_hat`` for the model handle.
+      num_workers: pool size (ref: num_proc on the spark estimators).
+    """
+
+    def __init__(self, train_fn: Callable, predict_fn: Callable,
+                 num_workers: int = 1,
+                 env: Optional[Dict[str, str]] = None):
+        self.train_fn = train_fn
+        self.predict_fn = predict_fn
+        self.num_workers = num_workers
+        self._env = env
+
+    def _shards(self, x: np.ndarray, y: Optional[np.ndarray]
+                ) -> Tuple[list, list]:
+        xs = np.array_split(np.asarray(x), self.num_workers)
+        ys = (np.array_split(np.asarray(y), self.num_workers)
+              if y is not None else [None] * self.num_workers)
+        return xs, ys
+
+    def fit(self, x: np.ndarray, y: Optional[np.ndarray] = None,
+            **fit_kwargs) -> JaxModel:
+        xs, ys = self._shards(x, y)
+        with Executor(self.num_workers, env=self._env) as ex:
+            # One concurrent dispatch — workers may collectively train
+            # (allreduce etc.), so they must all enter together; each
+            # selects its shard by rank.
+            results = ex.run(_worker_fit,
+                             args=(self.train_fn, xs, ys, fit_kwargs))
+        return JaxModel(results[0], self.predict_fn)

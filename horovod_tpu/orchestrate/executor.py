@@ -1,0 +1,187 @@
+"""Executor — persistent local actor pool with the launcher env contract.
+
+Re-conception of ref: ray/runner.py RayExecutor (actor pool that starts
+once and dispatches many functions) without requiring Ray: workers are
+subprocesses running ``orchestrate.worker_loop``, coordinated through the
+launcher's HMAC-authed HTTP KV (runner/http_kv.py), with the same env
+contract the CLI launcher uses (HVDT_RANK/SIZE/...).  Results and
+exceptions round-trip pickled per rank per call epoch.
+
+Workers import only the light KV client — no JAX — so dispatched
+functions decide their own runtime (and can hvd.init() themselves).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..runner.http_kv import KVClient, RendezvousServer, new_secret
+
+__all__ = ["Executor", "WorkerError"]
+
+
+class WorkerError(RuntimeError):
+    """A dispatched function raised on a worker; carries rank + traceback."""
+
+    def __init__(self, rank: int, message: str):
+        super().__init__(f"worker rank {rank} failed:\n{message}")
+        self.rank = rank
+
+
+def _dumps(obj: Any) -> bytes:
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj)
+    except ImportError:
+        return pickle.dumps(obj)
+
+
+class Executor:
+    """Start N persistent workers; run functions on all of them.
+
+    Usage (mirrors ref RayExecutor::
+
+        ex = Executor(num_workers=4)
+        ex.start()
+        results = ex.run(train_fn, args=(cfg,))
+        ex.shutdown()
+
+    Dispatched callables run as ``fn(*args, **kwargs)`` in the worker
+    process with the HVDT_* env contract set, so ``hvd.init()`` inside the
+    function sees the right rank/size.
+    """
+
+    def __init__(self, num_workers: int = 1,
+                 env: Optional[Dict[str, str]] = None,
+                 start_timeout: float = 60.0):
+        self.num_workers = num_workers
+        self._extra_env = dict(env or {})
+        self._timeout = start_timeout
+        self._server: Optional[RendezvousServer] = None
+        self._procs: List[subprocess.Popen] = []
+        self._epoch = 0
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._server = RendezvousServer(secret=new_secret())
+        port = self._server.start()
+        addr = "127.0.0.1"
+        # Workers must be able to unpickle functions defined in modules
+        # the driver imported from non-installed paths (tests, scripts):
+        # propagate the driver's sys.path (ref: ray/spark ship the code
+        # via cloudpickle-by-value / executor archives).
+        py_path = os.pathsep.join(
+            [p for p in sys.path if p] +
+            [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+             if p])
+        for rank in range(self.num_workers):
+            env = dict(os.environ)
+            env.update(self._extra_env)
+            env["PYTHONPATH"] = py_path
+            env.update({
+                "HVDT_RANK": str(rank),
+                "HVDT_SIZE": str(self.num_workers),
+                "HVDT_LOCAL_RANK": str(rank),
+                "HVDT_LOCAL_SIZE": str(self.num_workers),
+                "HVDT_CROSS_RANK": "0",
+                "HVDT_CROSS_SIZE": "1",
+                "HVDT_HOSTNAME": socket.gethostname(),
+                "HVDT_EXEC_ADDR": addr,
+                "HVDT_EXEC_PORT": str(port),
+                "HVDT_EXEC_SECRET": self._server.secret.hex(),
+            })
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horovod_tpu.orchestrate.worker_loop"],
+                env=env))
+        client = self._client()
+        for rank in range(self.num_workers):
+            if client.wait(f"/exec/ready/{rank}",
+                           timeout=self._timeout) is None:
+                self.shutdown()
+                raise TimeoutError(f"worker {rank} did not come up")
+        self._started = True
+
+    def _client(self) -> KVClient:
+        return KVClient("127.0.0.1", self._server.server_address[1],
+                        self._server.secret)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def run(self, fn: Callable, args: Sequence = (),
+            kwargs: Optional[Dict] = None,
+            timeout: float = 600.0) -> List[Any]:
+        """Run ``fn(*args, **kwargs)`` on every worker; rank-ordered
+        results (ref: RayExecutor.run)."""
+        if not self._started:
+            raise RuntimeError("Executor not started")
+        client = self._client()
+        e = self._epoch
+        self._epoch += 1
+        client.put(f"/exec/{e}/fn", _dumps((fn, tuple(args), kwargs or {})))
+        results: List[Any] = [None] * self.num_workers
+        for rank in range(self.num_workers):
+            raw = client.wait(f"/exec/{e}/result/{rank}", timeout=timeout)
+            if raw is None:
+                raise TimeoutError(
+                    f"worker {rank} did not answer call {e}")
+            status, payload = pickle.loads(raw)
+            if status == "err":
+                raise WorkerError(rank, payload)
+            results[rank] = payload
+        return results
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Alias with positional-args convenience (ref: execute)."""
+        return self.run(fn, args=args, kwargs=kwargs)
+
+    def run_single(self, fn: Callable, rank: int = 0,
+                   args: Sequence = (), kwargs: Optional[Dict] = None,
+                   timeout: float = 600.0) -> Any:
+        """Run on one rank only (others no-op; ref: execute_single)."""
+        def gated(*a, **kw):
+            import os as _os
+
+            if int(_os.environ.get("HVDT_RANK", 0)) == rank:
+                return fn(*a, **kw)
+            return None
+
+        return self.run(gated, args=args, kwargs=kwargs,
+                        timeout=timeout)[rank]
+
+    # -- teardown ----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            try:
+                self._client().put(f"/exec/{self._epoch}/stop", b"1")
+            except Exception:
+                pass
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=5)
+        self._procs.clear()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        self._started = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

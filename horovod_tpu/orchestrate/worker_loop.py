@@ -1,0 +1,52 @@
+"""Worker-side loop of the Executor actor pool.
+
+Polls the KV for successive call epochs, executes pickled functions,
+posts results/exceptions (ref: ray/worker.py BaseHorovodWorker.execute —
+same contract over the KV instead of Ray actor RPC).  Deliberately
+imports nothing heavy: dispatched functions own their runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    from ..runner.http_kv import KVClient
+
+    client = KVClient(os.environ["HVDT_EXEC_ADDR"],
+                      int(os.environ["HVDT_EXEC_PORT"]),
+                      bytes.fromhex(os.environ["HVDT_EXEC_SECRET"]))
+    rank = int(os.environ.get("HVDT_RANK", 0))
+    client.put(f"/exec/ready/{rank}", b"1")
+    epoch = 0
+    while True:
+        # Either the next call or the stop sentinel arrives for this epoch.
+        while True:
+            if client.get(f"/exec/{epoch}/stop") is not None:
+                return 0
+            raw = client.get(f"/exec/{epoch}/fn")
+            if raw is not None:
+                break
+            import time
+
+            time.sleep(0.02)
+        try:
+            fn, args, kwargs = pickle.loads(raw)
+            result = ("ok", fn(*args, **kwargs))
+        except BaseException:  # noqa: BLE001 - reported to the driver
+            result = ("err", traceback.format_exc())
+        try:
+            payload = pickle.dumps(result)
+        except Exception:
+            payload = pickle.dumps(("err",
+                                    f"unpicklable result: {result[1]!r}"))
+        client.put(f"/exec/{epoch}/result/{rank}", payload)
+        epoch += 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

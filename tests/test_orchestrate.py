@@ -1,0 +1,109 @@
+"""Orchestrator tests: Executor pool, RayExecutor adapter, JaxEstimator.
+
+Real subprocess workers on localhost — the analog of the reference's
+test/integration tier (test_static_run.py, test_ray.py local-mode runs).
+Worker processes are lightweight (no JAX import unless the dispatched fn
+does), so the pool spins up in ~a second.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.orchestrate import Executor, JaxEstimator, RayExecutor
+from horovod_tpu.orchestrate.executor import WorkerError
+
+
+def _rank_size():
+    return (int(os.environ["HVDT_RANK"]), int(os.environ["HVDT_SIZE"]))
+
+
+def _square(x):
+    return int(os.environ["HVDT_RANK"]) * x
+
+
+def _boom():
+    raise RuntimeError("intentional worker failure")
+
+
+class TestExecutor:
+    def test_run_collects_rank_ordered_results(self):
+        with Executor(num_workers=3, start_timeout=30) as ex:
+            assert ex.run(_rank_size) == [(0, 3), (1, 3), (2, 3)]
+            # Pool is persistent: second dispatch reuses the workers.
+            assert ex.run(_square, args=(10,)) == [0, 10, 20]
+
+    def test_worker_exception_propagates(self):
+        with Executor(num_workers=2, start_timeout=30) as ex:
+            with pytest.raises(WorkerError, match="intentional"):
+                ex.run(_boom)
+            # Pool survives a failed call.
+            assert ex.run(_rank_size) == [(0, 2), (1, 2)]
+
+    def test_run_single(self):
+        with Executor(num_workers=2, start_timeout=30) as ex:
+            assert ex.run_single(_rank_size, rank=1) == (1, 2)
+
+    def test_env_passthrough(self):
+        with Executor(num_workers=1, env={"MY_FLAG": "42"},
+                      start_timeout=30) as ex:
+            out = ex.run(lambda: os.environ.get("MY_FLAG"))
+            assert out == ["42"]
+
+
+def _np_mean(x):
+    return float(np.mean(x) + int(os.environ["HVDT_RANK"]))
+
+
+class TestRayExecutorAdapter:
+    def test_local_fallback_runs(self):
+        ex = RayExecutor(num_workers=2)
+        ex.start()
+        try:
+            assert ex.run(_rank_size) == [(0, 2), (1, 2)]
+            assert ex.execute(_np_mean, np.ones(4)) == [1.0, 2.0]
+        finally:
+            ex.shutdown()
+
+    def test_num_hosts_api(self):
+        ex = RayExecutor(num_hosts=2, num_workers_per_host=2)
+        assert ex.num_workers == 4
+
+    def test_requires_worker_count(self):
+        with pytest.raises(ValueError, match="num_workers"):
+            RayExecutor()
+
+    def test_run_remote_thunk(self):
+        ex = RayExecutor(num_workers=1)
+        ex.start()
+        try:
+            pending = ex.run_remote(_rank_size)
+            assert pending() == [(0, 1)]
+        finally:
+            ex.shutdown()
+
+
+def _fit_linear(x, y, lr=0.5, steps=60):
+    """Closed little least-squares trainer (pure numpy, runs in worker)."""
+    w = np.zeros(x.shape[1], np.float64)
+    for _ in range(steps):
+        grad = x.T @ (x @ w - y) / len(x)
+        w -= lr * grad
+    return w
+
+
+def _predict_linear(w, x):
+    return x @ w
+
+
+class TestJaxEstimator:
+    def test_fit_transform(self):
+        rng = np.random.default_rng(0)
+        true_w = np.array([2.0, -1.0, 0.5])
+        X = rng.normal(size=(240, 3))
+        y = X @ true_w
+        est = JaxEstimator(_fit_linear, _predict_linear, num_workers=2)
+        model = est.fit(X, y, lr=0.5, steps=120)
+        pred = model.transform(X)
+        np.testing.assert_allclose(pred, y, atol=0.2)

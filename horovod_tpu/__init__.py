@@ -93,7 +93,7 @@ def __getattr__(name):
             return Compression
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
-                    "data"):
+                    "data", "native", "orchestrate", "interop"):
             import importlib
 
             return importlib.import_module(f".{name}", __name__)

@@ -1,0 +1,7 @@
+"""Framework interop: collective APIs over non-JAX tensors.
+
+The reference binds TF/PyTorch/MXNet natively (SURVEY.md §2.4); here JAX
+is the first-class citizen and other frameworks interoperate through the
+eager named-collective path (host arrays ride the same negotiation,
+fusion, and data plane).  Available adapters: ``interop.torch``.
+"""

@@ -1,0 +1,154 @@
+"""PyTorch interop: the reference's torch API over the eager core.
+
+Re-conception of ref: horovod/torch/mpi_ops.py + functions.py — the same
+user-facing calls (allreduce/allgather/broadcast/alltoall, async
+variants, broadcast_parameters, broadcast_optimizer_state) accepting
+``torch.Tensor``s.  Tensors cross into the framework as host arrays and
+ride the eager controller's negotiation/fusion and whichever host data
+plane is selected (XLA mesh or the native TCP backend) — there is no
+second C++ binding to maintain (ref needed mpi_ops_v2.cc + adapters;
+here the boundary is numpy's zero-copy view of CPU torch tensors).
+
+Grad hooks for a DistributedOptimizer-style wrapper are torch-side sugar
+over these calls; see examples in the docs.  GPU torch tensors are not
+supported (this is a TPU framework — torch is CPU-only in its world).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+
+__all__ = ["allreduce", "allreduce_async", "allgather", "allgather_async",
+           "broadcast", "broadcast_async", "alltoall", "synchronize",
+           "broadcast_parameters", "broadcast_optimizer_state"]
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def _to_np(t) -> np.ndarray:
+    torch = _torch()
+    if isinstance(t, torch.Tensor):
+        if t.device.type != "cpu":
+            raise ValueError("interop.torch supports CPU tensors only")
+        return t.detach().numpy()
+    return np.asarray(t)
+
+
+def _from_np(a: np.ndarray, like) -> "Any":
+    torch = _torch()
+    return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype)
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op=None,
+                    process_set=None) -> int:
+    from ..ops import eager
+
+    return eager.allreduce_async(_to_np(tensor), average=average, name=name,
+                                 op=op, process_set=process_set)
+
+
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None, op=None, process_set=None):
+    from ..ops import eager
+
+    out = eager.allreduce(_to_np(tensor), average=average, name=name, op=op,
+                          process_set=process_set)
+    return _from_np(np.asarray(out), tensor)
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set=None) -> int:
+    from ..ops import eager
+
+    return eager.allgather_async(_to_np(tensor), name=name,
+                                 process_set=process_set)
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    from ..ops import eager
+
+    out = eager.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _from_np(np.asarray(out), tensor)
+
+
+def broadcast_async(tensor, root_rank: int = 0,
+                    name: Optional[str] = None, process_set=None) -> int:
+    from ..ops import eager
+
+    return eager.broadcast_async(_to_np(tensor), root_rank=root_rank,
+                                 name=name, process_set=process_set)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
+    from ..ops import eager
+
+    out = eager.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                          process_set=process_set)
+    return _from_np(np.asarray(out), tensor)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    from ..ops import eager
+
+    out, recv_splits = eager.alltoall(
+        _to_np(tensor),
+        splits=None if splits is None else _to_np(splits),
+        name=name, process_set=process_set)
+    return _from_np(np.asarray(out), tensor), recv_splits
+
+
+def synchronize(handle: int):
+    """Resolve an async handle to a numpy array (callers re-wrap as torch
+    if needed; ref: mpi_ops.py synchronize)."""
+    from ..ops import eager
+
+    return eager.synchronize(handle)
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=None) -> None:
+    """In-place broadcast of a ``model.state_dict()`` or named_parameters
+    iterable (ref: torch/functions.py:30 broadcast_parameters)."""
+    torch = _torch()
+    if isinstance(params, Mapping):
+        items: Iterable[Tuple[str, Any]] = params.items()
+    else:
+        items = params
+    for name, p in items:
+        if not isinstance(p, torch.Tensor):
+            continue
+        new = broadcast(p, root_rank=root_rank, name=f"param.{name}",
+                        process_set=process_set)
+        with torch.no_grad():
+            p.copy_(new)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0,
+                              process_set=None) -> None:
+    """Broadcast a torch optimizer's state tensors in place
+    (ref: torch/functions.py broadcast_optimizer_state)."""
+    torch = _torch()
+    # Names must be rank-stable: key on (group index, param index, state
+    # key) — id(p) differs per process and would never negotiate
+    # (same convention as functions.py broadcast_parameters.{i}).
+    for gi, group in enumerate(optimizer.param_groups):
+        for pi, p in enumerate(group["params"]):
+            state = optimizer.state.get(p, {})
+            for key, value in sorted(state.items()):
+                if isinstance(value, torch.Tensor):
+                    new = broadcast(value, root_rank=root_rank,
+                                    name=f"opt.{gi}.{pi}.{key}",
+                                    process_set=process_set)
+                    with torch.no_grad():
+                        value.copy_(new)

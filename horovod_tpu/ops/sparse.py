@@ -1,0 +1,103 @@
+"""Sparse (indexed-slices) allreduce — the embedding-gradient path.
+
+Re-conception of ref: torch/mpi_ops.py:556-578 sparse_allreduce_async
+(double allgather of indices and values; average applied to values) and
+the TF IndexedSlices path (tensorflow/__init__.py allreduce with
+sparse_as_dense=False).  A sparse gradient is (indices [nnz],
+values [nnz, ...rest], dense_shape); ranks hold different nnz — the
+eager allgather negotiates the ragged first dim.
+
+Two paths:
+
+* ``sparse_allreduce`` — eager: allgather indices and values across the
+  process set; result keeps duplicate indices (exactly like the
+  reference's concatenated IndexedSlices) plus ``to_dense`` scatter-add.
+* ``sparse_allreduce_jit`` — inside shard_map: fixed-nnz all_gather along
+  a mesh axis, returning concatenated (indices, values) — nnz must be
+  equal per rank under jit (pad with a sentinel row if needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+
+__all__ = ["SparseGradient", "sparse_allreduce", "sparse_allreduce_async",
+           "sparse_allreduce_jit"]
+
+
+@dataclasses.dataclass
+class SparseGradient:
+    """Indexed-slices gradient: ``dense[indices[i]] += values[i]``."""
+
+    indices: np.ndarray          # [nnz] int
+    values: np.ndarray           # [nnz, ...rest]
+    dense_shape: Tuple[int, ...]
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_shape, self.values.dtype)
+        np.add.at(out, np.asarray(self.indices), np.asarray(self.values))
+        return out
+
+
+def sparse_allreduce_async(indices, values, dense_shape,
+                           name: Optional[str] = None,
+                           op: ReduceOp = ReduceOp.AVERAGE,
+                           process_set=None):
+    """Async start; returns a zero-arg resolver (ref: returns ``handle``
+    closure, torch/mpi_ops.py:565-576)."""
+    from . import eager
+
+    # When unnamed, let the controller auto-name each collective with its
+    # deterministic per-process counter — the name must be identical on
+    # every rank for negotiation to match (a process-local id() would
+    # deadlock multi-rank runs).
+    h_idx = eager.allgather_async(np.asarray(indices),
+                                  name=f"{name}.indices" if name else None,
+                                  process_set=process_set)
+    h_val = eager.allgather_async(np.asarray(values),
+                                  name=f"{name}.values" if name else None,
+                                  process_set=process_set)
+
+    def resolve() -> SparseGradient:
+        vals = np.asarray(eager.synchronize(h_val))
+        idx = np.asarray(eager.synchronize(h_idx))
+        if op == ReduceOp.AVERAGE:
+            from ..common import basics
+
+            size = (process_set.size() if process_set is not None
+                    else basics.size())
+            vals = (vals / size).astype(vals.dtype)
+        return SparseGradient(idx, vals, tuple(dense_shape))
+
+    return resolve
+
+
+def sparse_allreduce(indices, values, dense_shape,
+                     name: Optional[str] = None,
+                     op: ReduceOp = ReduceOp.AVERAGE,
+                     process_set=None) -> SparseGradient:
+    return sparse_allreduce_async(indices, values, dense_shape, name=name,
+                                  op=op, process_set=process_set)()
+
+
+def sparse_allreduce_jit(indices, values, axis: str = "dp",
+                         op: ReduceOp = ReduceOp.AVERAGE):
+    """Sparse allreduce under jit/shard_map: equal-nnz all_gather along
+    ``axis``; returns concatenated (indices, values) with values averaged
+    for AVERAGE.  Use a sentinel index (e.g. 0 with zero values) to pad
+    ranks to a common nnz."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    gi = lax.all_gather(indices, axis, tiled=True)
+    gv = lax.all_gather(values, axis, tiled=True)
+    if op == ReduceOp.AVERAGE:
+        gv = gv / lax.axis_size(axis)
+    elif op != ReduceOp.SUM:
+        raise ValueError("sparse allreduce supports SUM/AVERAGE")
+    return gi, gv.astype(jnp.result_type(values))

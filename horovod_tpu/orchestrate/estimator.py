@@ -35,11 +35,8 @@ class JaxModel:
         return self.predict(x)
 
 
-def _worker_fit(train_fn, xs, ys, fit_kwargs):
-    import os
-
-    rank = int(os.environ.get("HVDT_RANK", 0))
-    return train_fn(xs[rank], ys[rank], **fit_kwargs)
+def _worker_fit(train_fn, fit_kwargs, x_shard, y_shard):
+    return train_fn(x_shard, y_shard, **fit_kwargs)
 
 
 class JaxEstimator:
@@ -74,8 +71,10 @@ class JaxEstimator:
         xs, ys = self._shards(x, y)
         with Executor(self.num_workers, env=self._env) as ex:
             # One concurrent dispatch — workers may collectively train
-            # (allreduce etc.), so they must all enter together; each
-            # selects its shard by rank.
+            # (allreduce etc.), so they must all enter together.  Shards
+            # ride per-rank KV keys: each worker downloads only its own.
             results = ex.run(_worker_fit,
-                             args=(self.train_fn, xs, ys, fit_kwargs))
+                             args=(self.train_fn, fit_kwargs),
+                             per_rank_args=[(xs[r], ys[r])
+                                            for r in range(self.num_workers)])
         return JaxModel(results[0], self.predict_fn)

@@ -105,12 +105,36 @@ class Executor:
                 [sys.executable, "-m", "horovod_tpu.orchestrate.worker_loop"],
                 env=env))
         client = self._client()
-        for rank in range(self.num_workers):
-            if client.wait(f"/exec/ready/{rank}",
-                           timeout=self._timeout) is None:
-                self.shutdown()
-                raise TimeoutError(f"worker {rank} did not come up")
+        try:
+            for rank in range(self.num_workers):
+                self._wait_key(client, f"/exec/ready/{rank}", rank,
+                               self._timeout,
+                               f"worker {rank} did not come up")
+        except Exception:
+            self.shutdown()
+            raise
         self._started = True
+
+    def _wait_key(self, client: KVClient, key: str, rank: int,
+                  timeout: float, timeout_msg: str) -> bytes:
+        """Wait for a key in short slices, failing fast if the worker
+        process dies (a crashed worker would otherwise stall the driver
+        for the whole timeout; ref: RayExecutor surfaces actor death)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return client.wait(key, timeout=min(
+                    1.0, max(0.05, deadline - time.monotonic())))
+            except TimeoutError:
+                proc = self._procs[rank] if rank < len(self._procs) else None
+                if proc is not None and proc.poll() is not None:
+                    raise WorkerError(
+                        rank, f"worker process exited with code "
+                              f"{proc.returncode} before answering") from None
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(timeout_msg) from None
 
     def _client(self) -> KVClient:
         return KVClient("127.0.0.1", self._server.server_address[1],
@@ -120,21 +144,35 @@ class Executor:
 
     def run(self, fn: Callable, args: Sequence = (),
             kwargs: Optional[Dict] = None,
-            timeout: float = 600.0) -> List[Any]:
+            timeout: float = 600.0,
+            per_rank_args: Optional[Sequence[Sequence]] = None
+            ) -> List[Any]:
         """Run ``fn(*args, **kwargs)`` on every worker; rank-ordered
-        results (ref: RayExecutor.run)."""
+        results (ref: RayExecutor.run).
+
+        ``per_rank_args``: optional rank-indexed extra positional args,
+        shipped under per-rank KV keys so each worker downloads only its
+        own payload (the data-sharding path — fit() shards ride this).
+        Workers call ``fn(*args, *per_rank_args[rank], **kwargs)``.
+        """
         if not self._started:
             raise RuntimeError("Executor not started")
+        if per_rank_args is not None and len(per_rank_args) != self.num_workers:
+            raise ValueError("per_rank_args must have one entry per worker")
         client = self._client()
         e = self._epoch
         self._epoch += 1
-        client.put(f"/exec/{e}/fn", _dumps((fn, tuple(args), kwargs or {})))
+        if per_rank_args is not None:
+            for rank, extra in enumerate(per_rank_args):
+                client.put(f"/exec/{e}/arg/{rank}", _dumps(tuple(extra)))
+        client.put(f"/exec/{e}/fn",
+                   _dumps((fn, tuple(args), kwargs or {},
+                           per_rank_args is not None)))
         results: List[Any] = [None] * self.num_workers
         for rank in range(self.num_workers):
-            raw = client.wait(f"/exec/{e}/result/{rank}", timeout=timeout)
-            if raw is None:
-                raise TimeoutError(
-                    f"worker {rank} did not answer call {e}")
+            raw = self._wait_key(
+                client, f"/exec/{e}/result/{rank}", rank, timeout,
+                f"worker {rank} did not answer call {e}")
             status, payload = pickle.loads(raw)
             if status == "err":
                 raise WorkerError(rank, payload)

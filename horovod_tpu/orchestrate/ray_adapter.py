@@ -60,19 +60,26 @@ class RayExecutor:
                     "provide num_workers or num_hosts*num_workers_per_host")
         self.settings = settings or Settings()
         self.num_workers = num_workers
-        self.ignored_options = {
-            k: v for k, v in dict(
-                cpus_per_worker=cpus_per_worker, use_gpu=use_gpu,
-                gpus_per_worker=gpus_per_worker,
-                use_current_placement_group=use_current_placement_group,
-                min_workers=min_workers, max_workers=max_workers,
-                reset_limit=reset_limit, elastic_timeout=elastic_timeout,
-                override_discovery=override_discovery).items()
-            if v not in (None, False, True) or k in ()}
+        # Record only options the caller actually changed from their
+        # defaults (placement/elastic knobs have no local-pool meaning).
+        defaults = dict(cpus_per_worker=1, use_gpu=False,
+                        gpus_per_worker=None,
+                        use_current_placement_group=True, min_workers=None,
+                        max_workers=None, reset_limit=None,
+                        elastic_timeout=600, override_discovery=True)
+        passed = dict(cpus_per_worker=cpus_per_worker, use_gpu=use_gpu,
+                      gpus_per_worker=gpus_per_worker,
+                      use_current_placement_group=use_current_placement_group,
+                      min_workers=min_workers, max_workers=max_workers,
+                      reset_limit=reset_limit,
+                      elastic_timeout=elastic_timeout,
+                      override_discovery=override_discovery)
+        self.ignored_options = {k: v for k, v in passed.items()
+                                if v != defaults[k]}
         self._env = env
         self._local: Optional[Executor] = None
         self._ray_workers: List[Any] = []
-        self._use_ray = self._ray_available()
+        self._use_ray = False  # decided at start() — ray.init may be late
 
     @staticmethod
     def _ray_available() -> bool:
@@ -88,6 +95,9 @@ class RayExecutor:
     def start(self, executable_cls: Optional[type] = None,
               executable_args: Sequence = (),
               executable_kwargs: Optional[Dict] = None) -> None:
+        # Ray availability is evaluated HERE, not in __init__ — reference
+        # scripts construct the executor before ray.init().
+        self._use_ray = self._ray_available()
         if self._use_ray:
             self._start_ray(executable_cls, executable_args,
                             executable_kwargs or {})

@@ -35,7 +35,11 @@ def main() -> int:
 
             time.sleep(0.02)
         try:
-            fn, args, kwargs = pickle.loads(raw)
+            fn, args, kwargs, has_per_rank = pickle.loads(raw)
+            if has_per_rank:
+                extra = pickle.loads(
+                    client.wait(f"/exec/{epoch}/arg/{rank}", timeout=30.0))
+                args = tuple(args) + tuple(extra)
             result = ("ok", fn(*args, **kwargs))
         except BaseException:  # noqa: BLE001 - reported to the driver
             result = ("err", traceback.format_exc())

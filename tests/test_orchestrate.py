@@ -107,3 +107,36 @@ class TestJaxEstimator:
         model = est.fit(X, y, lr=0.5, steps=120)
         pred = model.transform(X)
         np.testing.assert_allclose(pred, y, atol=0.2)
+
+
+def _die():
+    os._exit(17)
+
+
+class TestExecutorFailFast:
+    def test_dead_worker_fails_fast_not_timeout(self):
+        import time
+
+        with Executor(num_workers=2, start_timeout=30) as ex:
+            t0 = time.monotonic()
+            with pytest.raises(WorkerError, match="exited with code 17"):
+                ex.run(_die, timeout=120.0)
+            assert time.monotonic() - t0 < 30, "should not wait full timeout"
+
+
+def _take(tag, payload):
+    return (int(os.environ["HVDT_RANK"]), tag, int(np.sum(payload)))
+
+
+class TestPerRankArgs:
+    def test_each_worker_gets_its_shard(self):
+        shards = [np.full(3, r + 1) for r in range(2)]
+        with Executor(num_workers=2, start_timeout=30) as ex:
+            out = ex.run(_take, args=("s",),
+                         per_rank_args=[(s,) for s in shards])
+        assert out == [(0, "s", 3), (1, "s", 6)]
+
+    def test_length_mismatch_raises(self):
+        with Executor(num_workers=2, start_timeout=30) as ex:
+            with pytest.raises(ValueError, match="one entry per worker"):
+                ex.run(_take, per_rank_args=[(1,)])

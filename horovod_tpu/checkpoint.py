@@ -1,0 +1,189 @@
+"""Checkpoint / resume: the rank-0-save + broadcast-on-restart pattern.
+
+The reference ships no checkpoint format of its own — it provides the
+*consistency* primitives (broadcast_parameters/broadcast_optimizer_state,
+rank-0-only Keras BestModelCheckpoint, elastic in-memory commit) and its
+examples do rank-0 torch.save + broadcast on restart
+(ref: SURVEY.md §5.4; examples/pytorch/pytorch_imagenet_resnet50.py).
+
+Here the same pattern becomes a first-class API over Orbax (the
+TPU-native checkpoint store — async, sharding-aware, the thing a JAX
+user expects):
+
+* ``save_checkpoint`` — rank 0 writes the pytree (+ step metadata);
+  everyone barriers so no rank races ahead of a half-written save.
+* ``restore_checkpoint`` — rank 0 reads, then the tree is broadcast to
+  all ranks (multi-host consistency without shared storage).
+* ``CheckpointManager`` — keep-N/interval policy around the above
+  (ref: keras BestModelCheckpoint's save-frequency role).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Optional
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+
+
+def _rank_size():
+    from .common import basics
+
+    if basics.is_initialized():
+        return basics.rank(), basics.size()
+    return 0, 1
+
+
+def _barrier():
+    from .common import basics
+
+    if basics.is_initialized() and basics.size() > 1:
+        from .ops import eager
+
+        eager.barrier()
+
+
+def _checkpointer():
+    """StandardCheckpointer scoped to THIS process only.
+
+    These are rank-0-only saves (the broadcast provides multi-host
+    consistency), so Orbax's default all-process barrier sync must be
+    disabled — with it, rank 0 would block forever waiting for ranks
+    that never call into Orbax."""
+    import orbax.checkpoint as ocp
+
+    rank, _ = _rank_size()
+    return ocp.StandardCheckpointer(
+        multiprocessing_options=ocp.options.MultiprocessingOptions(
+            primary_host=rank, active_processes={rank}))
+
+
+def save_checkpoint(path: str, tree: Any, step: Optional[int] = None,
+                    force: bool = True) -> None:
+    """Rank-0 Orbax save of a pytree; collective barrier on completion.
+
+    ``tree`` may contain jax arrays (pulled to host), numpy arrays, and
+    plain scalars.  ``step`` is stored alongside for resume bookkeeping.
+    """
+    rank, size = _rank_size()
+    if rank == 0:
+        import jax
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        if force and os.path.exists(path):
+            shutil.rmtree(path)
+        payload = {"tree": jax.device_get(tree),
+                   "step": int(step) if step is not None else -1}
+        with _checkpointer() as ckptr:
+            ckptr.save(path, payload)
+    _barrier()
+
+
+def restore_checkpoint(path: str, template: Any,
+                       broadcast: bool = True) -> Any:
+    """Restore a pytree saved by :func:`save_checkpoint`.
+
+    ``template`` supplies the tree structure/shapes/dtypes (abstract or
+    concrete).  With ``broadcast=True`` rank 0 reads and the result is
+    broadcast — the reference's broadcast-on-restart consistency pattern,
+    so only rank 0 needs the file.  Returns ``(tree, step)`` where step
+    is None when absent.
+    """
+    rank, size = _rank_size()
+    tree, step = None, None
+    if rank == 0 or not broadcast:
+        import jax
+        import orbax.checkpoint as ocp
+
+        with _checkpointer() as ckptr:
+            payload = ckptr.restore(
+                os.path.abspath(path),
+                {"tree": jax.device_get(template), "step": 0})
+        tree = payload["tree"]
+        step = None if payload["step"] < 0 else int(payload["step"])
+    if broadcast and size > 1:
+        import numpy as _np
+
+        import jax
+
+        from .functions import broadcast_object, broadcast_parameters
+
+        # Non-root ranks need same-shaped placeholders for the leaf
+        # broadcasts — ship (treedef, step, shapes/dtypes) first.
+        if rank == 0:
+            leaves, treedef = jax.tree.flatten(tree)
+            meta = (treedef, step,
+                    [(_np.asarray(l).shape, _np.asarray(l).dtype.str)
+                     for l in leaves])
+        else:
+            meta = None
+        treedef, step, leaf_meta = broadcast_object(meta, root_rank=0)
+        if rank != 0:
+            leaves = [_np.zeros(shape, dtype=_np.dtype(ds))
+                      for shape, ds in leaf_meta]
+        leaves = broadcast_parameters(leaves, root_rank=0)
+        tree = jax.tree.unflatten(treedef, leaves)
+    return tree, step
+
+
+class CheckpointManager:
+    """Interval + keep-N checkpointing over save/restore.
+
+    ::
+
+        mgr = CheckpointManager("/ckpts", save_interval_steps=100, max_to_keep=3)
+        for step in ...:
+            ...
+            mgr.save(step, {"params": params, "opt": opt_state})
+        tree, step = mgr.restore_latest({"params": params, "opt": opt_state})
+    """
+
+    def __init__(self, directory: str, save_interval_steps: int = 1,
+                 max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.save_interval_steps = max(1, save_interval_steps)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:012d}")
+
+    def all_steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def should_save(self, step: int) -> bool:
+        return step % self.save_interval_steps == 0
+
+    def save(self, step: int, tree: Any, force: bool = False) -> bool:
+        """Save if the interval says so (or force); prunes old steps.
+        Returns True when a checkpoint was written."""
+        if not force and not self.should_save(step):
+            return False
+        save_checkpoint(self._step_dir(step), tree, step=step)
+        rank, _ = _rank_size()
+        if rank == 0:
+            steps = self.all_steps()
+            for old in steps[:-self.max_to_keep]:
+                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+        return True
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore_latest(self, template: Any, broadcast: bool = True):
+        """(tree, step) of the newest checkpoint, or (None, None)."""
+        step = self.latest_step()
+        if step is None:
+            return None, None
+        return restore_checkpoint(self._step_dir(step), template,
+                                  broadcast=broadcast)

@@ -1,0 +1,73 @@
+"""Checkpoint subsystem tests (ref: SURVEY.md §5.4 — rank-0 save +
+broadcast-on-restart pattern, here over Orbax)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.checkpoint import (CheckpointManager, restore_checkpoint,
+                                    save_checkpoint)
+
+
+def _tree():
+    return {"w": jnp.arange(6.0).reshape(2, 3),
+            "b": jnp.ones(3) * 0.5,
+            "nested": {"m": jnp.zeros((4,))}}
+
+
+class TestSaveRestore:
+    def test_roundtrip_with_step(self, hvd, tmp_path):
+        path = os.path.join(tmp_path, "ck")
+        tree = _tree()
+        save_checkpoint(path, tree, step=42)
+        restored, step = restore_checkpoint(path, jax.tree.map(
+            jnp.zeros_like, tree))
+        assert step == 42
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(restored[k]),
+                                       np.asarray(tree[k]))
+        np.testing.assert_allclose(np.asarray(restored["nested"]["m"]),
+                                   np.zeros(4))
+
+    def test_step_none_roundtrips(self, hvd, tmp_path):
+        path = os.path.join(tmp_path, "ck2")
+        save_checkpoint(path, {"x": jnp.ones(2)})
+        restored, step = restore_checkpoint(path, {"x": jnp.zeros(2)})
+        assert step is None
+        np.testing.assert_allclose(np.asarray(restored["x"]), [1.0, 1.0])
+
+    def test_force_overwrites(self, hvd, tmp_path):
+        path = os.path.join(tmp_path, "ck3")
+        save_checkpoint(path, {"x": jnp.ones(2)}, step=1)
+        save_checkpoint(path, {"x": jnp.full(2, 7.0)}, step=2)
+        restored, step = restore_checkpoint(path, {"x": jnp.zeros(2)})
+        assert step == 2
+        np.testing.assert_allclose(np.asarray(restored["x"]), [7.0, 7.0])
+
+
+class TestCheckpointManager:
+    def test_interval_and_keep_n(self, hvd, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "ckpts"),
+                                save_interval_steps=10, max_to_keep=2)
+        tree = {"x": jnp.ones(3)}
+        written = [s for s in range(35) if mgr.save(s, {"x": jnp.ones(3) * s})]
+        assert written == [0, 10, 20, 30]
+        assert mgr.all_steps() == [20, 30]  # pruned to keep-2
+        restored, step = mgr.restore_latest(tree)
+        assert step == 30
+        np.testing.assert_allclose(np.asarray(restored["x"]), [30.0] * 3)
+
+    def test_restore_empty_dir(self, hvd, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "empty"))
+        assert mgr.restore_latest({"x": jnp.zeros(1)}) == (None, None)
+
+    def test_force_save_off_interval(self, hvd, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "f"),
+                                save_interval_steps=100)
+        assert not mgr.save(7, {"x": jnp.ones(1)})
+        assert mgr.save(7, {"x": jnp.ones(1)}, force=True)
+        assert mgr.latest_step() == 7

@@ -46,6 +46,24 @@ def _worker():
     out["alltoall"] = (np.asarray(a2a).tolist(), list(splits))
 
     hvd.barrier()
+
+    # checkpoint: rank-0 save, restore-with-broadcast (only rank 0 has
+    # meaningful data; rank 1 must receive it through the broadcast)
+    import shutil
+
+    from horovod_tpu.checkpoint import restore_checkpoint, save_checkpoint
+
+    ckpath = "/tmp/hvdt_mp_ck_test"
+    if r == 0:
+        shutil.rmtree(ckpath, ignore_errors=True)
+    hvd.barrier()
+    tree = {"w": np.full(3, 5.0, np.float32) if r == 0
+            else np.zeros(3, np.float32)}
+    save_checkpoint(ckpath, tree, step=9)
+    restored, stp = restore_checkpoint(
+        ckpath, {"w": np.zeros(3, np.float32)})
+    out["ckpt"] = (np.asarray(restored["w"]).tolist(), stp)
+
     # grouped + async surface
     h1 = hvd.allreduce_async(np.ones(2, np.float32), name="h1")
     h2 = hvd.allreduce_async(np.full(2, 2.0, np.float32), name="h2")
@@ -73,6 +91,10 @@ def test_two_process_eager_collectives():
         # both ranks contribute identical values -> average is identity
         np.testing.assert_allclose(out["async"][0], [1.0, 1.0])
         np.testing.assert_allclose(out["async"][1], [2.0, 2.0])
+        # rank 1 must have received rank 0's checkpoint via broadcast
+        ck_vals, ck_step = out["ckpt"]
+        np.testing.assert_allclose(ck_vals, [5.0, 5.0, 5.0])
+        assert ck_step == 9
 
 
 def _worker_pickled():

@@ -16,15 +16,14 @@ ranks.  Across N = 2^k ranks it is applied recursively in a binary tree
 
 Two implementations:
 
-* ``adasum_allreduce`` — jit/shard_map path: one ``all_gather`` of the
-  flattened per-rank vectors, then every rank evaluates the identical
-  binary combination tree locally (the tree is unrolled at trace time —
-  rank count is static under jit).  Correctness-first: memory/bandwidth is
-  O(N·G) per device versus the reference's recursive-halving O(G); a
-  reduce-scattered formulation (combination tree on 1/N shards with
-  psum'd scalar dots per level, mirroring the bandwidth shape of
-  nccl_operations.cc:249-517) is the planned optimization once profiled.
-* ``host_adasum`` — eager-path version over host arrays.
+* ``adasum_allreduce`` — jit/shard_map path, sharded formulation:
+  all_to_all distributes shard s of every rank's vector to rank s, the
+  binary combination tree runs on 1/N shards with exact full-vector dots
+  via one batched psum per level, and a psum-embed reassembles — O(G)
+  wire and memory per rank, the bandwidth shape of the reference's
+  recursive halving (nccl_operations.cc:249-517).
+* ``host_adasum`` — eager-path version over host arrays (native C++ VHDD
+  when the TCP backend is active).
 """
 
 from __future__ import annotations
@@ -85,16 +84,30 @@ def host_adasum(flat: np.ndarray, process_set) -> np.ndarray:
 
 
 def adasum_allreduce(x, axis: str = "dp"):
-    """Adasum allreduce inside shard_map/jit over a mesh axis.
+    """Adasum allreduce inside shard_map/jit over a mesh axis — the
+    sharded (reduce-scatter-shaped) formulation.
 
-    Gathers per-rank vectors along the axis (bf16-safe: combination math in
-    f32), then runs the same binary tree as the host path, unrolled (axis
-    size is static under jit).  See the module docstring for the
-    memory/bandwidth caveat vs. the reference's recursive halving.
+    Mirrors the bandwidth shape of the reference's recursive halving
+    (ref: adasum.h FusedAllreduce; AdasumGpuAllreduceOp = local
+    reduce-scatter → cross Adasum → local all-gather):
+
+    1. all_to_all the flattened vector so rank s holds shard s of EVERY
+       rank's gradient — O(G) wire, O(G) memory per rank (the previous
+       all-gather formulation was O(p·G) both).
+    2. run the binary combination tree on the local shards; the
+       dot-products per pair are computed exactly as psums of per-shard
+       partials (one batched psum per tree level, 3 scalars per pair).
+    3. reassemble by zero-embedding each combined shard and psum-ing —
+       one collective that both gathers and restores the VMA-invariant
+       type (device.invariant_allgather_shards).
+
+    bf16-safe: combination math in f32.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    from .device import invariant_allgather_shards
 
     def _one(t):
         n = lax.axis_size(axis)
@@ -103,21 +116,29 @@ def adasum_allreduce(x, axis: str = "dp"):
         orig_shape = t.shape
         orig_dtype = t.dtype
         flat = t.reshape(-1).astype(jnp.float32)
-        # (n, len) on every rank
-        gathered = lax.all_gather(flat, axis)
-        vecs = [gathered[i] for i in range(n)]
+        if n == 1:
+            return flat.reshape(orig_shape).astype(orig_dtype)
+        pad = (-flat.size) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.float32)])
+        chunk = flat.size // n
+        # rows after a2a: row j = rank j's values on MY shard's index range
+        rows = lax.all_to_all(flat.reshape(n, chunk), axis, split_axis=0,
+                              concat_axis=0, tiled=False)
+        vecs = [rows[j] for j in range(n)]
         while len(vecs) > 1:
-            nxt = []
-            for i in range(0, len(vecs), 2):
-                a, b = vecs[i], vecs[i + 1]
-                nxt.append(adasum_pair(a, b, jnp.vdot(a, b), jnp.vdot(a, a),
-                                       jnp.vdot(b, b)))
-            vecs = nxt
-        # Every rank computed the identical tree from the same gathered
-        # data, but VMA typing still marks it varying; pmean is a numeric
-        # identity here and restores the invariant type so downstream
-        # out_specs=P() replication checks pass.
-        out = lax.pmean(vecs[0], axis)
-        return out.reshape(orig_shape).astype(orig_dtype)
+            pairs = [(vecs[i], vecs[i + 1]) for i in range(0, len(vecs), 2)]
+            # exact full-vector dots: psum of per-shard partials, batched
+            # into one collective per tree level
+            partial = jnp.stack([
+                jnp.stack([jnp.vdot(a, b), jnp.vdot(a, a), jnp.vdot(b, b)])
+                for a, b in pairs])                       # [pairs, 3]
+            dots = lax.psum(partial, axis)
+            vecs = [adasum_pair(a, b, dots[k, 0], dots[k, 1], dots[k, 2])
+                    for k, (a, b) in enumerate(pairs)]
+        full = invariant_allgather_shards(vecs[0], axis)
+        if pad:
+            full = full[:-pad]
+        return full.reshape(orig_shape).astype(orig_dtype)
 
     return jax.tree.map(_one, x)

@@ -35,6 +35,8 @@ __all__ = [
     "axis_size",
     "fused_allreduce",
     "fused_allreduce_buckets",
+    "hierarchical_allreduce",
+    "invariant_allgather_shards",
 ]
 
 AxisName = Union[str, Tuple[str, ...]]
@@ -302,3 +304,64 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
             out_leaves[i] = lax.dynamic_slice_in_dim(red, offset, sz).reshape(shape)
             offset += sz
     return jax.tree.unflatten(treedef, out_leaves)
+
+
+def invariant_allgather_shards(shard, axis: AxisName):
+    """Reassemble equal shards into the full vector with an *invariant*
+    result type: each rank zero-embeds its shard at its offset and the
+    full vector is the psum.
+
+    Rationale: every data-moving collective (all_gather/all_to_all/
+    psum_scatter) keeps the varying-manual-axes type, so a pipeline that
+    must end replicated (out_specs=P()) needs a psum-family terminal op;
+    this fuses the gather and the invariance restoration into one
+    allreduce instead of all_gather + identity pmean.
+    shard: [chunk, ...]; returns [axis_size*chunk, ...]."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    chunk = shard.shape[0]
+    full = jnp.zeros((n * chunk,) + shard.shape[1:], shard.dtype)
+    full = lax.dynamic_update_slice_in_dim(full, shard, idx * chunk, axis=0)
+    return lax.psum(full, axis)
+
+
+def hierarchical_allreduce(x, inner_axis: AxisName = "ici",
+                           outer_axis: AxisName = "dcn",
+                           op: ReduceOp = ReduceOp.AVERAGE,
+                           prescale_factor: float = 1.0,
+                           postscale_factor: float = 1.0):
+    """Two-level allreduce: reduce-scatter over the fast inner axis,
+    allreduce the 1/n_inner shard over the slow outer axis, reassemble
+    over inner (ref: NCCLHierarchicalAllreduce — local ncclReduceScatter
+    → cross-node MPI_Allreduce → local ncclAllGather,
+    nccl_operations.cc:249-517).
+
+    On TPU the natural mapping is inner=ICI (within a slice), outer=DCN
+    (between slices): outer-axis wire bytes drop to G/n_inner per chip.
+    XLA's GSPMD often derives this itself for plain psum over both axes;
+    this op makes the schedule explicit and controllable
+    (ref knob: HOROVOD_HIERARCHICAL_ALLREDUCE, common.h:122)."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(f"hierarchical_allreduce supports SUM/AVERAGE, got {op}")
+
+    def _one(t):
+        ni = lax.axis_size(inner_axis)
+        shape, dtype = t.shape, t.dtype
+        flat = jnp.ravel(t)
+        if prescale_factor != 1.0:
+            flat = flat * jnp.asarray(prescale_factor, dtype)
+        pad = (-flat.size) % ni
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros(pad, dtype)])
+        shard = lax.psum_scatter(flat, inner_axis, tiled=True)
+        shard = lax.psum(shard, outer_axis)
+        full = invariant_allgather_shards(shard, inner_axis)
+        if pad:
+            full = full[:-pad]
+        if op == ReduceOp.AVERAGE:
+            full = full / (ni * lax.axis_size(outer_axis))
+        if postscale_factor != 1.0:
+            full = full * jnp.asarray(postscale_factor, full.dtype)
+        return full.reshape(shape).astype(dtype)
+
+    return jax.tree.map(_one, x)

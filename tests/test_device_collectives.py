@@ -181,3 +181,69 @@ def test_broadcast_ignores_nan_on_nonroot(mesh8):
                     lambda t: dev.broadcast(t, root_rank=2, axis="dp"),
                     jnp.asarray(vals))
     np.testing.assert_allclose(np.asarray(out), np.full((8, 2), 7.0))
+
+
+class TestHierarchicalAllreduce:
+    @pytest.mark.parametrize("op_name", ["SUM", "AVERAGE"])
+    def test_matches_flat_allreduce(self, hvd, op_name):
+        """Two-level (2x4 mesh) hierarchical == flat allreduce over both
+        axes (ref: NCCLHierarchicalAllreduce equivalence)."""
+        from horovod_tpu.common.types import ReduceOp
+        from horovod_tpu.ops import device
+        from horovod_tpu.parallel import make_mesh
+
+        op = ReduceOp[op_name]
+        mesh = make_mesh(dp=2, tp=4, devices=jax.devices()[:8])
+
+        # 8 distinct contributions; element count NOT divisible by the
+        # inner axis (exercises padding)
+        xs = jnp.arange(8.0 * 13).reshape(8, 13)
+
+        def local(x):
+            x = x.reshape(13)
+            return device.hierarchical_allreduce(
+                x, inner_axis="tp", outer_axis="dp", op=op)
+
+        got = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=P(("dp", "tp")), out_specs=P())(xs)
+        want = xs.sum(0) if op == ReduceOp.SUM else xs.mean(0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_prescale_postscale(self, hvd):
+        from horovod_tpu.common.types import ReduceOp
+        from horovod_tpu.ops import device
+        from horovod_tpu.parallel import make_mesh
+
+        mesh = make_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+        xs = jnp.ones((4, 4))
+
+        got = jax.shard_map(
+            lambda x: device.hierarchical_allreduce(
+                x.reshape(4), inner_axis="tp", outer_axis="dp",
+                op=ReduceOp.SUM, prescale_factor=2.0,
+                postscale_factor=0.5),
+            mesh=mesh, in_specs=P(("dp", "tp")), out_specs=P())(xs)
+        np.testing.assert_allclose(np.asarray(got), np.full(4, 4.0))
+
+
+class TestShardedAdasum:
+    @pytest.mark.parametrize("count", [64, 61])  # 61: pad path
+    def test_matches_host_tree(self, hvd, count):
+        """The sharded jit Adasum equals the host binary tree on full
+        vectors (exact dots via psum)."""
+        from horovod_tpu.ops.adasum import _np_adasum_tree, adasum_allreduce
+
+        n = 8
+        rng = np.random.default_rng(3)
+        inputs = rng.normal(size=(n, count)).astype(np.float32)
+        mesh = hvd.mesh()
+
+        got = jax.shard_map(
+            lambda x: adasum_allreduce(x.reshape(count), axis="dp"),
+            mesh=mesh, in_specs=P("dp"), out_specs=P())(
+                jnp.asarray(inputs).reshape(n * count))
+        want = _np_adasum_tree(list(inputs))
+        np.testing.assert_allclose(np.asarray(got), want.astype(np.float32),
+                                   rtol=2e-4, atol=2e-5)

@@ -83,6 +83,9 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_LOG_LEVEL", "warning", str,
            "trace|debug|info|warning|error|fatal"),
         _k("HVDT_LOG_HIDE_TIME", False, _parse_bool, "Hide timestamps in log lines."),
+        # --- profiler (ref: HOROVOD_DISABLE_NVTX_RANGES) ---
+        _k("HVDT_DISABLE_PROFILER_RANGES", False, _parse_bool,
+           "Disable jax.profiler TraceAnnotation ranges around eager ops."),
         # --- kernels ---
         _k("HVDT_FLASH_ATTENTION", "auto", str,
            "Pallas flash-attention kernel: auto (TPU only), on, off."),

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import copy
 import functools
+import os
+import pickle
 from typing import Any, Callable, Dict, List, Optional
 
 from .common.basics import is_initialized, rank
@@ -136,7 +138,44 @@ class JaxState(ObjectState):
     Snapshots pull arrays to host memory (`jax.device_get`) so a committed
     state survives device loss; restore pushes them back (the next jitted
     step re-shards them under the then-current mesh).
+
+    ``path``: optional disk location for commits.  Under the launcher's
+    elastic mode the re-rendezvous model is PROCESS RESTART (a compiled
+    XLA world cannot resize in place — SURVEY.md §7 hard parts), so a
+    commit must outlive the process: with ``path`` set, every commit also
+    writes the host-memory snapshot there atomically, and a freshly
+    spawned worker finding the file resumes from it (rank consistency
+    comes from the usual sync() broadcast).
     """
+
+    def __init__(self, path: Optional[str] = None, **kwargs: Any):
+        self._state_path = path
+        super().__init__(**kwargs)
+        if path and os.path.exists(path):
+            self._load_from_disk()
+
+    def _payload_keys(self) -> List[str]:
+        return [k for k in super()._payload_keys() if k != "path"]
+
+    def persist(self) -> None:
+        """Write the committed snapshot to ``path`` (atomic rename)."""
+        if not self._state_path:
+            return
+        tmp = f"{self._state_path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._saved, f)
+        os.replace(tmp, self._state_path)
+
+    def _load_from_disk(self) -> None:
+        with open(self._state_path, "rb") as f:
+            self._saved = pickle.load(f)
+        self.restore()
+        log.info("elastic state resumed from %s", self._state_path)
+
+    def commit(self) -> None:
+        self.save()
+        self.persist()
+        self.check_host_updates()
 
     def _split(self, payload: Dict[str, Any]):
         import jax
@@ -207,12 +246,39 @@ def run(func: Callable) -> Callable:
                 log.info("collective failure — restoring last commit")
                 state.restore()
                 skip_sync = False
+                if _launcher_managed():
+                    _exit_for_respawn(state)
             except HostsUpdatedInterrupt as e:
                 log.info("hosts updated — re-rendezvous without rollback")
                 skip_sync = e.skip_sync
+                if _launcher_managed():
+                    _exit_for_respawn(state)
             _reset(state)
 
     return wrapper
+
+
+def _launcher_managed() -> bool:
+    """True under `hvdtrun --elastic`: the driver owns worker lifecycles
+    and re-rendezvous means PROCESS RESTART (the driver respawns every
+    slot each generation; a fresh process gets the new topology via the
+    env contract and resumes from the disk commit)."""
+    from .common import config
+
+    return (config.get_bool("HVDT_ELASTIC")
+            and bool(config.get_str("HVDT_RENDEZVOUS_ADDR")))
+
+
+def _exit_for_respawn(state: State) -> None:
+    import sys
+
+    from .runner.elastic.driver import RESTART_EXIT_CODE
+
+    persist = getattr(state, "persist", None)
+    if persist is not None:
+        persist()
+    log.info("exiting for respawn under the new generation")
+    sys.exit(RESTART_EXIT_CODE)
 
 
 def _reset(state: State) -> None:

@@ -287,7 +287,7 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
     buckets = fused_allreduce_buckets(leaves, threshold_bytes)
 
     out_leaves: List[Optional[jax.Array]] = [None] * len(leaves)
-    for bucket in buckets:
+    for bi, bucket in enumerate(buckets):
         parts = [leaves[i] for i in bucket]
         shapes = [p.shape for p in parts]
         sizes = [p.size for p in parts]
@@ -296,7 +296,12 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         orig_dtype = flat.dtype
         if wire_dtype is not None and flat.dtype != wire_dtype:
             flat = flat.astype(wire_dtype)
-        red = allreduce(flat, axis, op, prescale_factor, postscale_factor)
+        # Named scope per fused bucket — the jit-trace analog of the
+        # reference's NVTX op ranges; buckets appear as
+        # hvdt.fused_allreduce.bN in XPlane/profiler output.
+        with jax.named_scope(f"hvdt.fused_allreduce.b{bi}"):
+            red = allreduce(flat, axis, op, prescale_factor,
+                            postscale_factor)
         if red.dtype != orig_dtype:
             red = red.astype(orig_dtype)
         offset = 0

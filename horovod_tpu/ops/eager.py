@@ -458,6 +458,23 @@ class EagerController:
         return entries
 
     def _execute_response(self, resp: Response) -> None:
+        # Profiler range per fused response (NVTX analog — ref:
+        # common/nvtx_op_range.h, ranges named by op and batch size;
+        # disable via HVDT_DISABLE_PROFILER_RANGES).  Shows up in
+        # jax.profiler / XPlane traces alongside device activity.
+        from ..common import config
+
+        if not config.get_bool("HVDT_DISABLE_PROFILER_RANGES"):
+            import jax
+
+            label = (f"hvdt.{RequestType(resp.response_type).name}"
+                     f".x{len(resp.tensor_names)}")
+            with jax.profiler.TraceAnnotation(label):
+                self._execute_response_inner(resp)
+            return
+        self._execute_response_inner(resp)
+
+    def _execute_response_inner(self, resp: Response) -> None:
         rt = resp.response_type
         if rt == RequestType.JOIN:
             with self._lock:

@@ -26,9 +26,17 @@ from ..safe_shell_exec import safe_execute
 from .discovery import HostManager
 from .registration import WorkerStateRegistry, READY, SUCCESS, FAILURE
 
-__all__ = ["ElasticDriver", "run_elastic"]
+__all__ = ["ElasticDriver", "run_elastic", "RESTART_EXIT_CODE"]
 
 _DISCOVERY_INTERVAL_S = 1.0
+
+# Worker exit code meaning "ready for the next rendezvous" — the TPU
+# elastic model is process-restart (a compiled XLA world cannot resize
+# in place): workers persist their committed state to disk and exit with
+# this code; the driver respawns every slot under the new generation and
+# the fresh processes resume from the disk commit (see
+# horovod_tpu/elastic.py run()).
+RESTART_EXIT_CODE = 79
 
 
 @dataclasses.dataclass
@@ -54,9 +62,12 @@ class ElasticDriver:
                  spawn_fn: Optional[Callable[..., int]] = None,
                  reset_limit: Optional[int] = None,
                  discovery_interval: float = _DISCOVERY_INTERVAL_S,
-                 kv_server: Optional[RendezvousServer] = None):
+                 kv_server: Optional[RendezvousServer] = None,
+                 hosts_updated_cb: Optional[Callable[[int], None]] = None):
         self._hm = host_manager
         self._kv = kv_server
+        self._hosts_updated_cb = hosts_updated_cb
+        self._pending_updates = 0
         self._min_np = min_np
         self._max_np = max_np or min_np
         self._spawn_fn = spawn_fn or (lambda slot, gen: 0)
@@ -150,6 +161,13 @@ class ElasticDriver:
     def _notify_hosts_updated(self) -> None:
         with self._cond:
             self._cond.notify_all()
+            self._pending_updates += 1
+            n = self._pending_updates
+        # Publish so live workers see the membership change at their next
+        # commit and exit for respawn (the KV replaces the reference's
+        # in-worker notification RPC, runner/elastic/worker.py).
+        if self._hosts_updated_cb is not None:
+            self._hosts_updated_cb(n)
 
     def wait_for_available_slots(self, min_np: int,
                                  timeout: float = 600.0) -> None:
@@ -202,6 +220,11 @@ class ElasticDriver:
         with self._lock:
             if gen != self._generation:
                 return   # stale worker from a previous generation
+        if code == RESTART_EXIT_CODE:
+            # Worker observed a membership change and exited for respawn:
+            # it is READY for the next rendezvous, not failed.
+            self.registry.record_ready(slot.rank)
+            return
         if code == 0:
             self.registry.record_success(slot.rank)
         else:
@@ -275,6 +298,9 @@ def run_elastic(args) -> int:
         server.put_local(f"/rendezvous/{gen}/spec", spec.encode())
         server.put_local("/rendezvous/version", str(gen).encode())
 
+    def hosts_updated_cb(n: int) -> None:
+        server.put_local("/rendezvous/pending", str(n).encode())
+
     def spawn_fn(slot: hosts_mod.SlotInfo, gen: int) -> int:
         from ..launch import _build_command
 
@@ -292,7 +318,8 @@ def run_elastic(args) -> int:
         return safe_execute(cmd, env=env, prefix=prefix)
 
     driver = ElasticDriver(hm, min_np, max_np, spawn_fn,
-                           reset_limit=args.reset_limit)
+                           reset_limit=args.reset_limit,
+                           hosts_updated_cb=hosts_updated_cb)
     try:
         driver.start(rendezvous_cb)
         code = driver.wait()

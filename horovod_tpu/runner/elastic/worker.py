@@ -29,29 +29,45 @@ class WorkerNotificationManager:
         self._lock = threading.Lock()
         self._pending = False
         self._latest: Optional[int] = None
+        self._last_pending: Optional[int] = None
 
     def init(self) -> None:
         if self._client is None and "HVDT_RENDEZVOUS_ADDR" in os.environ:
             self._client = KVClient.from_env()
         if self._generation is None:
             self._generation = int(os.environ.get("HVDT_GENERATION", 0))
+        # Baseline the pending-updates counter: host changes that led to
+        # OUR generation's rendezvous are already accounted for.
+        self._last_pending = self._read_pending()
+
+    def _read_pending(self) -> int:
+        if self._client is None:
+            return 0
+        try:
+            raw = self._client.get("/rendezvous/pending")
+        except (ConnectionError, OSError):
+            return 0
+        return int(raw) if raw is not None else 0
 
     def poll(self) -> bool:
-        """True if the driver published a newer cluster generation."""
+        """True when the driver published a newer generation OR a pending
+        membership change (host added/removed since our rendezvous)."""
         if self._client is None:
             return False
         try:
             raw = self._client.get("/rendezvous/version")
         except (ConnectionError, OSError):
             return False
-        if raw is None:
-            return False
         with self._lock:
-            version = int(raw)
-            newer = version > (self._generation or 0)
-            if newer:
-                self._latest = version
-            self._pending = self._pending or newer
+            if raw is not None:
+                version = int(raw)
+                if version > (self._generation or 0):
+                    self._latest = version
+                    self._pending = True
+            pending_now = self._read_pending()
+            if pending_now > (self._last_pending or 0):
+                self._last_pending = pending_now
+                self._pending = True
             return self._pending
 
     def check_for_updates(self) -> None:

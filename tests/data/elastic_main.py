@@ -1,0 +1,56 @@
+"""Elastic integration training script (ref analog:
+test/integration/data/elastic_torch_main.py): trains to a fixed batch
+count with disk-backed commits, logging "rank size batch" lines so the
+test can assert world-size transitions and progress continuity."""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    log_path = os.environ["ELASTIC_TEST_LOG"]
+    state_path = os.environ["ELASTIC_TEST_STATE"]
+    total_batches = int(os.environ.get("ELASTIC_TEST_BATCHES", "30"))
+    sleep_s = float(os.environ.get("ELASTIC_TEST_SLEEP", "0.25"))
+
+    hvd.init()
+    state = hvd.elastic.JaxState(path=state_path,
+                                 w=np.zeros(4, np.float32), batch=0)
+
+    def log_line(batch):
+        with open(log_path, "a") as f:
+            f.write(f"{hvd.rank()} {hvd.size()} {batch}\n")
+
+    @hvd.elastic.run
+    def train(state):
+        while state.batch < total_batches:
+            g = hvd.allreduce(
+                np.ones(4, np.float32) * (hvd.rank() + 1.0),
+                name="grad")
+            state.w = state.w + np.asarray(g)
+            state.batch += 1
+            log_line(state.batch)
+            if state.batch % 5 == 0:
+                state.commit()   # snapshot + persist + host-update check
+            time.sleep(sleep_s)
+
+    train(state)
+    hvd.shutdown()
+    if hvd.elastic is not None and int(os.environ.get("HVDT_RANK", 0)) == 0:
+        print(f"final: batches={state.batch} w0={float(state.w[0]):.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

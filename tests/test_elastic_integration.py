@@ -1,0 +1,100 @@
+"""Elastic end-to-end: a real `hvdtrun --elastic` run that scales 1 -> 2
+workers mid-training via a scripted discovery schedule (ref:
+test/integration/test_elastic_torch.py + elastic_common.py — hosts
+appear on a timeline; training must continue from the last commit on the
+new world).
+"""
+
+import os
+import stat
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_discovery(tmp_path, control_file):
+    """Discovery script: localhost:1 until the control file appears, then
+    localhost:2 (the scripted schedule, ref elastic_common.py)."""
+    path = os.path.join(tmp_path, "discover.sh")
+    with open(path, "w") as f:
+        f.write(f"""#!/bin/sh
+if [ -f {control_file} ]; then
+  echo "localhost:2"
+else
+  echo "localhost:1"
+fi
+""")
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+    return path
+
+
+@pytest.mark.integration
+def test_elastic_scale_up_mid_training(tmp_path):
+    control = os.path.join(tmp_path, "scale_up_now")
+    discover = _write_discovery(tmp_path, control)
+    log_path = os.path.join(tmp_path, "progress.log")
+    state_path = os.path.join(tmp_path, "state.pkl")
+
+    env = dict(os.environ)
+    env.update({
+        "ELASTIC_TEST_LOG": log_path,
+        "ELASTIC_TEST_STATE": state_path,
+        "ELASTIC_TEST_BATCHES": "30",
+        "ELASTIC_TEST_SLEEP": "0.25",
+        "PYTHONPATH": REPO + os.pathsep + env_get(env, "PYTHONPATH"),
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "--min-np", "1", "--max-np", "2",
+         "--host-discovery-script", discover,
+         "--coordinator-port", "29731",
+         "--", sys.executable, os.path.join(REPO, "tests", "data",
+                                            "elastic_main.py")],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    # Let the single-worker phase make progress past one commit, then
+    # flip the discovery schedule to two hosts.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if os.path.exists(log_path) and len(_lines(log_path)) >= 6:
+            break
+        time.sleep(0.2)
+    else:
+        proc.kill()
+        pytest.fail("single-worker phase made no progress")
+    open(control, "w").write("go")
+
+    try:
+        out, _ = proc.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        pytest.fail(f"elastic run hung:\n{out.decode()[-3000:]}")
+    assert proc.returncode == 0, out.decode()[-3000:]
+
+    rows = [tuple(map(int, ln.split())) for ln in _lines(log_path)]
+    sizes = {size for _, size, _ in rows}
+    assert sizes == {1, 2}, f"expected a 1->2 transition, saw sizes {sizes}"
+    # Progress continuity: first batch logged by the 2-world must resume
+    # from a committed point (> 0 — not a cold start), and training must
+    # reach the target on the new world.
+    first_two_world_batch = next(b for _, size, b in rows if size == 2)
+    assert first_two_world_batch > 1, "scale-up restarted from scratch"
+    assert max(b for _, _, b in rows) == 30
+    # Both ranks of the new world logged.
+    assert {r for r, size, _ in rows if size == 2} == {0, 1}
+
+
+def env_get(env, key):
+    return env.get(key, "")
+
+
+def _lines(path):
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]

@@ -1,0 +1,36 @@
+"""Hermetic CPU-only child environments for driver entry points.
+
+Single source of truth for the accelerator env scrub used by
+``bench.py`` and ``__graft_entry__.py`` (both live at the repo root and
+must not import the framework — their parent processes stay JAX-free).
+
+A CPU child must drop every var that selects a JAX platform OR that
+makes an accelerator site-hook (tunnelled-TPU PJRT plugin registration
+at interpreter startup) do remote work: if the tunnel/relay is
+unhealthy, a child that keeps those vars hangs before executing a
+single line of our code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+PLATFORM_VARS = ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "XLA_FLAGS")
+ACCEL_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_", "LIBTPU", "PJRT_")
+
+
+def scrubbed_cpu_env(host_device_count: Optional[int] = None,
+                     base: Optional[Dict[str, str]] = None
+                     ) -> Dict[str, str]:
+    """Copy of ``base`` (default os.environ) pinned to the CPU platform
+    with every accelerator-steering var removed; optionally forces
+    ``host_device_count`` virtual CPU devices."""
+    src = os.environ if base is None else base
+    env = {k: v for k, v in src.items()
+           if k not in PLATFORM_VARS and not k.startswith(ACCEL_PREFIXES)}
+    env["JAX_PLATFORMS"] = "cpu"
+    if host_device_count is not None:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={host_device_count}")
+    return env

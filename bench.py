@@ -1,12 +1,19 @@
 """Synthetic ResNet-50 training benchmark — the reference's headline harness.
 
 Equivalent of ref: examples/pytorch/pytorch_synthetic_benchmark.py (ResNet-50,
-bs=32, images/sec; SURVEY.md §6) re-built TPU-native: bf16 compute, NHWC,
-jitted train step with donated params, synthetic ImageNet-shaped data.
+images/sec; SURVEY.md §6) re-built TPU-native: bf16 compute, NHWC, jitted
+train step with donated params, synthetic ImageNet-shaped data, MFU from the
+compiled step's XLA cost analysis.
 
-Prints ONE JSON line:
+Robustness contract (the driver runs ``python bench.py`` unattended):
+the parent process NEVER imports JAX.  It runs the measurement in a child
+subprocess with a hard timeout, retries backend init with backoff (tunnelled
+TPU backends can be transiently unavailable), falls back to a small CPU run
+if the accelerator never comes up, and ALWAYS prints exactly one JSON line:
+
   {"metric": "resnet50_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec/chip", "vs_baseline": N}
+   "unit": "images/sec/chip", "vs_baseline": N, "platform": ...,
+   "device_kind": ..., "mfu": ..., ...}
 
 Baseline: the reference's only published per-device synthetic number —
 1656.82 images/sec over 16 P100s (ResNet-101, docs/benchmarks.rst:27-43) =
@@ -16,26 +23,50 @@ Baseline: the reference's only published per-device synthetic number —
 from __future__ import annotations
 
 import argparse
-import functools
 import json
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_S_PER_DEVICE = 1656.82 / 16.0
+METRIC = "resnet50_images_per_sec_per_chip"
+UNIT = "images/sec/chip"
+
+# bf16 peak TFLOP/s by TPU generation (device_kind substring, lowercase).
+_PEAK_FLOPS = (
+    ("v6", 918e12), ("trillium", 918e12), ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
+)
 
 
-def main() -> None:
+def _peak_for(device_kind: str):
+    dk = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in dk:
+            return peak
+    return None
+
+
+def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-iters", type=int, default=5)
     ap.add_argument("--num-batches-per-iter", type=int, default=10)
-    ap.add_argument("--num-warmup", type=int, default=3)
-    args = ap.parse_args()
+    ap.add_argument("--num-warmup", type=int, default=2)
+    ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
+    return ap.parse_args(argv)
 
+
+def _run_child(args) -> None:
+    """Measurement process: import JAX, run the benchmark, print JSON."""
     import jax
     import jax.numpy as jnp
     import optax
+    import functools
+    import numpy as np
 
     from horovod_tpu.models import ResNetConfig, resnet50_init, resnet_loss
 
@@ -62,34 +93,125 @@ def main() -> None:
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
 
     t0 = time.perf_counter()
+    compiled = step.lower(params, stats, opt_state, images, labels).compile()
+    print(f"compile: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    try:
+        flops_per_step = float(compiled.cost_analysis()["flops"])
+    except Exception:
+        # Analytic fallback: ~3x forward FLOPs for training ResNet-50.
+        flops_per_step = 3 * 4.1e9 * args.batch_size
+
+    t0 = time.perf_counter()
     for _ in range(args.num_warmup):
-        params, stats, opt_state, loss = step(params, stats, opt_state,
-                                              images, labels)
+        params, stats, opt_state, loss = compiled(params, stats, opt_state,
+                                                  images, labels)
     jax.block_until_ready(params)
-    print(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s",
-          file=sys.stderr)
+    print(f"warmup: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     rates = []
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
-            params, stats, opt_state, loss = step(params, stats, opt_state,
-                                                  images, labels)
+            params, stats, opt_state, loss = compiled(
+                params, stats, opt_state, images, labels)
         jax.block_until_ready(params)
         dt = time.perf_counter() - t0
         rates.append(args.batch_size * args.num_batches_per_iter / dt)
 
-    import numpy as np
-
     value = float(np.mean(rates))
+    peak = _peak_for(dev.device_kind)
+    mfu = (value / args.batch_size) * flops_per_step / peak if peak else None
     print(f"img/sec per iter: {[round(r, 1) for r in rates]} "
-          f"(+-{float(np.std(rates)):.1f}); final loss {float(loss):.3f}",
-          file=sys.stderr)
+          f"(+-{float(np.std(rates)):.1f}); final loss {float(loss):.3f}; "
+          f"flops/step {flops_per_step:.3e}", file=sys.stderr)
     print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(value, 2),
-        "unit": "images/sec/chip",
+        "unit": UNIT,
         "vs_baseline": round(value / BASELINE_IMG_S_PER_DEVICE, 3),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch_size": args.batch_size,
+    }))
+
+
+def _spawn(child_args, timeout_s, cpu_only=False):
+    """Run this script in child mode; return (ok, json_line_or_None, note)."""
+    if cpu_only:
+        from _hermetic import scrubbed_cpu_env
+
+        env = scrubbed_cpu_env()
+    else:
+        env = dict(os.environ)
+    cmd = [sys.executable, os.path.abspath(__file__), "--_child"] + child_args
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return False, None, f"child timed out after {timeout_s}s"
+    sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            return proc.returncode == 0, line, ""
+    tail = (proc.stderr or proc.stdout or "")[-600:]
+    return False, None, f"child rc={proc.returncode}: {tail}"
+
+
+def main() -> None:
+    args = _parse_args()
+    if args._child:
+        _run_child(args)
+        return
+
+    base = ["--batch-size", str(args.batch_size),
+            "--image-size", str(args.image_size),
+            "--num-iters", str(args.num_iters),
+            "--num-batches-per-iter", str(args.num_batches_per_iter),
+            "--num-warmup", str(args.num_warmup)]
+
+    # Phase 1: accelerator attempts with backoff (tunnelled backends can be
+    # transiently down; a hung init is bounded by the child timeout).
+    attempt_timeouts = [
+        int(t) for t in os.environ.get(
+            "HVDT_BENCH_ATTEMPT_TIMEOUTS", "300,180").split(",")]
+    notes = []
+    for i, to in enumerate(attempt_timeouts):
+        ok, line, note = _spawn(base, to)
+        if ok and line:
+            print(line)
+            return
+        notes.append(f"attempt{i}: {note}")
+        print(f"bench attempt {i} failed: {note}", file=sys.stderr)
+        time.sleep(10)
+
+    # Phase 2: small CPU fallback so the driver still records a real
+    # measurement (clearly marked platform=cpu).
+    cpu_args = ["--batch-size", "8", "--image-size", str(args.image_size),
+                "--num-iters", "1", "--num-batches-per-iter", "2",
+                "--num-warmup", "1"]
+    ok, line, note = _spawn(cpu_args,
+                            int(os.environ.get("HVDT_BENCH_CPU_TIMEOUT",
+                                               "600")), cpu_only=True)
+    if ok and line:
+        d = json.loads(line)
+        d["error"] = "accelerator unavailable; CPU fallback — " + \
+            "; ".join(notes)
+        print(json.dumps(d))
+        return
+
+    notes.append(f"cpu-fallback: {note}")
+    # Phase 3: diagnostics-only JSON — still one parseable line.
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
+        "platform": None, "device_kind": None, "mfu": None,
+        "error": "; ".join(notes)[-1500:],
     }))
 
 

@@ -1,0 +1,157 @@
+"""Allreduce bus-bandwidth microbenchmark — BASELINE.md's primary metric.
+
+The reference's headline numbers are allreduce scaling efficiency measured
+with dedicated benchmark harnesses (ref: docs/benchmarks.rst:8-43; the
+synthetic harnesses :64-80).  This sweeps message sizes through the
+data-plane allreduce on the dp mesh and reports, per size:
+
+* ``algbw`` — algorithm bandwidth: message bytes / op time;
+* ``busbw`` — bus bandwidth: ``algbw * 2(n-1)/n``, the ring-allreduce
+  wire-traffic accounting, comparable across device counts (the
+  convention the reference's NCCL-based numbers use).
+
+Paths measured:
+
+* ``jit`` (default) — the XLA device collective (``psum`` over the dp
+  mesh axis), i.e. what ``DistributedOptimizer``'s fused gradient
+  allreduce lowers to.  On multi-chip TPU this rides ICI.
+* ``eager`` (``--eager``) — the negotiated eager path
+  (``hvd.allreduce``), measuring the full controller+data-plane
+  round trip per op (the reference's per-op latency analog).
+
+Runs anywhere: 8-device CPU sim for correctness/CI, a TPU slice for real
+numbers.  Prints one human line per size and a final JSON summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024:
+            return f"{n:.0f}{unit}"
+        n /= 1024
+    return f"{n:.0f}TiB"
+
+
+def bench_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
+              warmup: int):
+    """Per-op seconds for a chained psum allreduce of ``nbytes``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.devices.size
+    count = max(1, nbytes // jnp.dtype(dtype).itemsize)
+    x = jax.device_put(
+        jnp.ones((n, count), dtype),
+        NamedSharding(mesh, P("dp")))
+
+    def body(xl):
+        # inner chained allreduces per call amortize dispatch overhead;
+        # the 1/n rescale keeps values bounded AND makes each iteration
+        # depend on the last (no overlap/elision).
+        def one(_, acc):
+            red = lax.psum(acc, "dp") * (1.0 / n)
+            # psum output is replicated; pcast back to varying so the
+            # fori_loop carry type is stable.
+            return lax.pcast(red, ("dp",), to="varying")
+        return lax.fori_loop(0, inner, one, xl)
+
+    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                              out_specs=P("dp")))
+    for _ in range(warmup):
+        jax.block_until_ready(f(x))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        times.append((time.perf_counter() - t0) / inner)
+    return min(times)
+
+
+def bench_eager(hvd, nbytes: int, dtype, iters: int, warmup: int):
+    """Per-op seconds for the negotiated eager allreduce path."""
+    import numpy as np
+
+    count = max(1, nbytes // np.dtype(dtype).itemsize)
+    x = np.ones((count,), dtype)
+    for i in range(warmup):
+        hvd.allreduce(x, name=f"bw_warm_{nbytes}_{i}")
+    times = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(hvd.allreduce(x, name=f"bw_{nbytes}_{i}"))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-bytes", type=int, default=1 << 12)
+    ap.add_argument("--max-bytes", type=int, default=1 << 26)
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--inner", type=int, default=10,
+                    help="chained allreduces per timed call (jit path)")
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--eager", action="store_true",
+                    help="also measure the negotiated eager path")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n = mesh.devices.size
+    dev = jax.devices()[0]
+    print(f"# allreduce sweep on {n}x {dev.platform}:{dev.device_kind} "
+          f"(busbw = algbw * 2(n-1)/n)", file=sys.stderr)
+
+    rows = []
+    size = args.min_bytes
+    factor = 2.0 * (n - 1) / n if n > 1 else 1.0
+    while size <= args.max_bytes:
+        t_jit = bench_jit(mesh, size, args.dtype, args.inner, args.iters,
+                          args.warmup)
+        row = {"bytes": size, "jit_algbw_gbps": size / t_jit / 1e9,
+               "jit_busbw_gbps": size / t_jit * factor / 1e9,
+               "jit_us": t_jit * 1e6}
+        if args.eager:
+            t_e = bench_eager(hvd, size, args.dtype,
+                              max(3, args.iters // 2), 1)
+            row["eager_algbw_gbps"] = size / t_e / 1e9
+            row["eager_us"] = t_e * 1e6
+        rows.append(row)
+        msg = (f"{_fmt_bytes(size):>8}  jit {row['jit_us']:>10.1f}us "
+               f"algbw {row['jit_algbw_gbps']:>8.2f} GB/s "
+               f"busbw {row['jit_busbw_gbps']:>8.2f} GB/s")
+        if args.eager:
+            msg += (f"   eager {row['eager_us']:>10.1f}us "
+                    f"algbw {row['eager_algbw_gbps']:>8.2f} GB/s")
+        print(msg, file=sys.stderr)
+        size *= 4
+
+    peak = max(rows, key=lambda r: r["jit_busbw_gbps"])
+    print(json.dumps({
+        "metric": "allreduce_peak_busbw_gbps",
+        "value": round(peak["jit_busbw_gbps"], 3),
+        "unit": "GB/s",
+        "n_devices": n,
+        "platform": dev.platform,
+        "at_bytes": peak["bytes"],
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,12 @@ local devices via shard_map.
 
 Single chip (or CPU sim):
     python examples/jax_synthetic_benchmark.py --num-iters 3
+
+Scaling efficiency (the reference's headline metric — ref:
+docs/benchmarks.rst:8-43, the 90%/68% @512-GPU table):
+    python examples/jax_synthetic_benchmark.py --scaling-efficiency
+measures rate(1) on one device and rate(n) dp-sharded over the whole
+mesh, reporting ``rate(n) / (n * rate(1))``.
 """
 
 import argparse
@@ -17,7 +23,7 @@ import time
 import numpy as np
 
 
-def main():
+def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "mlp", "transformer"])
@@ -31,8 +37,16 @@ def main():
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument("--no-shard", action="store_true",
                    help="single-device step (no dp axis)")
-    args = p.parse_args()
+    p.add_argument("--scaling-efficiency", action="store_true",
+                   help="measure rate(n)/(n*rate(1)) over the dp mesh")
+    p.add_argument("--autotune", action="store_true",
+                   help="drive the fusion-knob autotuner from measured "
+                        "step rates (ref: HOROVOD_AUTOTUNE)")
+    return p.parse_args(argv)
 
+
+def measure(args, use_shard: bool, quiet: bool = False) -> float:
+    """One full benchmark run; returns mean images(samples)/sec total."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -40,9 +54,8 @@ def main():
 
     import horovod_tpu as hvd
 
-    hvd.init()
     mesh = hvd.mesh()
-    n_dev = 1 if args.no_shard else mesh.devices.size
+    n_dev = mesh.devices.size if use_shard else 1
     global_batch = args.batch_size * n_dev
 
     key = jax.random.PRNGKey(0)
@@ -81,39 +94,54 @@ def main():
         labels = jnp.zeros((global_batch,), jnp.int32)
         loss_fn = mlp_loss
 
-    opt = hvd.DistributedOptimizer(
-        optax.sgd(0.01, momentum=0.9),
-        op=hvd.Adasum if args.use_adasum else hvd.Average,
-        compression=(hvd.Compression.bf16 if args.fp16_allreduce
-                     else hvd.Compression.none))
-    opt_state = opt.init(params)
+    def build_step(threshold_bytes=None):
+        """(Re-)jit the train step for a fusion-bucket threshold — the
+        autotuner's 'apply' operation (thresholds are trace-time
+        constants under XLA)."""
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.01, momentum=0.9),
+            op=hvd.Adasum if args.use_adasum else hvd.Average,
+            compression=(hvd.Compression.bf16 if args.fp16_allreduce
+                         else hvd.Compression.none),
+            threshold_bytes=threshold_bytes)
 
-    def local_step(params, opt_state, xb, yb):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, xb, yb))(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        if not args.no_shard:
-            loss = jax.lax.pmean(loss, "dp")
-        return optax.apply_updates(params, updates), opt_state, loss
+        def local_step(params, opt_state, xb, yb):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_fn(p, xb, yb))(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            if use_shard:
+                loss = jax.lax.pmean(loss, "dp")
+            return optax.apply_updates(params, updates), opt_state, loss
 
-    if args.no_shard:
-        step = jax.jit(local_step, donate_argnums=(0, 1))
-    else:
-        step = jax.jit(jax.shard_map(
+        if not use_shard:
+            return opt, jax.jit(local_step, donate_argnums=(0, 1))
+        return opt, jax.jit(jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(P(), P(), P("dp"), P() if labels is None else P("dp")),
             out_specs=(P(), P(), P())),
             donate_argnums=(0, 1))
+
+    opt, step = build_step()
+    opt_state = opt.init(params)
+    if use_shard:
         data = jax.device_put(data, NamedSharding(mesh, P("dp")))
         if labels is not None:
             labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
 
     dev = jax.devices()[0]
-    if hvd.rank() == 0:
+    verbose = hvd.rank() == 0 and not quiet
+    if verbose:
         print(f"Model: {args.model}")
         print(f"Batch size: {global_batch} ({args.batch_size}/device, "
               f"{n_dev} devices)")
         print(f"Device: {dev.platform}:{dev.device_kind}")
+
+    autotuner = None
+    if args.autotune and use_shard:
+        from horovod_tpu.autotune import BenchmarkAutotuner
+
+        autotuner = BenchmarkAutotuner(
+            tree_example=params, steps_per_sample=args.num_batches_per_iter)
 
     def run_batches(n):
         nonlocal params, opt_state
@@ -129,14 +157,51 @@ def main():
         run_batches(args.num_batches_per_iter)
         dt = time.perf_counter() - t0
         rate = global_batch * args.num_batches_per_iter / dt
-        if hvd.rank() == 0:
+        if verbose:
             print(f"Iter #{i}: {rate:.1f} img/sec total")
+        if autotuner is not None and autotuner.record(
+                dt, steps=args.num_batches_per_iter):
+            _, step = build_step(autotuner.bucket_bytes)
+            if verbose:
+                print(f"  autotune -> bucket "
+                      f"{autotuner.bucket_bytes // 2**20} MiB")
         img_secs.append(rate)
 
-    if hvd.rank() == 0:
+    if verbose:
         mean, std = np.mean(img_secs), np.std(img_secs)
         print(f"Img/sec total: {mean:.1f} +- {1.96 * std:.1f}")
         print(f"Img/sec/device: {mean / n_dev:.1f}")
+    if autotuner is not None and verbose:
+        print(f"Autotune: {autotuner.summary()}")
+    return float(np.mean(img_secs))
+
+
+def main():
+    args = parse_args()
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if not args.scaling_efficiency:
+        measure(args, use_shard=not args.no_shard)
+        return
+
+    n = hvd.mesh().devices.size
+    rate1 = measure(args, use_shard=False, quiet=True)
+    raten = measure(args, use_shard=True, quiet=True)
+    eff = raten / (n * rate1) if n and rate1 else 0.0
+    if hvd.rank() == 0:
+        print(f"rate(1)     : {rate1:.1f} samples/sec")
+        print(f"rate({n})    : {raten:.1f} samples/sec "
+              f"({raten / n:.1f}/device)")
+        print(f"scaling efficiency rate({n})/({n}*rate(1)) = {eff:.3f}")
+        import json
+
+        print(json.dumps({"metric": "scaling_efficiency",
+                          "value": round(eff, 4), "n_devices": n,
+                          "model": args.model,
+                          "rate1": round(rate1, 2),
+                          "raten": round(raten, 2)}))
 
 
 if __name__ == "__main__":

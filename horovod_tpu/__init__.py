@@ -91,6 +91,12 @@ def __getattr__(name):
             from .ops.compression import Compression
 
             return Compression
+        if name == "run":
+            # Programmatic launcher (ref: horovod/runner/__init__.py:210
+            # hvd.run) — run a function on np workers, results by rank.
+            from .runner import run
+
+            return run
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
                     "data", "native", "orchestrate", "interop"):

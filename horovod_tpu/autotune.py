@@ -35,7 +35,8 @@ from .common.logging_util import get_logger
 
 log = get_logger(__name__)
 
-__all__ = ["GaussianProcess", "BayesianOptimizer", "ParameterManager"]
+__all__ = ["GaussianProcess", "BayesianOptimizer", "ParameterManager",
+           "BenchmarkAutotuner"]
 
 
 class GaussianProcess:
@@ -249,3 +250,95 @@ class ParameterManager:
                      f"{s.score:.1f}"])
         except OSError as e:
             log.warning("autotune log write failed: %s", e)
+
+
+class BenchmarkAutotuner:
+    """Closed-loop driver tying :class:`ParameterManager` to a train loop.
+
+    The reference's autotuner is closed-loop: measured step throughput
+    feeds the Bayesian optimizer, the winning parameters are synchronized
+    across ranks, and the fusion pipeline actually uses them
+    (ref: common/parameter_manager.cc Update/SynchronizeParameters,
+    operations.cc:793-800).  This is that loop for the jit path::
+
+        tuner = BenchmarkAutotuner(tree_example=params)
+        step = build_step(threshold_bytes=tuner.bucket_bytes)
+        for ...:
+            t0 = time.perf_counter(); run_n_steps(k)
+            if tuner.record(time.perf_counter() - t0, steps=k):
+                step = build_step(threshold_bytes=tuner.bucket_bytes)
+
+    ``record`` returns True when the knobs changed — the caller re-jits
+    its step with the new ``bucket_bytes`` (the fusion threshold is a
+    trace-time constant under XLA, so "apply" = re-jit; compile cost is
+    absorbed by the next sample and the warmup discards).
+
+    Cross-rank sync: when knobs change, rank 0's choice is broadcast
+    through the eager control plane KV and adopted everywhere, so every
+    rank always jits the same bucketing (the SynchronizeParameters
+    analog).  Single-process runs use the Local plane (no-op).
+    """
+
+    def __init__(self, tree_example, steps_per_sample: Optional[int] = None,
+                 pm: Optional[ParameterManager] = None,
+                 control_plane=None):
+        self.pm = pm or ParameterManager(steps_per_sample=steps_per_sample)
+        self._grad_bytes = float(sum(
+            np.prod(getattr(l, "shape", ())) * np.dtype(l.dtype).itemsize
+            for l in _tree_leaves(tree_example)))
+        self._cp = control_plane
+        self._sync_cycle = 0
+
+    @property
+    def bucket_bytes(self) -> int:
+        return self.pm.bucket_bytes
+
+    @property
+    def done(self) -> bool:
+        return self.pm.tuning_complete
+
+    def record(self, seconds: float, steps: int = 1) -> bool:
+        """Feed ``steps`` steps that took ``seconds`` total; True when the
+        knobs changed and the caller should re-jit."""
+        changed = False
+        per = seconds / max(1, steps)
+        for _ in range(steps):
+            changed = self.pm.record(self._grad_bytes, per) or changed
+        if changed:
+            self._sync()
+        return changed
+
+    def _sync(self) -> None:
+        """Adopt rank 0's knob point everywhere (KV broadcast)."""
+        cp = self._cp
+        if cp is None:
+            from .common import basics
+            from .ops.control_plane import (LocalControlPlane,
+                                            default_control_plane)
+
+            # Un-initialized framework == single process: nothing to sync.
+            self._cp = cp = (default_control_plane()
+                             if basics.is_initialized()
+                             else LocalControlPlane())
+        if cp.size() <= 1:
+            return
+        self._sync_cycle += 1
+        payload = None
+        if cp.rank() == 0:
+            payload = ",".join(f"{v:.6f}" for v in self.pm._current)
+        wire = cp.broadcast(payload, cycle=10_000_000 + self._sync_cycle)
+        point = np.array([float(v) for v in wire.split(",")])
+        self.pm._current = point
+        self.pm._sample = _Sample(point)
+
+    def summary(self) -> str:
+        state = "converged" if self.done else "tuning"
+        return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
+                f"overlap={self.pm.overlap_buckets} "
+                f"({self.pm._samples_done} samples)")
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)

@@ -27,6 +27,19 @@ from typing import Any, Optional
 __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
 
 
+def _named_dtype(name: str):
+    """np.dtype from a dtype *name*, covering ml_dtypes extended types
+    (bfloat16/float8_*) that plain ``np.dtype(name)`` doesn't know."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # ships with jax
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _rank_size():
     from .common import basics
 
@@ -111,18 +124,22 @@ def restore_checkpoint(path: str, template: Any,
         from .functions import broadcast_object, broadcast_parameters
 
         # Non-root ranks need same-shaped placeholders for the leaf
-        # broadcasts — ship (treedef, step, shapes/dtypes) first.
+        # broadcasts — ship (treedef, step, shapes/dtypes) first.  Dtypes
+        # travel by NAME, not dtype.str: for ml_dtypes types (bfloat16 —
+        # the standard TPU training dtype — fp8 variants, ...) dtype.str
+        # is an opaque '<V2' that round-trips to a raw void dtype and
+        # breaks the collective broadcast.
         if rank == 0:
             leaves, treedef = jax.tree.flatten(tree)
             meta = (treedef, step,
-                    [(_np.asarray(l).shape, _np.asarray(l).dtype.str)
+                    [(_np.asarray(l).shape, _np.asarray(l).dtype.name)
                      for l in leaves])
         else:
             meta = None
         treedef, step, leaf_meta = broadcast_object(meta, root_rank=0)
         if rank != 0:
-            leaves = [_np.zeros(shape, dtype=_np.dtype(ds))
-                      for shape, ds in leaf_meta]
+            leaves = [_np.zeros(shape, dtype=_named_dtype(name))
+                      for shape, name in leaf_meta]
         leaves = broadcast_parameters(leaves, root_rank=0)
         tree = jax.tree.unflatten(treedef, leaves)
     return tree, step

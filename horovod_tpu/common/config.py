@@ -96,9 +96,15 @@ KNOBS: Dict[str, Knob] = {
            "mesh) or 'tcp' (native C++ socket-mesh backend, the Gloo analog)."),
         _k("HVDT_TCP_ADDRS", "", str,
            "Rank-ordered host:port list for the native TCP backend (set by "
-           "the launcher; process set k listens on port+k)."),
+           "the launcher when HVDT_CPU_OPERATIONS=tcp; process set k "
+           "listens on port + k*HVDT_TCP_SET_PORT_STRIDE)."),
         _k("HVDT_TCP_TIMEOUT_MS", 30000, int,
            "Connect timeout for the native TCP backend mesh bootstrap."),
+        _k("HVDT_TCP_SET_PORT_STRIDE", 128, int,
+           "Port stride between process sets' socket meshes. All base "
+           "ports on one host must live in a contiguous block smaller "
+           "than this stride, so per-set listener ports (base + "
+           "set_id*stride) never collide with another rank's ports."),
         # --- elastic (ref: HOROVOD_ELASTIC common.h:139) ---
         _k("HVDT_ELASTIC", False, _parse_bool, "Elastic (fault-tolerant) mode."),
         # --- topology / rendezvous (set by the launcher; ref env contract

@@ -304,6 +304,16 @@ def transformer_apply(params: Dict, tokens: jax.Array,
         x = pipeline_spmd(stage_fn, params["block"], acts, axis="pp")
         x = x.reshape(b, l, cfg.d_model)
     else:
+        # Block params may still be varying on manual axes the config
+        # doesn't know about (e.g. a stages dim spec'd onto a size-1 pp
+        # mesh axis); the scan carry must match, so pcast x up to the
+        # union of the params' varying axes.
+        pvma = set()
+        for leaf in jax.tree.leaves(params["block"]):
+            pvma |= set(jax.typeof(leaf).vma)
+        missing = tuple(pvma - set(jax.typeof(x).vma))
+        if missing:
+            x = lax.pcast(x, missing, to="varying")
         x = _scan_blocks(params["block"], x, positions, cfg)
     x = _rmsnorm(x, params["ln_f"])
     return (x @ params["embed"].astype(x.dtype).T).astype(jnp.float32)

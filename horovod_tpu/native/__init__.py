@@ -40,8 +40,18 @@ def _build() -> bool:
     if not os.path.exists(makefile):
         return False
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR],
-                       capture_output=True, check=True, timeout=300)
+        # Cross-process lock: multiple ranks on one host all call load()
+        # on startup; without it concurrent `make` invocations write the
+        # same .o/.so and a rank can dlopen a half-written library.
+        import fcntl
+
+        with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               capture_output=True, check=True, timeout=300)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
     except (subprocess.SubprocessError, OSError):
         return False
     return os.path.exists(_LIB_PATH)
@@ -83,8 +93,13 @@ def load() -> ctypes.CDLL:
             return _lib
         if _load_failed is not None:
             raise NativeError(_load_failed)
-        if not os.path.exists(_LIB_PATH) and not _build():
-            _load_failed = "native core unavailable (no prebuilt .so and build failed)"
+        # Always run make: the Makefile's dependency tracking no-ops when
+        # the .so is current and rebuilds it when a C++ source changed —
+        # a stale binary must never shadow the sources.  The .so is a
+        # build artifact (gitignored), not a vendored blob.
+        if not _build() and not os.path.exists(_LIB_PATH):
+            _load_failed = ("native core unavailable "
+                            "(build failed and no existing .so)")
             raise NativeError(_load_failed)
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))

@@ -13,10 +13,15 @@ operations.cc:144-253).  Here there are two host data planes:
   debugging without touching devices.
 
 Selection: ``HVDT_CPU_OPERATIONS=tcp`` + ``HVDT_TCP_ADDRS`` (rank-ordered
-``host:port`` list, set by the launcher alongside the rest of the env
-contract — runner/launch.py).  Each process set gets its own socket mesh;
-its members listen on ``base_port + process_set_id`` so concurrent groups
-never collide (ports are per-listener).
+``host:port`` list; the launcher exports it automatically when
+``HVDT_CPU_OPERATIONS=tcp`` — runner/launch.py — or the operator sets it
+by hand).  Each process set gets its own socket mesh; its members listen
+on ``base_port + process_set_id * HVDT_TCP_SET_PORT_STRIDE``.  The stride
+(default 128) keeps per-set ports clear of *other ranks'* base ports on
+the same host: with ranks at consecutive ports (e.g. 9000, 9001, ...), a
+naive +set_id offset would land set 1's rank-0 listener on rank 1's base
+port.  Contract: all base ports on one host must sit in a contiguous
+block smaller than the stride.
 """
 
 from __future__ import annotations
@@ -61,7 +66,7 @@ def group_for(process_set):
         return g
     addrs_all = [a.strip() for a in
                  config.get_str("HVDT_TCP_ADDRS").split(",") if a.strip()]
-    offset = process_set.id
+    offset = process_set.id * config.get_int("HVDT_TCP_SET_PORT_STRIDE")
     member_addrs = []
     for r in process_set.ranks:
         host, port = addrs_all[r].rsplit(":", 1)

@@ -1,0 +1,224 @@
+"""CLI-flag / YAML-config / env translation for the launcher.
+
+Re-conception of ref: runner/common/util/config_parser.py:1-202 +
+runner/launch.py:242-527 for the HVDT knob registry: every runtime knob
+(fusion, cycle, cache, autotune, timeline, stall check, host data plane,
+logging) is settable from
+
+  1. a CLI flag on ``hvdtrun``            (highest precedence)
+  2. the caller's environment             (HVDT_*)
+  3. a ``--config-file`` YAML             (sections below)
+  4. the knob's built-in default          (common/config.py)
+
+and the launcher forwards the result to every worker as ``HVDT_*`` env —
+the same precedence order the reference implements by writing CLI/file
+values into the env it hands to workers.
+
+YAML shape (mirrors the reference's config sections)::
+
+    params:
+      fusion_threshold_mb: 32
+      cycle_time_ms: 3.5
+      cache_capacity: 2048
+    autotune:
+      enabled: true
+      log_file: /tmp/autotune.csv
+      warmup_samples: 3
+      steps_per_sample: 10
+      bayes_opt_max_samples: 20
+      gaussian_process_noise: 0.8
+    timeline:
+      filename: /tmp/timeline.json
+      mark_cycles: true
+    stall_check:
+      disabled: false
+      warning_time_seconds: 60
+      shutdown_time_seconds: 0
+    library_options:
+      cpu_operations: tcp
+      tcp_port_stride: 128
+    logging:
+      level: info
+      hide_timestamp: false
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["KNOB_FLAGS", "add_knob_arguments", "load_config_file",
+           "apply_config_file", "env_from_args"]
+
+
+@dataclasses.dataclass(frozen=True)
+class _Flag:
+    """One CLI flag ↔ one HVDT env var ↔ one YAML (section, key)."""
+    flag: str                 # e.g. "--fusion-threshold-mb"
+    dest: str                 # argparse dest
+    env: str                  # HVDT_* var the value is forwarded as
+    section: str              # YAML section
+    key: str                  # YAML key within the section
+    help: str
+    type: Callable = str
+    is_bool: bool = False     # store_true flag
+    to_env: Callable[[Any], str] = staticmethod(lambda v: str(v))
+
+
+def _mb_to_bytes(v) -> str:
+    return str(int(float(v) * 1024 * 1024))
+
+
+def _bool_env(v) -> str:
+    return "1" if v else "0"
+
+
+KNOB_FLAGS: List[_Flag] = [
+    # --- params (ref: config_parser.py set_args_from_config 'params') ---
+    _Flag("--fusion-threshold-mb", "fusion_threshold_mb",
+          "HVDT_FUSION_THRESHOLD", "params", "fusion_threshold_mb",
+          "Tensor-fusion bucket size in MB.", type=float,
+          to_env=_mb_to_bytes),
+    _Flag("--cycle-time-ms", "cycle_time_ms", "HVDT_CYCLE_TIME",
+          "params", "cycle_time_ms",
+          "Eager background-cycle time in ms.", type=float),
+    _Flag("--cache-capacity", "cache_capacity", "HVDT_CACHE_CAPACITY",
+          "params", "cache_capacity",
+          "Response-cache capacity.", type=int),
+    # --- autotune ---
+    _Flag("--autotune", "autotune", "HVDT_AUTOTUNE", "autotune", "enabled",
+          "Enable Bayesian autotuning of fusion knobs.", is_bool=True,
+          to_env=_bool_env),
+    _Flag("--autotune-log-file", "autotune_log_file", "HVDT_AUTOTUNE_LOG",
+          "autotune", "log_file", "CSV log for autotune samples."),
+    _Flag("--autotune-warmup-samples", "autotune_warmup_samples",
+          "HVDT_AUTOTUNE_WARMUP_SAMPLES", "autotune", "warmup_samples",
+          "Autotune warmup discard count.", type=int),
+    _Flag("--autotune-steps-per-sample", "autotune_steps_per_sample",
+          "HVDT_AUTOTUNE_STEPS_PER_SAMPLE", "autotune", "steps_per_sample",
+          "Steps per autotune sample.", type=int),
+    _Flag("--autotune-bayes-opt-max-samples", "autotune_bayes_opt_max_samples",
+          "HVDT_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "autotune",
+          "bayes_opt_max_samples", "Max Bayesian-optimizer samples.",
+          type=int),
+    _Flag("--autotune-gaussian-process-noise", "autotune_gp_noise",
+          "HVDT_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", "autotune",
+          "gaussian_process_noise", "GP noise alpha.", type=float),
+    # --- timeline ---
+    _Flag("--timeline-filename", "timeline_filename", "HVDT_TIMELINE",
+          "timeline", "filename",
+          "Write Chrome-tracing timeline JSON to this path."),
+    _Flag("--timeline-mark-cycles", "timeline_mark_cycles",
+          "HVDT_TIMELINE_MARK_CYCLES", "timeline", "mark_cycles",
+          "Mark background cycles in the timeline.", is_bool=True,
+          to_env=_bool_env),
+    # --- stall check ---
+    _Flag("--no-stall-check", "no_stall_check", "HVDT_STALL_CHECK_DISABLE",
+          "stall_check", "disabled", "Disable the stall inspector.",
+          is_bool=True, to_env=_bool_env),
+    _Flag("--stall-check-warning-time-seconds", "stall_warning_time",
+          "HVDT_STALL_CHECK_TIME_SECONDS", "stall_check",
+          "warning_time_seconds", "Stall warning threshold.", type=int),
+    _Flag("--stall-check-shutdown-time-seconds", "stall_shutdown_time",
+          "HVDT_STALL_SHUTDOWN_TIME_SECONDS", "stall_check",
+          "shutdown_time_seconds", "Stall abort threshold (0 = never).",
+          type=int),
+    # --- library options ---
+    _Flag("--cpu-operations", "cpu_operations", "HVDT_CPU_OPERATIONS",
+          "library_options", "cpu_operations",
+          "Host-collective data plane: xla | tcp."),
+    _Flag("--tcp-port-stride", "tcp_port_stride",
+          "HVDT_TCP_SET_PORT_STRIDE", "library_options", "tcp_port_stride",
+          "Port stride between process sets' TCP meshes.", type=int),
+    # --- logging ---
+    _Flag("--log-level", "log_level", "HVDT_LOG_LEVEL", "logging", "level",
+          "trace|debug|info|warning|error|fatal."),
+    _Flag("--log-hide-timestamp", "log_hide_timestamp",
+          "HVDT_LOG_HIDE_TIME", "logging", "hide_timestamp",
+          "Hide timestamps in worker log lines.", is_bool=True,
+          to_env=_bool_env),
+    # --- numerics ---
+    _Flag("--allreduce-dtype", "allreduce_dtype", "HVDT_ALLREDUCE_DTYPE",
+          "params", "allreduce_dtype",
+          "Wire dtype for allreduce (e.g. bfloat16 for on-the-wire "
+          "compression)."),
+    # --- mesh ---
+    _Flag("--mesh-axes", "mesh_axes", "HVDT_MESH_AXES", "params",
+          "mesh_axes", "Default mesh axes, e.g. 'dp=4,tp=2'."),
+]
+
+
+def add_knob_arguments(parser: argparse.ArgumentParser) -> None:
+    """Add every knob flag (default=None so 'explicitly set on the CLI'
+    is detectable — the precedence rules depend on it)."""
+    g = parser.add_argument_group(
+        "runtime knobs",
+        "Forwarded to workers as HVDT_* env. Precedence: CLI > caller env "
+        "> --config-file > default.")
+    for f in KNOB_FLAGS:
+        if f.is_bool:
+            g.add_argument(f.flag, dest=f.dest, action="store_const",
+                           const=True, default=None, help=f.help)
+        else:
+            g.add_argument(f.flag, dest=f.dest, type=f.type, default=None,
+                           help=f.help)
+
+
+def load_config_file(path: str) -> Dict[str, Dict[str, Any]]:
+    """Parse the YAML config file into {section: {key: value}}."""
+    import yaml
+
+    with open(path) as fh:
+        data = yaml.safe_load(fh) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f"config file {path} must be a YAML mapping")
+    return data
+
+
+def apply_config_file(args: argparse.Namespace, path: Optional[str]
+                      ) -> Dict[str, Any]:
+    """Returns {dest: value} of file-provided knobs (file values NEVER
+    overwrite args — CLI wins; env-vs-file precedence is resolved in
+    :func:`env_from_args`)."""
+    if not path:
+        return {}
+    data = load_config_file(path)
+    out: Dict[str, Any] = {}
+    known = {(f.section, f.key): f for f in KNOB_FLAGS}
+    for section, body in data.items():
+        if not isinstance(body, dict):
+            raise ValueError(f"config section {section!r} must be a mapping")
+        for key, value in body.items():
+            f = known.get((section, key))
+            if f is None:
+                raise ValueError(
+                    f"unknown config entry {section}.{key} "
+                    f"(known: {sorted(k for k in known)})")
+            out[f.dest] = value
+    return out
+
+
+def env_from_args(args: argparse.Namespace,
+                  file_values: Dict[str, Any],
+                  base_env: Optional[Dict[str, str]] = None
+                  ) -> Dict[str, str]:
+    """HVDT_* env to forward to workers, honoring
+    CLI > caller env > config file > default.
+
+    ``base_env`` defaults to ``os.environ``; a file value only applies
+    when the var is absent there, while a CLI value always wins.
+    """
+    import os
+
+    env = dict(os.environ) if base_env is None else dict(base_env)
+    out: Dict[str, str] = {}
+    for f in KNOB_FLAGS:
+        cli_val = getattr(args, f.dest, None)
+        if cli_val is not None:
+            out[f.env] = f.to_env(cli_val)
+        elif f.env in env:
+            out[f.env] = env[f.env]
+        elif f.dest in file_values:
+            out[f.env] = f.to_env(file_values[f.dest])
+    return out

@@ -281,6 +281,18 @@ class ElasticDriver:
 def run_elastic(args) -> int:
     """CLI entry for ``hvdtrun --host-discovery-script ...``
     (ref: launch.py:621 _run_elastic → gloo_run.py:340)."""
+    from ..launch import knob_env_for
+
+    knob_env = knob_env_for(args)
+    if knob_env.get("HVDT_CPU_OPERATIONS", "").lower() == "tcp":
+        # The static rank->addr contract HVDT_TCP_ADDRS encodes cannot
+        # survive elastic membership changes; reject up front instead of
+        # letting workers crash on an empty address list mid-bootstrap.
+        raise RuntimeError(
+            "--cpu-operations tcp is not supported with elastic launch: "
+            "the TCP socket mesh needs a static rank->host:port mapping. "
+            "Use the default 'xla' host data plane for elastic jobs.")
+
     hm = HostManager.from_script(args.host_discovery_script,
                                  default_slots=args.slots_per_host)
     min_np = args.min_np or args.num_proc or 1
@@ -312,6 +324,7 @@ def run_elastic(args) -> int:
             "HVDT_COORDINATOR_ADDR": f"{coord}:{coordinator_port}",
             "HVDT_ELASTIC": "1",
             "HVDT_GENERATION": str(gen),
+            **knob_env,
         }
         cmd, env = _build_command(args, slot, base_env, args.command)
         prefix = f"[{slot.rank}]" if args.verbose else ""

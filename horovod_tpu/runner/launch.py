@@ -24,6 +24,7 @@ import threading
 from typing import Dict, List, Optional
 
 from . import hosts as hosts_mod
+from .config_parser import add_knob_arguments, apply_config_file, env_from_args
 from .http_kv import RendezvousServer, new_secret
 from .safe_shell_exec import safe_execute
 
@@ -52,6 +53,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--output-filename", default=None,
                    help="Mux per-rank output into <dir>/rank.<N> files.")
     p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file with runtime-knob sections (see "
+                        "runner/config_parser.py). Precedence: CLI > "
+                        "caller env > config file > default.")
+    p.add_argument("--tcp-base-port", type=int, default=40000,
+                   help="First listener port for the native TCP host data "
+                        "plane (used when --cpu-operations tcp).")
+    p.add_argument("--no-preflight", action="store_true",
+                   help="Skip the host-reachability preflight probe.")
+    add_knob_arguments(p)
     # Elastic flags (ref: launch.py elastic group)
     p.add_argument("--host-discovery-script", default=None,
                    help="Executable printing current 'host:slots' lines; "
@@ -103,6 +114,82 @@ def _build_command(args, slot: hosts_mod.SlotInfo, base_env: Dict[str, str],
             dict(os.environ))
 
 
+def knob_env_for(args) -> Dict[str, str]:
+    """Resolve the runtime-knob env contract for workers (CLI > caller
+    env > --config-file > default; ref: config_parser.py precedence)."""
+    file_values = apply_config_file(args, getattr(args, "config_file", None))
+    return env_from_args(args, file_values)
+
+
+def tcp_addrs_env(args, slots: List[hosts_mod.SlotInfo],
+                  env: Dict[str, str]) -> Dict[str, str]:
+    """Allocate the rank-ordered HVDT_TCP_ADDRS contract when the native
+    TCP host data plane is selected and the operator didn't hand-set it.
+
+    Each rank listens at ``tcp_base_port + local_rank`` on its host —
+    a contiguous per-host block, as the per-set port striding requires
+    (ops/tcp_backend.py)."""
+    if env.get("HVDT_CPU_OPERATIONS", os.environ.get(
+            "HVDT_CPU_OPERATIONS", "xla")).lower() != "tcp":
+        return {}
+    if env.get("HVDT_TCP_ADDRS") or os.environ.get("HVDT_TCP_ADDRS"):
+        return {}
+    addrs = []
+    for slot in sorted(slots, key=lambda s: s.rank):
+        host = "127.0.0.1" if _is_local(slot.hostname) else slot.hostname
+        addrs.append(f"{host}:{args.tcp_base_port + slot.local_rank}")
+    return {"HVDT_TCP_ADDRS": ",".join(addrs)}
+
+
+def preflight_reachability(args, slots: List[hosts_mod.SlotInfo],
+                           addr: str, port: int) -> None:
+    """Probe that every worker host can reach the launcher's rendezvous
+    server before any rank is spawned — the analog of the reference's
+    driver/NIC discovery (ref: runner/driver/driver_service.py:162-260,
+    which probes mutually-routable interfaces).  On TPU VMs a single NIC
+    carries DCN, so the failure mode worth catching is "this host can't
+    reach the coordinator address at all" — fail fast, naming the host,
+    instead of an opaque rendezvous timeout minutes later.
+    """
+    import subprocess
+
+    probe_py = (f"import socket;"
+                f"socket.create_connection(('{addr}',{port}),timeout=10);"
+                f"print('ok')")
+    seen = set()
+    for slot in slots:
+        host = slot.hostname
+        if host in seen:
+            continue
+        seen.add(host)
+        if _is_local(host):
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=10).close()
+            except OSError as e:
+                raise RuntimeError(
+                    f"preflight: host {host!r} (local) cannot reach the "
+                    f"rendezvous server at 127.0.0.1:{port} — {e!r}. "
+                    f"Pass --no-preflight to skip.") from e
+            continue
+        cmd = (f"{_ssh_prefix(args, host)} "
+               f"{shlex.quote(f'python3 -c {shlex.quote(probe_py)}')}")
+        try:
+            res = subprocess.run(cmd, shell=True, capture_output=True,
+                                 text=True, timeout=30)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(
+                f"preflight: host {host!r} did not answer the "
+                f"reachability probe to {addr}:{port} within 30s")
+        if res.returncode != 0 or "ok" not in res.stdout:
+            raise RuntimeError(
+                f"preflight: host {host!r} cannot reach the rendezvous "
+                f"server at {addr}:{port} — "
+                f"{(res.stderr or res.stdout).strip()[-300:]!r}. "
+                f"Check that the launcher's address is routable from the "
+                f"worker (wrong NIC?) or pass --no-preflight to skip.")
+
+
 def run_static(args) -> int:
     """Static launch (ref: launch.py:528 _run_static + gloo_run.py:240)."""
     if args.hostfile:
@@ -128,7 +215,15 @@ def run_static(args) -> int:
         "HVDT_SECRET": server.secret.hex(),
         "HVDT_COORDINATOR_ADDR": f"{coord_host}:{args.coordinator_port}",
     }
+    base_env.update(knob_env_for(args))
+    base_env.update(tcp_addrs_env(args, slots, base_env))
     server.put_local("/cluster/size", str(np_).encode())
+    if not getattr(args, "no_preflight", False):
+        try:
+            preflight_reachability(args, slots, my_addr, port)
+        except RuntimeError:
+            server.stop()
+            raise
 
     terminate = threading.Event()
     exit_codes: Dict[int, int] = {}

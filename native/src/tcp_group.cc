@@ -153,15 +153,25 @@ int TcpGroup::Connect(int rank, int size, const std::string& addrs_csv,
   int accepted = 0;
   for (int peer = 0; peer < rank; ++peer) {
     // Dial peer (it has a lower rank, so it accepts).
+    // getaddrinfo, not gethostbyname: the Python layer drives N rank
+    // threads through concurrent bootstraps in one process, and
+    // gethostbyname returns a pointer into static storage (a data race
+    // that can memcpy a torn peer address).
     sockaddr_in sa{};
     sa.sin_family = AF_INET;
     sa.sin_port = htons(static_cast<uint16_t>(addrs[peer].port));
-    hostent* he = ::gethostbyname(addrs[peer].host.c_str());
-    if (!he) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (::getaddrinfo(addrs[peer].host.c_str(), nullptr, &hints, &res) != 0 ||
+        res == nullptr) {
+      if (res) ::freeaddrinfo(res);
       ::close(listen_fd);
       return fail("cannot resolve host " + addrs[peer].host);
     }
-    std::memcpy(&sa.sin_addr, he->h_addr, he->h_length);
+    sa.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    ::freeaddrinfo(res);
     int fd = -1;
     while (true) {
       fd = ::socket(AF_INET, SOCK_STREAM, 0);

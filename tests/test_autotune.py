@@ -126,3 +126,76 @@ class TestBayesianOptimizerExploration:
         bo = BayesianOptimizer(grid, noise=1e-3, xi=10.0)  # huge xi: EI<=0
         bo.observe([0.0], 5.0)
         assert float(bo.suggest()[0]) == 1.0
+
+
+class TestBenchmarkAutotuner:
+    """Closed-loop driver: measured step time -> knob change -> re-jit
+    signal -> cross-rank sync (ref: parameter_manager.cc closed loop)."""
+
+    def _drive(self, tuner, optimum_log2=24.0):
+        """Simulate a system whose comm throughput peaks at a known
+        bucket size; returns when tuning completes."""
+        import numpy as np
+
+        guard = 0
+        while not tuner.done:
+            guard += 1
+            assert guard < 3000, "autotuner failed to converge"
+            b = np.log2(tuner.pm.bucket_bytes)
+            score = 1e9 * np.exp(-0.5 * ((b - optimum_log2) / 1.5) ** 2)
+            seconds = tuner._grad_bytes / score
+            tuner.record(seconds, steps=1)
+
+    def test_converges_to_optimum_and_beats_default(self):
+        import numpy as np
+
+        from horovod_tpu.autotune import BenchmarkAutotuner, ParameterManager
+
+        params = {"w": np.zeros((1024, 1024), np.float32),
+                  "b": np.zeros((1024,), np.float32)}
+        pm = ParameterManager(warmup_samples=1, steps_per_sample=2,
+                              max_samples=20, noise=0.05)
+        tuner = BenchmarkAutotuner(params, pm=pm)
+        default_bucket = tuner.bucket_bytes
+        self._drive(tuner, optimum_log2=24.0)
+        assert tuner.done
+        # GP/EI over a noiseless peaked landscape must land on (or next
+        # to) the optimum — and must beat the 64 MiB default's score.
+        best_log2 = np.log2(tuner.bucket_bytes)
+        assert abs(best_log2 - 24.0) <= 1.0
+        assert tuner.bucket_bytes != default_bucket
+        score = lambda b: 1e9 * np.exp(-0.5 * ((b - 24.0) / 1.5) ** 2)
+        assert score(best_log2) > score(np.log2(default_bucket))
+
+    def test_record_signals_rejit_and_syncs(self):
+        import numpy as np
+
+        from horovod_tpu.autotune import BenchmarkAutotuner, ParameterManager
+
+        class FakePlane:
+            """2-rank control plane: rank 1 receives rank 0's point."""
+            def __init__(self):
+                self.broadcasts = []
+            def rank(self):
+                return 1
+            def size(self):
+                return 2
+            def broadcast(self, payload, cycle):
+                assert payload is None   # non-root provides nothing
+                self.broadcasts.append(cycle)
+                return "23.000000,2.000000"
+            def gather(self, payload, cycle):
+                return None
+            def barrier(self, tag=""):
+                pass
+
+        cp = FakePlane()
+        pm = ParameterManager(warmup_samples=0, steps_per_sample=1,
+                              max_samples=5)
+        tuner = BenchmarkAutotuner({"w": np.zeros(8, np.float32)}, pm=pm,
+                                   control_plane=cp)
+        changed = tuner.record(0.01, steps=1)
+        assert changed                      # knobs moved -> re-jit signal
+        assert cp.broadcasts                # sync happened through the KV
+        assert tuner.bucket_bytes == 2 ** 23  # adopted rank 0's point
+        assert tuner.pm.overlap_buckets == 2

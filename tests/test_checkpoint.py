@@ -71,3 +71,15 @@ class TestCheckpointManager:
         assert not mgr.save(7, {"x": jnp.ones(1)})
         assert mgr.save(7, {"x": jnp.ones(1)}, force=True)
         assert mgr.latest_step() == 7
+
+
+def test_named_dtype_covers_ml_dtypes():
+    """Leaf dtype metadata travels by name; ml_dtypes names must resolve
+    (np.dtype('bfloat16') alone raises TypeError)."""
+    import numpy as np
+
+    from horovod_tpu.checkpoint import _named_dtype
+
+    assert _named_dtype("float32") == np.dtype(np.float32)
+    assert _named_dtype("bfloat16").name == "bfloat16"
+    assert _named_dtype("float8_e4m3fn").name == "float8_e4m3fn"

@@ -64,6 +64,22 @@ def _worker():
         ckpath, {"w": np.zeros(3, np.float32)})
     out["ckpt"] = (np.asarray(restored["w"]).tolist(), stp)
 
+    # bf16 checkpoint round-trip: the standard TPU training dtype must
+    # survive the leaf-metadata broadcast (dtype travels by name; the
+    # ml_dtypes '<V2' dtype.str regression)
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    ckpath2 = "/tmp/hvdt_mp_ck_bf16"
+    if r == 0:
+        shutil.rmtree(ckpath2, ignore_errors=True)
+    hvd.barrier()
+    tree2 = {"w": np.full(4, 2.5, bf16) if r == 0 else np.zeros(4, bf16)}
+    save_checkpoint(ckpath2, tree2, step=3)
+    restored2, stp2 = restore_checkpoint(ckpath2, {"w": np.zeros(4, bf16)})
+    w2 = np.asarray(restored2["w"])
+    out["ckpt_bf16"] = (w2.astype(np.float32).tolist(), w2.dtype.name, stp2)
+
     # grouped + async surface
     h1 = hvd.allreduce_async(np.ones(2, np.float32), name="h1")
     h2 = hvd.allreduce_async(np.full(2, 2.0, np.float32), name="h2")
@@ -95,6 +111,10 @@ def test_two_process_eager_collectives():
         ck_vals, ck_step = out["ckpt"]
         np.testing.assert_allclose(ck_vals, [5.0, 5.0, 5.0])
         assert ck_step == 9
+        bf_vals, bf_dtype, bf_step = out["ckpt_bf16"]
+        np.testing.assert_allclose(bf_vals, [2.5] * 4)
+        assert bf_dtype == "bfloat16"
+        assert bf_step == 3
 
 
 def _worker_pickled():

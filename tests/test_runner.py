@@ -244,3 +244,104 @@ class TestProgrammaticRun:
             lambda: [int(__import__("os").environ["HVDT_RANK"]),
                      int(__import__("os").environ["HVDT_SIZE"])], np=2)
         assert sorted(results) == [[0, 2], [1, 2]]
+
+
+class TestConfigParser:
+    """CLI/env/config-file knob translation (ref: runner/common/util/
+    config_parser.py precedence CLI > env > file > default)."""
+
+    def _args(self, argv):
+        return parse_args(argv + ["--", "python", "train.py"])
+
+    def test_cli_flags_to_env(self):
+        from horovod_tpu.runner.launch import knob_env_for
+
+        args = self._args(["-np", "2", "--fusion-threshold-mb", "32",
+                           "--cycle-time-ms", "2.5", "--autotune",
+                           "--timeline-filename", "/tmp/tl.json",
+                           "--no-stall-check", "--log-level", "debug"])
+        env = knob_env_for(args)
+        assert env["HVDT_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+        assert env["HVDT_CYCLE_TIME"] == "2.5"
+        assert env["HVDT_AUTOTUNE"] == "1"
+        assert env["HVDT_TIMELINE"] == "/tmp/tl.json"
+        assert env["HVDT_STALL_CHECK_DISABLE"] == "1"
+        assert env["HVDT_LOG_LEVEL"] == "debug"
+
+    def test_config_file_and_precedence(self, tmp_path, monkeypatch):
+        from horovod_tpu.runner.config_parser import (apply_config_file,
+                                                      env_from_args)
+
+        cfg = tmp_path / "hvdt.yaml"
+        cfg.write_text(
+            "params:\n  fusion_threshold_mb: 16\n  cycle_time_ms: 7\n"
+            "autotune:\n  enabled: true\n"
+            "stall_check:\n  warning_time_seconds: 90\n"
+            "logging:\n  level: info\n")
+        # CLI sets cycle-time (beats file); env sets log level (beats
+        # file); file supplies fusion threshold + autotune + stall.
+        args = self._args(["--config-file", str(cfg),
+                           "--cycle-time-ms", "3"])
+        file_values = apply_config_file(args, args.config_file)
+        env = env_from_args(args, file_values,
+                            base_env={"HVDT_LOG_LEVEL": "error"})
+        assert env["HVDT_CYCLE_TIME"] == "3.0"            # CLI wins
+        assert env["HVDT_LOG_LEVEL"] == "error"           # env beats file
+        assert env["HVDT_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+        assert env["HVDT_AUTOTUNE"] == "1"
+        assert env["HVDT_STALL_CHECK_TIME_SECONDS"] == "90"
+
+    def test_config_file_unknown_key_rejected(self, tmp_path):
+        from horovod_tpu.runner.config_parser import apply_config_file
+
+        cfg = tmp_path / "bad.yaml"
+        cfg.write_text("params:\n  no_such_knob: 1\n")
+        args = self._args(["--config-file", str(cfg)])
+        with pytest.raises(ValueError, match="no_such_knob"):
+            apply_config_file(args, args.config_file)
+
+    def test_tcp_addrs_allocation(self):
+        from horovod_tpu.runner.launch import tcp_addrs_env
+
+        args = self._args(["--cpu-operations", "tcp",
+                           "--tcp-base-port", "41000"])
+        slots = hosts_mod.get_host_assignments(
+            [HostInfo("localhost", 2)], 2)
+        env = tcp_addrs_env(args, slots, {"HVDT_CPU_OPERATIONS": "tcp"})
+        assert env["HVDT_TCP_ADDRS"] == "127.0.0.1:41000,127.0.0.1:41001"
+        # operator-provided addrs are never overwritten
+        env2 = tcp_addrs_env(args, slots,
+                             {"HVDT_CPU_OPERATIONS": "tcp",
+                              "HVDT_TCP_ADDRS": "h:1"})
+        assert env2 == {}
+
+    def test_preflight_local_ok_and_remote_failure(self):
+        from horovod_tpu.runner.launch import preflight_reachability
+
+        server = RendezvousServer(secret=new_secret())
+        port = server.start()
+        try:
+            args = self._args(["-np", "1"])
+            slots = hosts_mod.get_host_assignments(
+                [HostInfo("localhost", 1)], 1)
+            preflight_reachability(args, slots, "127.0.0.1", port)  # no raise
+        finally:
+            server.stop()
+        # unreachable local port fails fast, with the diagnostic message
+        args = self._args(["-np", "1"])
+        with pytest.raises(RuntimeError, match="cannot reach"):
+            preflight_reachability(args, slots, "127.0.0.1", 1)  # closed port
+
+    def test_elastic_rejects_tcp_data_plane(self):
+        from horovod_tpu.runner.elastic.driver import run_elastic
+
+        args = self._args(["--host-discovery-script", "/bin/true",
+                           "--cpu-operations", "tcp"])
+        with pytest.raises(RuntimeError, match="elastic"):
+            run_elastic(args)
+
+    def test_top_level_run_alias(self):
+        import horovod_tpu as hvd
+        from horovod_tpu import runner
+
+        assert hvd.run is runner.run

@@ -1,0 +1,215 @@
+"""Multi-rank eager-collective battery — real worker processes.
+
+The analog of the reference's parallel test tier run under
+``mpirun -np {2,4} pytest`` (ref: test/parallel/test_torch.py:59 and its
+error-case battery): every negotiated eager op exercised across true
+process boundaries, including the ragged/uneven/error paths that size-1
+tests cannot reach.  Packed into one worker function per process count
+(process spawn + JAX import dominate, so each np config boots once).
+"""
+
+import numpy as np
+import pytest
+
+
+def _battery4():
+    """np=4 op battery; returns {check_name: payload} per rank."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r, s = hvd.rank(), hvd.size()
+    out = {"rank": r, "size": s}
+
+    # -- reduce ops across 4 ranks ----------------------------------------
+    base = np.array([float(r + 1), float(2 * r + 1)], np.float32)
+    out["avg"] = np.asarray(
+        hvd.allreduce(base, name="b_avg", op=hvd.Average)).tolist()
+    out["sum"] = np.asarray(
+        hvd.allreduce(base, name="b_sum", op=hvd.Sum)).tolist()
+    out["min"] = np.asarray(
+        hvd.allreduce(base, name="b_min", op=hvd.Min)).tolist()
+    out["max"] = np.asarray(
+        hvd.allreduce(base, name="b_max", op=hvd.Max)).tolist()
+    out["prod"] = np.asarray(
+        hvd.allreduce(np.full(2, float(r + 1), np.float32), name="b_prod",
+                      op=hvd.Product)).tolist()
+
+    # -- eager Adasum across ranks ----------------------------------------
+    ada = hvd.allreduce(np.full(3, float(r + 1), np.float32),
+                       name="b_ada", op=hvd.Adasum)
+    out["adasum"] = np.asarray(ada).tolist()
+
+    # -- ragged allgather: rank r contributes r+1 rows --------------------
+    g = hvd.allgather(np.full((r + 1, 2), float(r), np.float32), name="b_ag")
+    out["allgather"] = np.asarray(g).tolist()
+
+    # -- uneven alltoall: rank r sends ((r+j) % 2) + 1 rows to rank j -----
+    splits = [((r + j) % 2) + 1 for j in range(s)]
+    payload = np.concatenate([
+        np.full((splits[j], 1), 10.0 * r + j, np.float32)
+        for j in range(s)])
+    recv, rsplits = hvd.alltoall(payload, splits=splits, name="b_a2a")
+    out["alltoall"] = (np.asarray(recv).ravel().tolist(),
+                       list(np.asarray(rsplits)))
+
+    # -- reducescatter (uneven tail goes to low ranks first) --------------
+    rs = hvd.reducescatter(np.arange(8, dtype=np.float32), name="b_rs",
+                           op=hvd.Sum)
+    out["reducescatter"] = np.asarray(rs).tolist()
+
+    # -- process-set subgroups: low pair vs high pair ---------------------
+    lo = hvd.add_process_set([0, 1])
+    hi = hvd.add_process_set([2, 3])
+    mine = lo if r < 2 else hi
+    sub = hvd.allreduce(np.full(2, float(r), np.float32), name="b_sub",
+                        op=hvd.Sum, process_set=mine)
+    out["subgroup"] = np.asarray(sub).tolist()
+
+    # -- join with pending tensors: ranks 0-2 allreduce, rank 3 joins -----
+    if r != 3:
+        pend = hvd.allreduce(np.full(2, float(r + 1), np.float32),
+                             name="b_pend", op=hvd.Sum)
+        out["join_pending"] = np.asarray(pend).tolist()
+        last = hvd.join()
+    else:
+        last = hvd.join()          # no matching enqueue: zero contribution
+        out["join_pending"] = None
+    out["join_last"] = int(last)
+
+    hvd.shutdown()
+    return out
+
+
+def _errors2():
+    """np=2 cross-rank error battery."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {"rank": r}
+
+    # shape mismatch across ranks must raise on EVERY rank, not hang
+    try:
+        hvd.allreduce(np.zeros(3 if r == 0 else 4, np.float32),
+                      name="err_shape")
+        out["shape_mismatch"] = "no-error"
+    except Exception as e:
+        out["shape_mismatch"] = type(e).__name__ + ":" + str(e)[:80]
+
+    # dtype mismatch across ranks
+    try:
+        hvd.allreduce(
+            np.zeros(3, np.float32 if r == 0 else np.float64),
+            name="err_dtype")
+        out["dtype_mismatch"] = "no-error"
+    except Exception as e:
+        out["dtype_mismatch"] = type(e).__name__ + ":" + str(e)[:80]
+
+    # mismatched op across ranks
+    try:
+        hvd.allreduce(np.zeros(3, np.float32), name="err_op",
+                      op=hvd.Sum if r == 0 else hvd.Average)
+        out["op_mismatch"] = "no-error"
+    except Exception as e:
+        out["op_mismatch"] = type(e).__name__ + ":" + str(e)[:80]
+
+    # the controller must still be usable after failed negotiations
+    ok = hvd.allreduce(np.full(2, float(r + 1), np.float32),
+                       name="err_recover", op=hvd.Sum)
+    out["recovered"] = np.asarray(ok).tolist()
+    hvd.shutdown()
+    return out
+
+
+def _pickled(fn):
+    import sys
+
+    import cloudpickle
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    return fn
+
+
+def test_four_process_battery():
+    import horovod_tpu.runner as runner
+
+    results = runner.run(_pickled(_battery4), np=4)
+    assert len(results) == 4
+    by_rank = sorted(results, key=lambda o: o["rank"])
+    s = 4
+    for r, out in enumerate(by_rank):
+        assert out["size"] == s
+        np.testing.assert_allclose(out["avg"], [2.5, 4.0])     # mean r+1 / 2r+1
+        np.testing.assert_allclose(out["sum"], [10.0, 16.0])
+        np.testing.assert_allclose(out["min"], [1.0, 1.0])
+        np.testing.assert_allclose(out["max"], [4.0, 7.0])
+        np.testing.assert_allclose(out["prod"], [24.0, 24.0])  # 1*2*3*4
+
+        # Adasum of parallel vectors collapses toward the dominant
+        # direction; exact value checked for cross-rank agreement below.
+
+        # ragged allgather: rows 1+2+3+4 = 10, rank-major order
+        ag = np.asarray(out["allgather"])
+        assert ag.shape == (10, 2)
+        expect_rows = sum(([float(q)] * (q + 1) for q in range(s)), [])
+        np.testing.assert_allclose(ag[:, 0], expect_rows)
+
+        # uneven alltoall: rank r receives split ((q+r)%2)+1 from each q
+        vals, rsplits = out["alltoall"]
+        expect_splits = [((q + r) % 2) + 1 for q in range(s)]
+        assert list(rsplits) == expect_splits
+        expect_vals = sum(([10.0 * q + r] * expect_splits[q]
+                           for q in range(s)), [])
+        np.testing.assert_allclose(vals, expect_vals)
+
+        # reducescatter of arange(8) summed over 4 ranks, split 2 each
+        np.testing.assert_allclose(
+            out["reducescatter"],
+            (4 * np.arange(8, dtype=np.float64))[2 * r:2 * r + 2])
+
+        # subgroups: 0+1=1 for the low pair, 2+3=5 for the high pair
+        np.testing.assert_allclose(
+            out["subgroup"], [1.0, 1.0] if r < 2 else [5.0, 5.0])
+
+        # join: ranks 0-2's pending sum completes with rank 3 absent
+        # (zero contribution): 1+2+3 = 6
+        if r != 3:
+            np.testing.assert_allclose(out["join_pending"], [6.0, 6.0])
+
+    # cross-rank agreement for adasum + join ordering
+    ada0 = by_rank[0]["adasum"]
+    for out in by_rank[1:]:
+        np.testing.assert_allclose(out["adasum"], ada0)
+    assert len({o["join_last"] for o in by_rank}) == 1
+
+
+def test_two_process_error_battery():
+    import horovod_tpu.runner as runner
+
+    results = runner.run(_pickled(_errors2), np=2)
+    by_rank = sorted(results, key=lambda o: o["rank"])
+    for out in by_rank:
+        # every rank sees the negotiation error, with the reason named
+        assert out["shape_mismatch"] != "no-error"
+        assert "shape" in out["shape_mismatch"].lower()
+        assert out["dtype_mismatch"] != "no-error"
+        assert "type" in out["dtype_mismatch"].lower()
+        assert out["op_mismatch"] != "no-error"
+        # and the controller keeps working afterwards
+        np.testing.assert_allclose(out["recovered"], [3.0, 3.0])

@@ -24,7 +24,16 @@ from ..common.types import ReduceOp
 
 __all__ = ["allreduce", "allreduce_async", "allgather", "allgather_async",
            "broadcast", "broadcast_async", "alltoall", "synchronize",
-           "broadcast_parameters", "broadcast_optimizer_state"]
+           "broadcast_parameters", "broadcast_optimizer_state",
+           "DistributedOptimizer"]
+
+
+def __getattr__(name):
+    if name == "DistributedOptimizer":
+        from .torch_optimizer import DistributedOptimizer
+
+        return DistributedOptimizer
+    raise AttributeError(name)
 
 
 def _torch():

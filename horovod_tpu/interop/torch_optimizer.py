@@ -1,0 +1,195 @@
+"""Grad-hook DistributedOptimizer for PyTorch models.
+
+The reference's canonical torch API: wrap any ``torch.optim`` optimizer so
+each parameter's gradient, the moment autograd finishes accumulating it,
+is enqueued as a named async allreduce; ``step()`` synchronizes the
+handles and applies the reduced gradients
+(ref: torch/optimizer.py — _DistributedOptimizer grad hooks :131-253,
+synchronize :255-302, factory :516-605).
+
+TPU-native translation: the hooks enqueue through THIS framework's eager
+controller (negotiation + fusion + response cache), and the bytes ride
+whichever host data plane is selected (XLA device mesh or the native TCP
+backend) — no NCCL, no DDP.  Because the controller's background thread
+negotiates while autograd is still producing later gradients, comm
+overlaps backward exactly like the reference.
+
+``backward_passes_per_step=k`` follows the reference contract: call
+``backward()`` k times, then ``step()`` once.  Each parameter carries a
+delay counter (ref: _allreduce_delay); its hook enqueues the accumulated
+gradient (divided by k) on the k-th backward.  Calling ``step()`` or
+``zero_grad()`` mid-accumulation raises instead of silently training
+wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from ..common.types import ReduceOp
+from .torch import _to_np
+
+__all__ = ["DistributedOptimizer"]
+
+
+class _Hooks:
+    """Per-parameter async-allreduce state shared by the mixin methods."""
+
+    def __init__(self, optimizer, named_parameters, op, process_set,
+                 backward_passes_per_step: int):
+        self.op = op
+        self.process_set = process_set
+        self.k = max(1, int(backward_passes_per_step))
+        self._handles: Dict[Any, int] = {}       # param -> eager handle
+        self._names: Dict[Any, str] = {}
+        self._delay: Dict[Any, int] = {}         # param -> backwards left
+        self._hook_refs = []
+
+        params = [p for group in optimizer.param_groups
+                  for p in group["params"]]
+        if named_parameters is not None:
+            by_obj = {id(p): n for n, p in named_parameters}
+            missing = [p for p in params if id(p) not in by_obj]
+            if missing:
+                raise ValueError(
+                    "named_parameters does not cover all optimized "
+                    f"parameters ({len(missing)} missing)")
+            names = {p: f"grad.{by_obj[id(p)]}" for p in params}
+        else:
+            names = {p: f"grad.{i}" for i, p in enumerate(params)}
+        self._names = names
+
+        for p in params:
+            if not p.requires_grad:
+                continue
+            self._delay[p] = self.k
+            self._hook_refs.append(
+                p.register_post_accumulate_grad_hook(self._hook))
+
+    def _hook(self, p) -> None:
+        d = self._delay.get(p, self.k) - 1
+        self._delay[p] = d
+        if d <= 0:
+            self._enqueue(p)
+
+    def _enqueue(self, p, zeros: bool = False) -> None:
+        from ..ops import eager
+
+        if p in self._handles:          # double-backward past the boundary
+            eager.synchronize(self._handles.pop(p))
+        if zeros or p.grad is None:
+            grad = np.zeros(tuple(p.shape), dtype=_torch_np_dtype(p))
+        else:
+            # Copy: the controller's background thread reads this buffer
+            # asynchronously; a zero-copy view of p.grad would race with
+            # in-place grad mutation (clip_grad_norm_ etc.).
+            grad = np.array(_to_np(p.grad.detach()), copy=True)
+            if self.k > 1:
+                grad /= self.k
+        self._handles[p] = eager.allreduce_async(
+            grad, name=self._names[p], op=self.op,
+            process_set=self.process_set)
+
+    def mid_accumulation(self) -> bool:
+        return any(0 < d < self.k for d in self._delay.values())
+
+    def synchronize(self, optimizer) -> None:
+        import torch
+
+        from ..ops import eager
+
+        # Symmetric negotiation: ranks may differ in which params got
+        # gradients (data-dependent branches, per-rank frozen modules).
+        # Every rank enqueues EVERY optimized param — zeros when no local
+        # gradient exists — so no rank's negotiation can hang waiting for
+        # a name that never arrives elsewhere.
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p not in self._handles:
+                    self._enqueue(p, zeros=p.grad is None)
+        for p, handle in list(self._handles.items()):
+            out = np.asarray(eager.synchronize(handle))
+            t = torch.from_numpy(out)
+            with torch.no_grad():
+                if p.grad is None:
+                    p.grad = t.view(p.shape).to(p.dtype).clone()
+                else:
+                    p.grad.copy_(t.view_as(p.grad))
+        self._handles.clear()
+        for p in self._delay:
+            self._delay[p] = self.k
+
+
+def _torch_np_dtype(p):
+    import torch
+
+    return {torch.float32: np.float32, torch.float64: np.float64,
+            torch.float16: np.float16}.get(p.dtype, np.float32)
+
+
+def DistributedOptimizer(optimizer,
+                         named_parameters: Optional[
+                             Iterable[Tuple[str, Any]]] = None,
+                         op: ReduceOp = ReduceOp.AVERAGE,
+                         process_set=None,
+                         backward_passes_per_step: int = 1):
+    """Wrap a ``torch.optim`` optimizer with gradient-allreduce hooks
+    (ref: torch/optimizer.py:516 DistributedOptimizer — same call shape:
+    construct your optimizer, wrap it, train as usual)::
+
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = hvd.interop.torch.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        loss.backward()   # grads stream into named async allreduces
+        opt.step()        # synchronize() then apply
+
+    Returns an object of a dynamic subclass of the wrapped optimizer's
+    class, so isinstance checks and schedulers keep working.
+    """
+    named = list(named_parameters) if named_parameters is not None else None
+
+    base = optimizer.__class__
+    cls = type("Distributed" + base.__name__, (base,), {
+        "step": _step,
+        "synchronize": _synchronize,
+        "zero_grad": _zero_grad,
+        "_hvdt_base": base,
+    })
+    optimizer.__class__ = cls
+    optimizer._hvdt = _Hooks(optimizer, named, op, process_set,
+                             backward_passes_per_step)
+    return optimizer
+
+
+def _step(self, closure=None):
+    h = self._hvdt
+    if h.mid_accumulation():
+        raise RuntimeError(
+            f"step() called mid-accumulation: with "
+            f"backward_passes_per_step={h.k}, call backward() {h.k} times "
+            f"before each step() (ref contract).")
+    h.synchronize(self)
+    return self._hvdt_base.step(self, closure)
+
+
+def _synchronize(self):
+    """Wait for all outstanding gradient allreduces and install the
+    reduced gradients (ref: optimizer.py synchronize :255)."""
+    self._hvdt.synchronize(self)
+
+
+def _zero_grad(self, set_to_none: bool = True):
+    h = self._hvdt
+    if h._handles:
+        raise RuntimeError(
+            "zero_grad() called with allreduce handles outstanding — "
+            "call step() or synchronize() first (matches the reference's "
+            "misuse guard).")
+    if h.mid_accumulation():
+        raise RuntimeError(
+            "zero_grad() called mid-accumulation would discard "
+            f"gradients: with backward_passes_per_step={h.k}, zero only "
+            "after the boundary step().")
+    return self._hvdt_base.zero_grad(self, set_to_none=set_to_none)

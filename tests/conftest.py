@@ -54,3 +54,15 @@ def hvd():
     hvd_mod.init()
     yield hvd_mod
     hvd_mod.shutdown()
+
+
+def pickle_by_value(fn):
+    """Ship a worker function to runner.run-spawned processes by VALUE:
+    workers cannot import the defining test module (it lives on pytest's
+    sys.path, not theirs)."""
+    import sys
+
+    import cloudpickle
+
+    cloudpickle.register_pickle_by_value(sys.modules[fn.__module__])
+    return fn

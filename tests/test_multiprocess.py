@@ -118,11 +118,6 @@ def test_two_process_eager_collectives():
 
 
 def _worker_pickled():
-    """Return _worker pickled by value — worker processes cannot import
-    this test module (it lives on pytest's sys.path, not theirs)."""
-    import sys
+    from conftest import pickle_by_value
 
-    import cloudpickle
-
-    cloudpickle.register_pickle_by_value(sys.modules[__name__])
-    return _worker
+    return pickle_by_value(_worker)

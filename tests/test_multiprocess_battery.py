@@ -137,13 +137,7 @@ def _errors2():
     return out
 
 
-def _pickled(fn):
-    import sys
-
-    import cloudpickle
-
-    cloudpickle.register_pickle_by_value(sys.modules[__name__])
-    return fn
+from conftest import pickle_by_value as _pickled
 
 
 def test_four_process_battery():

@@ -46,10 +46,19 @@ class _Hooks:
         self._names: Dict[Any, str] = {}
         self._delay: Dict[Any, int] = {}         # param -> backwards left
         self._hook_refs = []
+        self._synchronized = False               # grads already reduced
 
         params = [p for group in optimizer.param_groups
                   for p in group["params"]]
         if named_parameters is not None:
+            seen = set()
+            for n, _ in named_parameters:
+                if n in seen:
+                    raise ValueError(
+                        f"duplicate parameter name {n!r} in "
+                        "named_parameters — collective names must be "
+                        "unique (ref: optimizer.py duplicate check)")
+                seen.add(n)
             by_obj = {id(p): n for n, p in named_parameters}
             missing = [p for p in params if id(p) not in by_obj]
             if missing:
@@ -69,8 +78,12 @@ class _Hooks:
                 p.register_post_accumulate_grad_hook(self._hook))
 
     def _hook(self, p) -> None:
-        d = self._delay.get(p, self.k) - 1
-        self._delay[p] = d
+        if self._delay.get(p, self.k) <= 0:
+            raise RuntimeError(
+                f"Gradients for {self._names[p]!r} were computed more "
+                f"than backward_passes_per_step={self.k} times before "
+                "step()/synchronize() (ref misuse guard).")
+        d = self._delay[p] = self._delay.get(p, self.k) - 1
         if d <= 0:
             self._enqueue(p)
 
@@ -80,17 +93,24 @@ class _Hooks:
         if p in self._handles:          # double-backward past the boundary
             eager.synchronize(self._handles.pop(p))
         if zeros or p.grad is None:
-            grad = np.zeros(tuple(p.shape), dtype=_torch_np_dtype(p))
+            grad = np.zeros(tuple(p.shape), dtype=_wire_np_dtype(p))
         else:
+            g = p.grad.detach()
+            # bf16 (and other numpy-less torch dtypes) go over the wire
+            # as f32 — matching the zeros path so every rank negotiates
+            # the same dtype for a name.
+            if not _numpy_compatible(g.dtype):
+                g = g.float()
             # Copy: the controller's background thread reads this buffer
             # asynchronously; a zero-copy view of p.grad would race with
             # in-place grad mutation (clip_grad_norm_ etc.).
-            grad = np.array(_to_np(p.grad.detach()), copy=True)
+            grad = np.array(_to_np(g), copy=True)
             if self.k > 1:
                 grad /= self.k
         self._handles[p] = eager.allreduce_async(
             grad, name=self._names[p], op=self.op,
             process_set=self.process_set)
+        self._synchronized = False
 
     def mid_accumulation(self) -> bool:
         return any(0 < d < self.k for d in self._delay.values())
@@ -120,9 +140,16 @@ class _Hooks:
         self._handles.clear()
         for p in self._delay:
             self._delay[p] = self.k
+        self._synchronized = True
 
 
-def _torch_np_dtype(p):
+def _numpy_compatible(dtype) -> bool:
+    import torch
+
+    return dtype in (torch.float32, torch.float64, torch.float16)
+
+
+def _wire_np_dtype(p):
     import torch
 
     return {torch.float32: np.float32, torch.float64: np.float64,
@@ -165,13 +192,25 @@ def DistributedOptimizer(optimizer,
 
 def _step(self, closure=None):
     h = self._hvdt
+    if closure is not None:
+        # A closure's backward() would enqueue fresh allreduces AFTER the
+        # synchronize below, so the update would use unreduced local
+        # grads and replicas would silently diverge. Explicit beats
+        # silent: restructure as backward() -> step() without a closure.
+        raise ValueError(
+            "DistributedOptimizer.step() does not support closures: run "
+            "backward() first, then call step() with no arguments.")
     if h.mid_accumulation():
         raise RuntimeError(
             f"step() called mid-accumulation: with "
             f"backward_passes_per_step={h.k}, call backward() {h.k} times "
             f"before each step() (ref contract).")
-    h.synchronize(self)
-    return self._hvdt_base.step(self, closure)
+    if not h._synchronized:
+        h.synchronize(self)
+    out = self._hvdt_base.step(self)
+    # The reduced grads were consumed; the next backward must re-sync.
+    h._synchronized = False
+    return out
 
 
 def _synchronize(self):

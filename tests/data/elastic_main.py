@@ -1,7 +1,8 @@
 """Elastic integration training script (ref analog:
 test/integration/data/elastic_torch_main.py): trains to a fixed batch
-count with disk-backed commits, logging "rank size batch" lines so the
-test can assert world-size transitions and progress continuity."""
+count with disk-backed commits, logging "rank size batch lr_milli ts_ms"
+lines so the test can assert world-size transitions, LR rescale on
+resize, progress continuity, and recovery time."""
 
 import os
 import sys
@@ -17,6 +18,8 @@ import numpy as np  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
 
+BASE_LR = 0.1
+
 
 def main():
     log_path = os.environ["ELASTIC_TEST_LOG"]
@@ -28,19 +31,23 @@ def main():
     state = hvd.elastic.JaxState(path=state_path,
                                  w=np.zeros(4, np.float32), batch=0)
 
-    def log_line(batch):
+    def log_line(batch, lr):
         with open(log_path, "a") as f:
-            f.write(f"{hvd.rank()} {hvd.size()} {batch}\n")
+            f.write(f"{hvd.rank()} {hvd.size()} {batch} "
+                    f"{int(lr * 1000)} {int(time.time() * 1000)}\n")
 
     @hvd.elastic.run
     def train(state):
+        # Linear-scaling rule: LR rescales with the CURRENT world size
+        # on every (re)start (ref: elastic docs + LearningRateScheduleCB).
+        lr = BASE_LR * hvd.size()
         while state.batch < total_batches:
             g = hvd.allreduce(
                 np.ones(4, np.float32) * (hvd.rank() + 1.0),
                 name="grad")
-            state.w = state.w + np.asarray(g)
+            state.w = state.w + lr * np.asarray(g)
             state.batch += 1
-            log_line(state.batch)
+            log_line(state.batch, lr)
             if state.batch % 5 == 0:
                 state.commit()   # snapshot + persist + host-update check
             time.sleep(sleep_s)

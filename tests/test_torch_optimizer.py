@@ -154,3 +154,83 @@ def test_two_process_equivalence():
             grads.append(wr.grad)
         w = w - 0.5 * (grads[0] + grads[1]) / 2
     np.testing.assert_allclose(by_rank[0]["w"], w.numpy(), rtol=1e-5)
+
+
+class TestGuards:
+    def _opt(self, hvd, model, **kw):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        return DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), **kw)
+
+    def test_synchronize_then_clip_then_step(self, hvd):
+        """The reference grad-clipping pattern: synchronize(), mutate
+        grads, step() — step must NOT re-allreduce."""
+        import torch
+
+        model = _make_model(torch)
+        opt = self._opt(hvd, model)
+        w0 = model.weight.detach().clone()
+        x = torch.randn(16, 4)
+        ((model(x)) ** 2).mean().backward()
+        opt.synchronize()
+        g_after_sync = model.weight.grad.detach().clone()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1e-4)
+        opt.step()
+        # the clipped (tiny) grad was applied — not a re-reduced copy of
+        # the full one
+        delta = (w0 - model.weight.detach()).abs().max()
+        assert delta <= 0.1 * 1.2e-4
+        assert g_after_sync.abs().max() > 1e-3   # clip actually changed it
+
+    def test_over_backward_raises(self, hvd):
+        import torch
+
+        model = _make_model(torch)
+        opt = self._opt(hvd, model, backward_passes_per_step=2)
+        x = torch.randn(4, 4)
+        ((model(x)) ** 2).mean().backward()
+        ((model(x)) ** 2).mean().backward()      # boundary: enqueued
+        with pytest.raises(RuntimeError, match="more than"):
+            ((model(x)) ** 2).mean().backward()  # 3rd pass: misuse
+        opt.synchronize()                        # drain for teardown
+
+    def test_closure_rejected(self, hvd):
+        import torch
+
+        model = _make_model(torch)
+        opt = self._opt(hvd, model)
+        ((model(torch.randn(4, 4))) ** 2).mean().backward()
+        with pytest.raises(ValueError, match="closure"):
+            opt.step(lambda: None)
+        opt.synchronize()
+
+    def test_duplicate_names_rejected(self, hvd):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        model = _make_model(torch)
+        with pytest.raises(ValueError, match="duplicate"):
+            DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=[("w", model.weight), ("w", model.bias)])
+
+    def test_bf16_model_trains(self, hvd):
+        import torch
+
+        model = _make_model(torch).to(torch.bfloat16)
+        opt = self._opt(hvd, model)
+        x = torch.randn(16, 4, dtype=torch.bfloat16)
+        losses = []
+        for _ in range(10):
+            opt.zero_grad()
+            loss = ((model(x)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        assert model.weight.dtype == torch.bfloat16
+        assert losses[-1] < losses[0]

@@ -66,12 +66,19 @@ def bench_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
 
     f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
                               out_specs=P("dp")))
+
+    def run_and_wait():
+        # Force completion with a host fetch of a scalar that data-depends
+        # on the result; block_until_ready can be a no-op on tunnelled
+        # PJRT backends and would report fantasy bandwidth.
+        float(jnp.sum(f(x)[..., :1].astype(jnp.float32)))
+
     for _ in range(warmup):
-        jax.block_until_ready(f(x))
+        run_and_wait()
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(f(x))
+        run_and_wait()
         times.append((time.perf_counter() - t0) / inner)
     return min(times)
 

@@ -147,7 +147,9 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
         nonlocal params, opt_state
         for _ in range(n):
             params, opt_state, loss = step(params, opt_state, data, labels)
-        jax.block_until_ready(loss)
+        # Host fetch (not block_until_ready — a no-op on some tunnelled
+        # PJRT backends) so the timed region covers real device work.
+        float(jnp.sum(loss))
 
     run_batches(args.num_warmup_batches)
 

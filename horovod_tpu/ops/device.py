@@ -28,9 +28,11 @@ from ..common.types import ReduceOp
 __all__ = [
     "allreduce",
     "allgather",
+    "allgather_ragged",
     "reduce_scatter",
     "broadcast",
     "alltoall",
+    "alltoall_uneven",
     "axis_rank",
     "axis_size",
     "fused_allreduce",
@@ -182,16 +184,164 @@ def reduce_scatter(x, axis: AxisName = "dp", scatter_axis: int = 0,
                    op: ReduceOp = ReduceOp.SUM):
     """Reduce-scatter over a mesh axis — first-class on TPU (building block
     for ZeRO/FSDP-style sharding and Adasum; the reference only has it
-    embedded inside NCCLHierarchicalAllreduce, nccl_operations.cc:378)."""
-    def _rs(t):
-        out = lax.psum_scatter(t, axis, scatter_dimension=scatter_axis, tiled=True)
-        if op == ReduceOp.AVERAGE:
-            out = out / lax.axis_size(axis)
-        return out
+    embedded inside NCCLHierarchicalAllreduce, nccl_operations.cc:378).
 
-    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
-        raise ValueError(f"reduce_scatter supports SUM/AVERAGE, got {op}")
+    SUM/AVERAGE lower to ``psum_scatter`` (the native ICI reduction).
+    MIN/MAX/PRODUCT have no scatter-reduce XLA primitive, so they lower to
+    the bandwidth-equivalent all-to-all + local reduce: each element
+    crosses the wire exactly once, then n shard-copies reduce locally —
+    the same wire cost as a ring reduce-scatter (the reference's dispatch
+    handles these ops generically, ops/collective_operations.h:209-273)."""
+    def _rs(t):
+        if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            out = lax.psum_scatter(t, axis, scatter_dimension=scatter_axis,
+                                   tiled=True)
+            if op == ReduceOp.AVERAGE:
+                out = out / lax.axis_size(axis)
+            return out
+        n = lax.axis_size(axis)
+        if t.shape[scatter_axis] % n:
+            raise ValueError(
+                f"reduce_scatter dim {scatter_axis} ({t.shape[scatter_axis]}) "
+                f"not divisible by axis size {n}")
+        # rank r receives every rank's r'th slice, stacked along
+        # scatter_axis: [..., n*chunk, ...] -> [..., n, chunk, ...]
+        gathered = lax.all_to_all(t, axis, split_axis=scatter_axis,
+                                  concat_axis=scatter_axis, tiled=True)
+        chunk = t.shape[scatter_axis] // n
+        shape = (gathered.shape[:scatter_axis] + (n, chunk)
+                 + gathered.shape[scatter_axis + 1:])
+        stacked = gathered.reshape(shape)
+        if op == ReduceOp.MIN:
+            return jnp.min(stacked, axis=scatter_axis)
+        if op == ReduceOp.MAX:
+            return jnp.max(stacked, axis=scatter_axis)
+        if op == ReduceOp.PRODUCT:
+            return jnp.prod(stacked, axis=scatter_axis)
+        raise ValueError(f"Unsupported reduce op: {op}")
+
     return jax.tree.map(_rs, x)
+
+
+def allgather_ragged(x, sizes: Sequence[int], axis: AxisName = "dp"):
+    """Allgather where rank r contributes its first ``sizes[r]`` rows —
+    the jit-path answer to the reference's first-dimension-ragged allgather
+    (AllgatherOp displacement math, ops/collective_operations.h:129).
+
+    ``sizes`` must be static (known at trace time): XLA needs static
+    shapes, so the dynamic-shape negotiation the reference does at runtime
+    moves to trace time here.  Every rank passes a uniformly padded array
+    with ``max(sizes)`` rows (SPMD requires identical per-rank shapes);
+    rows past ``sizes[rank]`` are ignored.  Returns the exact
+    ``sum(sizes)``-row concatenation, replicated (axis-invariant).
+
+    Lowering: each rank zero-embeds its valid rows at its static
+    displacement and the result is one psum — gather and invariance
+    restoration fused into a single all-reduce (see
+    ``invariant_allgather_shards`` for the equal-shard case).
+    """
+    sizes = [int(s) for s in sizes]
+    n = lax.axis_size(axis)
+    if len(sizes) != n:
+        raise ValueError(f"len(sizes)={len(sizes)} != axis size {n}")
+    maxpad = max(sizes)
+    total = sum(sizes)
+    offsets = jnp.asarray(
+        [sum(sizes[:r]) for r in range(n)], jnp.int32)
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+    idx = lax.axis_index(axis)
+
+    def _one(t):
+        if t.shape[0] != maxpad:
+            raise ValueError(
+                f"ragged allgather input must be padded to max(sizes)="
+                f"{maxpad} rows, got {t.shape[0]}")
+        mask_shape = (maxpad,) + (1,) * (t.ndim - 1)
+        mask = (jnp.arange(maxpad) < sizes_arr[idx]).reshape(mask_shape)
+        contrib = jnp.where(mask, t, jnp.zeros((), t.dtype))
+        # Embed into total+maxpad rows so the padded block never clamps;
+        # masked-zero overhang rows land in the next rank's region and
+        # add nothing under psum.
+        buf = jnp.zeros((total + maxpad,) + t.shape[1:], t.dtype)
+        buf = lax.dynamic_update_slice_in_dim(buf, contrib, offsets[idx],
+                                              axis=0)
+        return lax.psum(buf, axis)[:total]
+
+    return jax.tree.map(_one, x)
+
+
+def alltoall_uneven(x, send_splits: Sequence[Sequence[int]],
+                    axis: AxisName = "dp"):
+    """All-to-all with per-(src, dst) row counts — the jit-path analog of
+    the reference's alltoallv (AlltoallOp::PrepareOutputAndParams recv-
+    split exchange, ops/collective_operations.h:209-273).
+
+    ``send_splits[r][j]`` = rows rank r sends to rank j, static at trace
+    time (the runtime recv-split MPI exchange moves to trace time under
+    XLA's static-shape model).  Each rank's row counts must sum to the
+    (uniform) input first dimension.  Because received totals differ per
+    rank while SPMD output shapes cannot, the result is padded to the
+    largest receive total; returns ``(out, recv_count)`` where ``out`` has
+    ``max_j(sum_r send_splits[r][j])`` rows (rows past ``recv_count`` are
+    zero) and ``recv_count`` is this rank's valid-row scalar.
+
+    Wire cost: segments are padded to the largest single split for the
+    device all_to_all — bounded overhead for near-even splits (the MoE
+    capacity-padding regime this substrate targets, SURVEY.md §2.7);
+    grossly skewed splits pay padding bandwidth.
+    """
+    M = [[int(v) for v in row] for row in send_splits]
+    n = lax.axis_size(axis)
+    if len(M) != n or any(len(row) != n for row in M):
+        raise ValueError(f"send_splits must be {n}x{n}")
+    row_tot = {sum(row) for row in M}
+    if len(row_tot) != 1:
+        raise ValueError(
+            "each rank's send_splits row must sum to the same (uniform) "
+            f"input length, got sums {sorted(row_tot)}")
+    in_rows = row_tot.pop()
+    maxseg = max(max(row) for row in M)
+    recv_totals = [sum(M[r][j] for r in range(n)) for j in range(n)]
+    max_out = max(recv_totals)
+
+    send_off = jnp.asarray(
+        [[sum(row[:j]) for j in range(n)] for row in M], jnp.int32)
+    seg_len = jnp.asarray(M, jnp.int32)
+    recv_off = jnp.asarray(
+        [[sum(M[k][j] for k in range(r)) for j in range(n)]
+         for r in range(n)], jnp.int32)
+    recv_tot = jnp.asarray(recv_totals, jnp.int32)
+    idx = lax.axis_index(axis)
+
+    def _one(t):
+        if t.shape[0] != in_rows:
+            raise ValueError(
+                f"input rows {t.shape[0]} != send_splits row sum {in_rows}")
+        pad = jnp.zeros((maxseg,) + t.shape[1:], t.dtype)
+        tp = jnp.concatenate([t, pad], axis=0)
+        segs = []
+        for j in range(n):
+            seg = lax.dynamic_slice_in_dim(tp, send_off[idx, j], maxseg,
+                                           axis=0)
+            mask = (jnp.arange(maxseg) < seg_len[idx, j]).reshape(
+                (maxseg,) + (1,) * (t.ndim - 1))
+            segs.append(jnp.where(mask, seg, jnp.zeros((), t.dtype)))
+        sendbuf = jnp.concatenate(segs, axis=0)        # [n*maxseg, ...]
+        recvbuf = lax.all_to_all(sendbuf, axis, split_axis=0,
+                                 concat_axis=0, tiled=True)
+        out = jnp.zeros((max_out + maxseg,) + t.shape[1:], t.dtype)
+        for r in range(n):
+            block = lax.dynamic_slice_in_dim(recvbuf, r * maxseg, maxseg,
+                                             axis=0)
+            # blocks are already masked by the sender; valid regions are
+            # disjoint, so additive embedding assembles the compaction.
+            embed = jnp.zeros_like(out)
+            embed = lax.dynamic_update_slice_in_dim(
+                embed, block, recv_off[r, idx], axis=0)
+            out = out + embed
+        return out[:max_out]
+
+    return jax.tree.map(_one, x), recv_tot[idx]
 
 
 def broadcast(x, root_rank: int = 0, axis: AxisName = "dp"):

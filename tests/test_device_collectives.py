@@ -247,3 +247,84 @@ class TestShardedAdasum:
         want = _np_adasum_tree(list(inputs))
         np.testing.assert_allclose(np.asarray(got), want.astype(np.float32),
                                    rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("op,np_fn", [
+    (ReduceOp.MIN, np.min), (ReduceOp.MAX, np.max),
+    (ReduceOp.PRODUCT, np.prod)])
+def test_reduce_scatter_min_max_product(mesh8, op, np_fn):
+    # rank r holds a distinct (8, 3) block; rank r's output row-block is the
+    # elementwise op over all ranks' r-th slice (scatter dim = 1 row/rank).
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.uniform(0.5, 2.0, size=(8, 8, 3)), jnp.float32)
+    out = _per_rank(
+        mesh8, lambda t: dev.reduce_scatter(t[0], "dp", op=op), x,
+        in_spec=P("dp"), out_spec=P("dp"))
+    expected = np_fn(np.asarray(x), axis=0)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+def test_allgather_ragged(mesh8):
+    # rank r contributes r+1 valid rows (padded to 8); result is the exact
+    # sum(sizes)-row concatenation, identical on every rank.
+    sizes = [r + 1 for r in range(8)]
+    blocks = [np.full((sizes[r], 2), 10 * r, np.float32) + np.arange(
+        sizes[r], dtype=np.float32)[:, None] for r in range(8)]
+    padded = np.stack([
+        np.concatenate([b, np.full((8 - len(b), 2), -1, np.float32)])
+        for b in blocks])
+    out = _per_rank(
+        mesh8, lambda t: dev.allgather_ragged(t[0], sizes, "dp"),
+        jnp.asarray(padded), in_spec=P("dp"), out_spec=P("dp"))
+    expected = np.concatenate(blocks)          # (36, 2)
+    assert out.shape == (8 * 36, 2)
+    for r in range(8):                         # every rank sees the same
+        np.testing.assert_allclose(np.asarray(out)[r * 36:(r + 1) * 36],
+                                   expected)
+
+
+def test_allgather_ragged_rejects_bad_pad(mesh8):
+    with pytest.raises(ValueError, match="padded to max"):
+        _per_rank(mesh8,
+                  lambda t: dev.allgather_ragged(t[0], [1] * 8, "dp"),
+                  jnp.zeros((8, 4, 2)), in_spec=P("dp"), out_spec=P("dp"))
+
+
+def test_alltoall_uneven(mesh8):
+    # splits[r][j] = (r + j) % 3; pad rows so every rank's sends sum to the
+    # same input length.
+    n = 8
+    M = [[(r + j) % 3 for j in range(n)] for r in range(n)]
+    in_rows = max(sum(row) for row in M)
+    for row in M:                              # top-up last split to equalize
+        row[-1] += in_rows - sum(row)
+    rng = np.random.RandomState(2)
+    data = [rng.randn(in_rows, 2).astype(np.float32) for _ in range(n)]
+
+    def body(t):
+        out, cnt = dev.alltoall_uneven(t[0], M, "dp")
+        return out, jnp.broadcast_to(cnt, (1,))
+
+    out, cnts = _per_rank(mesh8, body, jnp.stack(data),
+                          in_spec=P("dp"), out_spec=(P("dp"), P("dp")))
+    recv_totals = [sum(M[r][j] for r in range(n)) for j in range(n)]
+    max_out = max(recv_totals)
+    assert out.shape == (n * max_out, 2)
+    np.testing.assert_array_equal(np.asarray(cnts), recv_totals)
+    for j in range(n):                         # reassemble expected recv
+        parts, got = [], np.asarray(out)[j * max_out:(j + 1) * max_out]
+        for r in range(n):
+            off = sum(M[r][:j])
+            parts.append(data[r][off:off + M[r][j]])
+        expected = np.concatenate(parts) if parts else np.zeros((0, 2))
+        np.testing.assert_allclose(got[:recv_totals[j]], expected, rtol=1e-6)
+        np.testing.assert_allclose(got[recv_totals[j]:], 0.0)
+
+
+def test_alltoall_uneven_rejects_bad_splits(mesh8):
+    with pytest.raises(ValueError, match="sum to the same"):
+        M = [[1] * 8 for _ in range(8)]
+        M[3][0] = 2                            # rank 3 sends 9 rows, others 8
+        _per_rank(mesh8,
+                  lambda t: dev.alltoall_uneven(t[0], M, "dp")[0],
+                  jnp.zeros((8, 8, 2)), in_spec=P("dp"), out_spec=P("dp"))

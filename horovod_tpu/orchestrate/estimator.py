@@ -6,11 +6,24 @@ distributed workers).  Petastorm/DataFrame plumbing collapses to numpy
 arrays sharded across the Executor pool; what survives is the contract:
 ``est.fit(X, y) -> model`` trains data-parallel across workers, and the
 returned model is a plain local object with ``transform``/``predict``.
+
+Two fit paths:
+
+* **declarative** (ref: KerasEstimator's model/optimizer/loss params,
+  spark/common/params.py:64-210) — pass ``model_init``/``loss_fn``/
+  ``optimizer`` plus ``epochs``/``batch_size``/``validation_split``/
+  ``store`` and the estimator runs the full distributed loop itself:
+  broadcast initial params, per-batch eager gradient allreduce across
+  worker processes, epoch metric averaging, rank-0 checkpointing into
+  the store directory (ref: store.py checkpoint dir + BestModelCheckpoint
+  rank-0 discipline).
+* **custom** (``train_fn``) — bring-your-own worker loop, as before.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+import socket
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -39,6 +52,100 @@ def _worker_fit(train_fn, fit_kwargs, x_shard, y_shard):
     return train_fn(x_shard, y_shard, **fit_kwargs)
 
 
+def _declarative_fit(spec: Dict[str, Any], x_shard, y_shard):
+    """Runs inside each Executor worker: the estimator-owned training loop.
+
+    The worker env carries JAX_PLATFORMS=cpu + HVDT_COORDINATOR_ADDR (set
+    by ``JaxEstimator.fit``), so ``hvd.init()`` connects the JAX
+    distributed runtime across the pool and eager collectives negotiate
+    through it — the same per-step gradient-allreduce shape as the
+    reference's estimator workers (ref: spark/keras/remote.py train loop).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp  # noqa: F401
+    import optax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+
+    x = np.asarray(x_shard)
+    y = np.asarray(y_shard)
+    n_val = int(round(len(x) * spec["validation_split"]))
+    x_train, y_train = x[:len(x) - n_val], y[:len(y) - n_val]
+    x_val, y_val = x[len(x) - n_val:], y[len(y) - n_val:]
+
+    params = spec["model_init"](jax.random.PRNGKey(spec["seed"]))
+    # Broadcast rank 0's init so all replicas start identical even if
+    # model_init is nondeterministic (ref: broadcast_parameters at start
+    # of training, torch/functions.py:30).
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = spec["optimizer"] or optax.adam(1e-3)
+    opt_state = opt.init(params)
+    loss_fn = spec["loss_fn"]
+
+    grad_step = jax.jit(jax.value_and_grad(loss_fn))
+    eval_loss = jax.jit(loss_fn)
+
+    bs = spec["batch_size"]
+    rng = np.random.RandomState(spec["seed"] + 101 * rank)
+    manager = None
+    if spec["store"]:
+        # All ranks construct the manager and enter save(): the write is
+        # rank-0-only inside save_checkpoint, but its completion barrier
+        # is collective.
+        from ..checkpoint import CheckpointManager
+
+        manager = CheckpointManager(spec["store"])
+
+    history: List[Dict[str, float]] = []
+    for epoch in range(spec["epochs"]):
+        order = (rng.permutation(len(x_train)) if spec["shuffle"]
+                 else np.arange(len(x_train)))
+        losses = []
+        for start in range(0, max(len(order), 1), max(bs, 1)):
+            idx = order[start:start + bs]
+            if idx.size == 0:
+                continue
+            # Pad the tail batch to full size (static shapes: one jit
+            # trace) — wrap-around rows re-weight a few samples slightly,
+            # matching the reference's repartition-to-equal-shards
+            # behavior rather than dropping data.
+            if idx.size < bs:
+                idx = np.concatenate([idx, order[:bs - idx.size]])
+            loss, grads = grad_step(params, x_train[idx], y_train[idx])
+            # One grouped (all-or-nothing fused) eager allreduce per step
+            # (ref: grouped allreduce + GroupTable, common/group_table.cc).
+            leaves, treedef = jax.tree.flatten(grads)
+            reduced = hvd.grouped_allreduce(
+                [np.asarray(g) for g in leaves], name="est_grad")
+            grads = jax.tree.unflatten(
+                treedef, [jnp.asarray(r) for r in reduced])
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            losses.append(float(loss))
+        row = {"epoch": epoch,
+               "train_loss": float(np.mean(losses)) if losses else float("nan")}
+        # Cross-worker metric averaging (ref: MetricAverageCallback,
+        # _keras/callbacks.py:49).
+        row["train_loss"] = float(np.asarray(hvd.allreduce(
+            np.asarray([row["train_loss"]], np.float32),
+            name="est_metric/train"))[0])
+        if len(x_val):
+            vl = float(eval_loss(params, x_val, y_val))
+            row["val_loss"] = float(np.asarray(hvd.allreduce(
+                np.asarray([vl], np.float32), name="est_metric/val"))[0])
+        history.append(row)
+        if manager is not None:
+            manager.save(epoch, params, force=True)
+        hvd.barrier()
+
+    return {"params": jax.tree.map(np.asarray, params), "history": history}
+
+
 class JaxEstimator:
     """Data-parallel fit over an Executor pool.
 
@@ -51,30 +158,102 @@ class JaxEstimator:
       num_workers: pool size (ref: num_proc on the spark estimators).
     """
 
-    def __init__(self, train_fn: Callable, predict_fn: Callable,
+    def __init__(self, train_fn: Optional[Callable] = None,
+                 predict_fn: Optional[Callable] = None,
                  num_workers: int = 1,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 *,
+                 model_init: Optional[Callable] = None,
+                 loss_fn: Optional[Callable] = None,
+                 optimizer: Any = None,
+                 epochs: int = 1,
+                 batch_size: int = 32,
+                 validation_split: float = 0.0,
+                 shuffle: bool = True,
+                 store: Optional[str] = None,
+                 seed: int = 0):
+        if (train_fn is None) == (model_init is None):
+            raise ValueError(
+                "pass exactly one of train_fn (custom loop) or "
+                "model_init+loss_fn (declarative loop)")
+        if model_init is not None and loss_fn is None:
+            raise ValueError("declarative fit needs loss_fn")
+        if predict_fn is None:
+            raise ValueError(
+                "predict_fn is required — the returned JaxModel's "
+                "transform/predict contract depends on it")
         self.train_fn = train_fn
         self.predict_fn = predict_fn
         self.num_workers = num_workers
         self._env = env
+        self._spec = None if model_init is None else {
+            "model_init": model_init, "loss_fn": loss_fn,
+            "optimizer": optimizer, "epochs": int(epochs),
+            "batch_size": int(batch_size),
+            "validation_split": float(validation_split),
+            "shuffle": bool(shuffle), "store": store, "seed": int(seed)}
+        self.history_: List[Dict[str, float]] = []
 
     def _shards(self, x: np.ndarray, y: Optional[np.ndarray]
                 ) -> Tuple[list, list]:
         xs = np.array_split(np.asarray(x), self.num_workers)
         ys = (np.array_split(np.asarray(y), self.num_workers)
               if y is not None else [None] * self.num_workers)
+        if self._spec is not None:
+            # Declarative workers issue name-matched collectives in
+            # lockstep, so every rank MUST see the same shard length (same
+            # batch count, same n_val) — equalize by wrapping each shard's
+            # own rows up to the largest shard (the repartition-to-equal-
+            # shards discipline of the reference's estimators,
+            # spark/common/util.py prep for equal Petastorm row groups).
+            if len(np.asarray(x)) < self.num_workers:
+                raise ValueError(
+                    f"need at least num_workers={self.num_workers} samples, "
+                    f"got {len(np.asarray(x))}")
+            target = max(len(s) for s in xs)
+
+            def pad(s):
+                if s is None or len(s) == target:
+                    return s
+                reps = [s[i % len(s)] for i in range(target - len(s))]
+                return np.concatenate([s, np.stack(reps)]) if reps else s
+
+            xs = [pad(s) for s in xs]
+            ys = [pad(s) for s in ys]
         return xs, ys
 
     def fit(self, x: np.ndarray, y: Optional[np.ndarray] = None,
             **fit_kwargs) -> JaxModel:
         xs, ys = self._shards(x, y)
-        with Executor(self.num_workers, env=self._env) as ex:
+        env = dict(self._env or {})
+        if self._spec is not None:
+            # Declarative workers run collective training: pin them to the
+            # CPU platform (an accelerator-steering outer env would make
+            # every worker claim the real TPU) and give them a JAX
+            # coordination service address so hvd.init() connects the pool.
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            env.setdefault("PALLAS_AXON_POOL_IPS", "")
+            env.setdefault("HVDT_COORDINATOR_ADDR",
+                           f"127.0.0.1:{_free_port()}")
+        with Executor(self.num_workers, env=env) as ex:
             # One concurrent dispatch — workers may collectively train
             # (allreduce etc.), so they must all enter together.  Shards
             # ride per-rank KV keys: each worker downloads only its own.
+            if self._spec is not None:
+                results = ex.run(
+                    _declarative_fit, args=(self._spec,),
+                    per_rank_args=[(xs[r], ys[r])
+                                   for r in range(self.num_workers)])
+                self.history_ = results[0]["history"]
+                return JaxModel(results[0]["params"], self.predict_fn)
             results = ex.run(_worker_fit,
                              args=(self.train_fn, fit_kwargs),
                              per_rank_args=[(xs[r], ys[r])
                                             for r in range(self.num_workers)])
         return JaxModel(results[0], self.predict_fn)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

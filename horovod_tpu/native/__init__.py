@@ -25,6 +25,10 @@ __all__ = ["available", "load", "NativeError", "TcpProcessGroup",
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            os.pardir, os.pardir, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libhvdt_core.so")
+# Installed-wheel location: setup.py ships the prebuilt library inside the
+# package (no source tree / toolchain on the install host).
+_PKG_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "_lib", "libhvdt_core.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -93,18 +97,24 @@ def load() -> ctypes.CDLL:
             return _lib
         if _load_failed is not None:
             raise NativeError(_load_failed)
-        # Always run make: the Makefile's dependency tracking no-ops when
-        # the .so is current and rebuilds it when a C++ source changed —
-        # a stale binary must never shadow the sources.  The .so is a
-        # build artifact (gitignored), not a vendored blob.
-        if not _build() and not os.path.exists(_LIB_PATH):
+        # Always run make in a source tree: the Makefile's dependency
+        # tracking no-ops when the .so is current and rebuilds it when a
+        # C++ source changed — a stale binary must never shadow the
+        # sources.  The .so is a build artifact (gitignored), not a
+        # vendored blob.  Installed wheels have no source tree; they use
+        # the library setup.py packaged next to this module.
+        if _build() or os.path.exists(_LIB_PATH):
+            lib_path = _LIB_PATH
+        elif os.path.exists(_PKG_LIB_PATH):
+            lib_path = _PKG_LIB_PATH
+        else:
             _load_failed = ("native core unavailable "
                             "(build failed and no existing .so)")
             raise NativeError(_load_failed)
         try:
-            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            _lib = _bind(ctypes.CDLL(lib_path))
         except OSError as e:  # pragma: no cover - load error surface
-            _load_failed = f"cannot load {_LIB_PATH}: {e}"
+            _load_failed = f"cannot load {lib_path}: {e}"
             raise NativeError(_load_failed)
         return _lib
 

@@ -52,7 +52,7 @@ def _worker_fit(train_fn, fit_kwargs, x_shard, y_shard):
     return train_fn(x_shard, y_shard, **fit_kwargs)
 
 
-def _declarative_fit(spec: Dict[str, Any], x_shard, y_shard):
+def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
     """Runs inside each Executor worker: the estimator-owned training loop.
 
     The worker env carries JAX_PLATFORMS=cpu + HVDT_COORDINATOR_ADDR (set
@@ -60,6 +60,12 @@ def _declarative_fit(spec: Dict[str, Any], x_shard, y_shard):
     distributed runtime across the pool and eager collectives negotiate
     through it — the same per-step gradient-allreduce shape as the
     reference's estimator workers (ref: spark/keras/remote.py train loop).
+
+    The train/validation split already happened driver-side on the global
+    dataset (``JaxEstimator.fit``): every rank receives an equal-length
+    train shard (padding never touches validation rows) and, when a
+    validation set exists, a non-empty validation shard — so the
+    validation-metric collective below is entered by every rank or none.
     """
     import jax
 
@@ -72,11 +78,8 @@ def _declarative_fit(spec: Dict[str, Any], x_shard, y_shard):
     hvd.init()
     rank = hvd.rank()
 
-    x = np.asarray(x_shard)
-    y = np.asarray(y_shard)
-    n_val = int(round(len(x) * spec["validation_split"]))
-    x_train, y_train = x[:len(x) - n_val], y[:len(y) - n_val]
-    x_val, y_val = x[len(x) - n_val:], y[len(y) - n_val:]
+    x_train = np.asarray(x_train)
+    y_train = np.asarray(y_train)
 
     params = spec["model_init"](jax.random.PRNGKey(spec["seed"]))
     # Broadcast rank 0's init so all replicas start identical even if
@@ -134,8 +137,9 @@ def _declarative_fit(spec: Dict[str, Any], x_shard, y_shard):
         row["train_loss"] = float(np.asarray(hvd.allreduce(
             np.asarray([row["train_loss"]], np.float32),
             name="est_metric/train"))[0])
-        if len(x_val):
-            vl = float(eval_loss(params, x_val, y_val))
+        if x_val is not None:
+            vl = float(eval_loss(params, np.asarray(x_val),
+                                 np.asarray(y_val)))
             row["val_loss"] = float(np.asarray(hvd.allreduce(
                 np.asarray([vl], np.float32), name="est_metric/val"))[0])
         history.append(row)
@@ -182,6 +186,9 @@ class JaxEstimator:
             raise ValueError(
                 "predict_fn is required — the returned JaxModel's "
                 "transform/predict contract depends on it")
+        if not 0.0 <= validation_split < 1.0:
+            raise ValueError(
+                f"validation_split must be in [0, 1), got {validation_split}")
         self.train_fn = train_fn
         self.predict_fn = predict_fn
         self.num_workers = num_workers
@@ -199,34 +206,64 @@ class JaxEstimator:
         xs = np.array_split(np.asarray(x), self.num_workers)
         ys = (np.array_split(np.asarray(y), self.num_workers)
               if y is not None else [None] * self.num_workers)
-        if self._spec is not None:
-            # Declarative workers issue name-matched collectives in
-            # lockstep, so every rank MUST see the same shard length (same
-            # batch count, same n_val) — equalize by wrapping each shard's
-            # own rows up to the largest shard (the repartition-to-equal-
-            # shards discipline of the reference's estimators,
-            # spark/common/util.py prep for equal Petastorm row groups).
-            if len(np.asarray(x)) < self.num_workers:
-                raise ValueError(
-                    f"need at least num_workers={self.num_workers} samples, "
-                    f"got {len(np.asarray(x))}")
-            target = max(len(s) for s in xs)
-
-            def pad(s):
-                if s is None or len(s) == target:
-                    return s
-                reps = [s[i % len(s)] for i in range(target - len(s))]
-                return np.concatenate([s, np.stack(reps)]) if reps else s
-
-            xs = [pad(s) for s in xs]
-            ys = [pad(s) for s in ys]
         return xs, ys
+
+    @staticmethod
+    def _equalize(shards: list) -> list:
+        """Wrap-pad every shard to the longest shard's length.
+
+        Declarative workers issue name-matched collectives in lockstep, so
+        every rank MUST see the same shard length (same batch count) —
+        the repartition-to-equal-shards discipline of the reference's
+        estimators (spark/common/util.py prep for equal row groups).
+        Padding duplicates a shard's OWN rows only; validation rows are
+        split off globally before this runs, so they can never leak in.
+        """
+        target = max(len(s) for s in shards)
+
+        def pad(s):
+            if s is None or len(s) == target:
+                return s
+            reps = [s[i % len(s)] for i in range(target - len(s))]
+            return np.concatenate([s, np.stack(reps)]) if reps else s
+
+        return [pad(s) for s in shards]
 
     def fit(self, x: np.ndarray, y: Optional[np.ndarray] = None,
             **fit_kwargs) -> JaxModel:
-        xs, ys = self._shards(x, y)
         env = dict(self._env or {})
         if self._spec is not None:
+            if fit_kwargs:
+                raise TypeError(
+                    "declarative fit() takes no per-call kwargs — pass "
+                    f"them to the constructor (got {sorted(fit_kwargs)})")
+            if y is None:
+                raise ValueError("declarative fit needs y (loss_fn is "
+                                 "called as loss_fn(params, xb, yb))")
+            x, y = np.asarray(x), np.asarray(y)
+            if len(x) < self.num_workers:
+                raise ValueError(
+                    f"need at least num_workers={self.num_workers} "
+                    f"samples, got {len(x)}")
+            # Global tail split (keras validation_split convention) BEFORE
+            # sharding/equalization so padded duplicates of training rows
+            # can never land in the validation set.
+            n_val = int(round(len(x) * self._spec["validation_split"]))
+            x_tr, y_tr = x[:len(x) - n_val], y[:len(y) - n_val]
+            xs, ys = self._shards(x_tr, y_tr)
+            xs, ys = self._equalize(xs), self._equalize(ys)
+            if n_val:
+                # Round-robin val shards; whole (tiny) val set per rank
+                # when there are fewer val rows than workers, so every
+                # rank enters the val-metric collective.
+                xv = [x[len(x) - n_val:][r::self.num_workers]
+                      for r in range(self.num_workers)]
+                yv = [y[len(y) - n_val:][r::self.num_workers]
+                      for r in range(self.num_workers)]
+                xv = [s if len(s) else x[len(x) - n_val:] for s in xv]
+                yv = [s if len(s) else y[len(y) - n_val:] for s in yv]
+            else:
+                xv = yv = [None] * self.num_workers
             # Declarative workers run collective training: pin them to the
             # CPU platform (an accelerator-steering outer env would make
             # every worker claim the real TPU) and give them a JAX
@@ -235,17 +272,19 @@ class JaxEstimator:
             env.setdefault("PALLAS_AXON_POOL_IPS", "")
             env.setdefault("HVDT_COORDINATOR_ADDR",
                            f"127.0.0.1:{_free_port()}")
+            with Executor(self.num_workers, env=env) as ex:
+                results = ex.run(
+                    _declarative_fit, args=(self._spec,),
+                    per_rank_args=[(xs[r], ys[r], xv[r], yv[r])
+                                   for r in range(self.num_workers)])
+            self.history_ = results[0]["history"]
+            return JaxModel(results[0]["params"], self.predict_fn)
+
+        xs, ys = self._shards(x, y)
         with Executor(self.num_workers, env=env) as ex:
             # One concurrent dispatch — workers may collectively train
             # (allreduce etc.), so they must all enter together.  Shards
             # ride per-rank KV keys: each worker downloads only its own.
-            if self._spec is not None:
-                results = ex.run(
-                    _declarative_fit, args=(self._spec,),
-                    per_rank_args=[(xs[r], ys[r])
-                                   for r in range(self.num_workers)])
-                self.history_ = results[0]["history"]
-                return JaxModel(results[0]["params"], self.predict_fn)
             results = ex.run(_worker_fit,
                              args=(self.train_fn, fit_kwargs),
                              per_rank_args=[(xs[r], ys[r])

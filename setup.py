@@ -1,0 +1,39 @@
+"""Wheel build with the native core included.
+
+The reference drives per-framework CMake builds from setup.py
+(ref: setup.py:31-66 CMakeExtension/custom_build_ext); here the single
+native artifact is libhvdt_core.so from native/Makefile (plain g++, no
+pybind11 — the Python side binds via ctypes).  The build is best-effort:
+a wheel built where no toolchain exists still works, because the loader
+(horovod_tpu/native/__init__.py) can rebuild from an sdist checkout or
+fall back to pure-Python implementations.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        native_dir = os.path.join(HERE, "native")
+        so = os.path.join(native_dir, "libhvdt_core.so")
+        try:
+            subprocess.run(["make", "-C", native_dir], check=True,
+                           capture_output=True, timeout=300)
+        except (OSError, subprocess.SubprocessError) as e:
+            print(f"warning: native core build skipped ({e}); "
+                  "the wheel will use pure-Python fallbacks")
+            return
+        dest = os.path.join(self.build_lib, "horovod_tpu", "native", "_lib")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copy2(so, dest)
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -218,3 +218,18 @@ class TestDeclarativeEstimator:
                            predict_fn=_lin_predict, num_workers=4)
         with pytest.raises(ValueError, match="at least num_workers"):
             est.fit(np.zeros((2, 3), np.float32), np.zeros(2, np.float32))
+
+    def test_fit_guards(self):
+        import optax
+
+        est = JaxEstimator(model_init=_lin_init, loss_fn=_lin_loss,
+                           predict_fn=_lin_predict, optimizer=optax.sgd(0.1),
+                           num_workers=2)
+        X = np.zeros((8, 3), np.float32)
+        with pytest.raises(TypeError, match="no per-call kwargs"):
+            est.fit(X, np.zeros(8, np.float32), epochs=10)
+        with pytest.raises(ValueError, match="needs y"):
+            est.fit(X)
+        with pytest.raises(ValueError, match=r"validation_split must be"):
+            JaxEstimator(model_init=_lin_init, loss_fn=_lin_loss,
+                         predict_fn=_lin_predict, validation_split=1.0)

@@ -99,6 +99,54 @@ def bench_eager(hvd, nbytes: int, dtype, iters: int, warmup: int):
     return min(times)
 
 
+def _eager_worker(sizes, dtype, iters):
+    """Per-rank body for --np multi-process eager measurement: measures
+    the full negotiate+host-collective round trip across real processes
+    (the reference's per-op latency regime)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rows = []
+    for nbytes in sizes:
+        t = bench_eager(hvd, nbytes, dtype, iters, 2)
+        rows.append({"bytes": nbytes, "eager_us": t * 1e6,
+                     "eager_algbw_gbps": nbytes / t / 1e9})
+    return {"rank": hvd.rank(), "size": hvd.size(), "rows": rows}
+
+
+def _run_eager_multiproc(args) -> None:
+    """--np N: spawn N real worker processes via the programmatic runner
+    and report the negotiated eager path's latency/bandwidth sweep."""
+    import functools
+
+    from horovod_tpu import runner
+
+    sizes = []
+    s = args.min_bytes
+    while s <= args.max_bytes:
+        sizes.append(s)
+        s *= 4
+    results = runner.run(
+        functools.partial(_eager_worker, sizes, args.dtype, args.iters),
+        np=args.np)
+    rows = results[0]["rows"]
+    for row in rows:
+        print(f"{_fmt_bytes(row['bytes']):>8}  eager {row['eager_us']:>10.1f}us "
+              f"algbw {row['eager_algbw_gbps']:>8.3f} GB/s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "eager_allreduce_sweep",
+        "n_processes": args.np,
+        "unit": "us",
+        "rows": rows,
+    }))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--min-bytes", type=int, default=1 << 12)
@@ -110,7 +158,14 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--eager", action="store_true",
                     help="also measure the negotiated eager path")
+    ap.add_argument("--np", type=int, default=0,
+                    help="measure the eager path across N real worker "
+                         "processes (launched via the programmatic runner)")
     args = ap.parse_args()
+
+    if args.np > 1:
+        _run_eager_multiproc(args)
+        return
 
     import jax
     import numpy as np

@@ -43,6 +43,17 @@ def _use_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def _fit_block(n: int, block: int) -> int:
+    """Largest power-of-2 reduction of ``block`` that divides ``n`` (the
+    defaults are tuned upper bounds, not divisibility requirements —
+    callers gate on 128-divisible sequence lengths, so this lands on
+    >=128 for them and degrades gracefully for anything else)."""
+    block = min(block, n)
+    while n % block:
+        block //= 2
+    return max(block, 1)
+
+
 def _kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
             oacc_ref, om_ref, ol_ref, acc_s, m_s, l_s, *, causal: bool,
             scale: float):
@@ -69,32 +80,43 @@ def _kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
         m_s[...] = m_ref[0, 0, :, :].astype(jnp.float32)
         l_s[...] = l_ref[0, 0, :, :].astype(jnp.float32)
 
-    q = q_ref[0, 0, :, :]                       # [bq, d]
-    k_blk = k_ref[0, 0, :, :]                   # [bk, d]
-    v_blk = v_ref[0, 0, :, :]
-    s = jax.lax.dot_general(
-        q, k_blk, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale       # [bq, bk]
+    def _compute():
+        q = q_ref[0, 0, :, :]                   # [bq, d]
+        k_blk = k_ref[0, 0, :, :]               # [bk, d]
+        v_blk = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            q_pos = (qo_ref[0] + iq * bq
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+            k_pos = (ko_ref[0] + ik * bk
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+            mask = q_pos >= k_pos               # [bq, bk]
+            s = jnp.where(mask, s, _NEG_INF)
+        m = m_s[...]
+        l = l_s[...]
+        acc = acc_s[...]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        acc_s[...] = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_s[...] = l * corr + p.sum(axis=-1, keepdims=True)
+        m_s[...] = m_new
+
     if causal:
-        q_pos = (qo_ref[0] + iq * bq
-                 + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
-        k_pos = (ko_ref[0] + ik * bk
-                 + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
-        mask = q_pos >= k_pos                   # [bq, bk]
-        s = jnp.where(mask, s, _NEG_INF)
-    m = m_s[...]
-    l = l_s[...]
-    acc = acc_s[...]
-    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    if causal:
-        p = jnp.where(mask, p, 0.0)
-    corr = jnp.exp(m - m_new)
-    acc_s[...] = acc * corr + jax.lax.dot_general(
-        p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    l_s[...] = l * corr + p.sum(axis=-1, keepdims=True)
-    m_s[...] = m_new
+        # Causal block pruning: when even this q-block's LAST row precedes
+        # the k-block's first position the whole tile is masked — skip both
+        # matmuls (the flops halving that makes causal flash ~2x full).
+        last_q = qo_ref[0] + iq * bq + (bq - 1)
+        first_k = ko_ref[0] + ik * bk
+        pl.when(last_q >= first_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(ik == nk - 1)
     def _flush():
@@ -160,15 +182,15 @@ def _flash_call(q, k, v, acc, m, l, q_offset, k_offset, *, causal, scale,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+                    block_q: int = 512, block_k: int = 1024) -> jax.Array:
     """Fused flash attention; layouts/API match
     parallel.ring_attention (q,k,v: [B, L, H, D]; GQA via fewer kv heads).
     """
     b, lq, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    block_q = min(block_q, lq)
-    block_k = min(block_k, k.shape[1])
+    block_q = _fit_block(lq, block_q)
+    block_k = _fit_block(k.shape[1], block_k)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -185,7 +207,7 @@ def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
                        acc: jax.Array, row_max: jax.Array,
                        row_sum: jax.Array, *, q_offset, k_offset,
                        causal: bool, scale: float,
-                       block_q: int = 128, block_k: int = 128
+                       block_q: int = 512, block_k: int = 1024
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One ring step in ring-attention layout.
 
@@ -195,8 +217,8 @@ def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
     scalar-prefetch arguments).
     """
     b, lq, h, d = q.shape
-    block_q = min(block_q, lq)
-    block_k = min(block_k, k_blk.shape[1])
+    block_q = _fit_block(lq, block_q)
+    block_k = _fit_block(k_blk.shape[1], block_k)
     qt = q.transpose(0, 2, 1, 3)
     kt = k_blk.transpose(0, 2, 1, 3)
     vt = v_blk.transpose(0, 2, 1, 3)

@@ -48,10 +48,14 @@ def test_flash_bf16():
                                rtol=3e-2, atol=3e-2)
 
 
-def test_flash_rejects_ragged_blocks():
+def test_flash_clamps_ragged_blocks():
+    # L=100 does not divide the requested 64 — the block clamp halves
+    # down to a divisor (4 here) and the kernel stays correct.
     q, k, v = _rand_qkv(3, l=100)
-    with pytest.raises(ValueError, match="divide"):
-        flash_attention(q, k, v, block_q=64, block_k=64)
+    out = flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
 
 
 def test_block_update_streams_to_full_attention():
@@ -114,3 +118,28 @@ def test_transformer_uses_flash_when_on(monkeypatch):
     got = transformer_apply(params, tokens, cfg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_fit_block_divisibility():
+    from horovod_tpu.ops.pallas_kernels import _fit_block
+
+    assert _fit_block(768, 512) == 256     # 512 does not divide 768
+    assert _fit_block(768, 1024) == 768    # min() clamp divides exactly
+    assert _fit_block(2048, 512) == 512
+    assert _fit_block(64, 512) == 64
+    assert _fit_block(100, 512) >= 1 and 100 % _fit_block(100, 512) == 0
+
+
+def test_flash_non_power_of_two_seq():
+    # L=768 is a multiple of 128 but not of the tuned 512/1024 defaults;
+    # the block clamp must make it work (regression: models gate on
+    # seq % 128 == 0).
+    import jax
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 768, 2, 64))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 768, 2, 64))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 768, 2, 64))
+    out = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)

@@ -47,11 +47,22 @@ def _fit_block(n: int, block: int) -> int:
     """Largest power-of-2 reduction of ``block`` that divides ``n`` (the
     defaults are tuned upper bounds, not divisibility requirements —
     callers gate on 128-divisible sequence lengths, so this lands on
-    >=128 for them and degrades gracefully for anything else)."""
-    block = min(block, n)
-    while n % block:
-        block //= 2
-    return max(block, 1)
+    >=128 for them and degrades gracefully for anything else).
+
+    On real TPU the block's sublane dimension must stay tile-aligned
+    (Mosaic cannot lower sub-16 sublane tiles for bf16); rather than an
+    obscure lowering error, refuse explicitly.  Interpret mode (the CPU
+    test path) has no alignment floor."""
+    fitted = min(block, n)
+    while n % fitted:
+        fitted //= 2
+    fitted = max(fitted, 1)
+    if fitted < 16 and not _use_interpret():
+        raise ValueError(
+            f"sequence length {n} only tiles at block={fitted} (<16), "
+            f"below the TPU sublane tile — pad the sequence to a multiple "
+            f"of 128")
+    return fitted
 
 
 def _kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,

@@ -24,7 +24,7 @@ PRESETS = {
     # under test — Adasum + wire compression + fused dp allreduce at
     # 24x1024x16 scale — is objective-agnostic.
     "bert-large": dict(layers=24, d_model=1024, heads=16, d_ff=4096,
-                       seq=512, vocab=30528),
+                       seq=512, vocab=30528, remat=True),
 }
 
 
@@ -47,6 +47,11 @@ def main():
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--preset", choices=sorted(PRESETS), default=None,
                    help="named model scale (overrides size flags)")
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="float32",
+                   help="activation/compute dtype (bfloat16 on TPU)")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block (trade FLOPs for HBM)")
     p.add_argument("--use-adasum", action="store_true",
                    help="Adasum gradient combination (dp-only layout)")
     p.add_argument("--bf16-allreduce", action="store_true",
@@ -95,9 +100,9 @@ def main():
     cfg = TransformerConfig(
         vocab=args.vocab, layers=args.layers, d_model=args.d_model,
         heads=args.heads, kv_heads=args.heads, d_ff=args.d_ff,
-        max_seq=args.seq, dtype=jnp.float32,
+        max_seq=args.seq, dtype=getattr(jnp, args.dtype),
         num_experts=2 * args.ep if args.ep > 1 else 0,
-        sp=args.sp, ep=args.ep, pp=args.pp)
+        sp=args.sp, ep=args.ep, pp=args.pp, remat=args.remat)
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     rules = transformer_rules()
     axes = transformer_logical_axes(cfg)

@@ -214,6 +214,24 @@ class EagerController:
             try:
                 did_work = self._run_cycle()
             except Exception as e:  # pragma: no cover - defensive
+                with self._lock:
+                    idle = (not self._entries and not self._to_announce
+                            and not self._local_join_handles)
+                if not self._running or idle:
+                    # Teardown raced a blocking control-plane call — our
+                    # own shutdown(), or a peer's coordination service
+                    # going away while this rank idles in the long-poll.
+                    # No tensor/join was in flight so nothing was lost,
+                    # but the controller is DEAD: mark it so later
+                    # enqueues raise instead of queueing forever.  A
+                    # failure DURING pending work still takes the loud
+                    # path below (elastic failure detection depends on
+                    # it).
+                    log.debug("controller loop exiting on teardown: %s", e)
+                    self._running = False
+                    self.handles.abort_all(
+                        f"controller shut down (control plane gone: {e})")
+                    return
                 log.exception("controller cycle failed: %s", e)
                 self._fail_all(f"controller cycle failed: {e}")
                 return

@@ -43,25 +43,27 @@ def _use_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _fit_block(n: int, block: int) -> int:
+def _fit_block(n: int, block: int, dtype) -> int:
     """Largest power-of-2 reduction of ``block`` that divides ``n`` (the
     defaults are tuned upper bounds, not divisibility requirements —
     callers gate on 128-divisible sequence lengths, so this lands on
     >=128 for them and degrades gracefully for anything else).
 
     On real TPU the block's sublane dimension must stay tile-aligned
-    (Mosaic cannot lower sub-16 sublane tiles for bf16); rather than an
-    obscure lowering error, refuse explicitly.  Interpret mode (the CPU
-    test path) has no alignment floor."""
+    (the per-dtype minimum sublane tile: 8 rows for f32, 16 for bf16,
+    32 for 1-byte types); Mosaic fails to lower smaller blocks with an
+    obscure error, so refuse explicitly instead.  Interpret mode (the
+    CPU test path) has no alignment floor."""
     fitted = min(block, n)
     while n % fitted:
         fitted //= 2
     fitted = max(fitted, 1)
-    if fitted < 16 and not _use_interpret():
+    floor = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+    if fitted < floor and not _use_interpret():
         raise ValueError(
-            f"sequence length {n} only tiles at block={fitted} (<16), "
-            f"below the TPU sublane tile — pad the sequence to a multiple "
-            f"of 128")
+            f"sequence length {n} only tiles at block={fitted}, below the "
+            f"TPU sublane tile ({floor} rows for {jnp.dtype(dtype).name}) "
+            f"— pad the sequence to a multiple of 128")
     return fitted
 
 
@@ -200,8 +202,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     b, lq, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    block_q = _fit_block(lq, block_q)
-    block_k = _fit_block(k.shape[1], block_k)
+    block_q = _fit_block(lq, block_q, q.dtype)
+    block_k = _fit_block(k.shape[1], block_k, k.dtype)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -228,8 +230,8 @@ def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
     scalar-prefetch arguments).
     """
     b, lq, h, d = q.shape
-    block_q = _fit_block(lq, block_q)
-    block_k = _fit_block(k_blk.shape[1], block_k)
+    block_q = _fit_block(lq, block_q, q.dtype)
+    block_k = _fit_block(k_blk.shape[1], block_k, k_blk.dtype)
     qt = q.transpose(0, 2, 1, 3)
     kt = k_blk.transpose(0, 2, 1, 3)
     vt = v_blk.transpose(0, 2, 1, 3)

@@ -72,7 +72,7 @@ def _rows(path):
     return out
 
 
-def _wait_for_progress(proc, log_path, min_lines, timeout=60):
+def _wait_for_progress(proc, log_path, min_lines, timeout=120):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(log_path) and len(_rows(log_path)) >= min_lines:
@@ -125,11 +125,12 @@ def test_elastic_scale_up_mid_training(tmp_path):
     # LR rescale on resize: base*1 before, base*2 after (linear scaling).
     assert {lr for _, s, _, lr, _ in rows if s == 1} == {BASE_LR_MILLI}
     assert {lr for _, s, _, lr, _ in rows if s == 2} == {2 * BASE_LR_MILLI}
-    # Bounded recovery: restart + re-init + re-jit within 90s (CPU sim;
-    # logged for the record).
+    # Bounded recovery: restart + re-init + re-jit (measured ~2-5s on an
+    # idle box; the generous bound absorbs single-core CI contention when
+    # the whole suite runs concurrently).
     rec = _recovery_ms(rows, 1, 2)
     print(f"scale-up recovery (restart+reinit+rejit): {rec} ms")
-    assert 0 <= rec < 90_000, f"recovery took {rec} ms"
+    assert 0 <= rec < 150_000, f"recovery took {rec} ms"
 
 
 @pytest.mark.integration

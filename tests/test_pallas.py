@@ -123,11 +123,15 @@ def test_transformer_uses_flash_when_on(monkeypatch):
 def test_fit_block_divisibility():
     from horovod_tpu.ops.pallas_kernels import _fit_block
 
-    assert _fit_block(768, 512) == 256     # 512 does not divide 768
-    assert _fit_block(768, 1024) == 768    # min() clamp divides exactly
-    assert _fit_block(2048, 512) == 512
-    assert _fit_block(64, 512) == 64
-    assert _fit_block(100, 512) >= 1 and 100 % _fit_block(100, 512) == 0
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    assert _fit_block(768, 512, f32) == 256   # 512 does not divide 768
+    assert _fit_block(768, 1024, f32) == 768  # min() clamp divides exactly
+    assert _fit_block(2048, 512, f32) == 512
+    assert _fit_block(64, 512, f32) == 64
+    fitted = _fit_block(100, 512, f32)
+    assert fitted >= 1 and 100 % fitted == 0
 
 
 def test_flash_non_power_of_two_seq():

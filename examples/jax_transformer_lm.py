@@ -24,7 +24,7 @@ PRESETS = {
     # under test — Adasum + wire compression + fused dp allreduce at
     # 24x1024x16 scale — is objective-agnostic.
     "bert-large": dict(layers=24, d_model=1024, heads=16, d_ff=4096,
-                       seq=512, vocab=30528, remat=True),
+                       seq=512, vocab=30528, remat=True, loss_chunk=8192),
 }
 
 
@@ -52,6 +52,9 @@ def main():
                    help="activation/compute dtype (bfloat16 on TPU)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (trade FLOPs for HBM)")
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help=">0: chunked-vocab cross entropy (no "
+                        "[tokens, vocab] logits tensor)")
     p.add_argument("--use-adasum", action="store_true",
                    help="Adasum gradient combination (dp-only layout)")
     p.add_argument("--bf16-allreduce", action="store_true",
@@ -59,8 +62,12 @@ def main():
                         "(dp-only layout)")
     args = p.parse_args()
     if args.preset:
+        # Preset fills in only what the user left at parser defaults, so
+        # e.g. `--preset bert-large --loss-chunk 0` reproduces the dense
+        # loss path at preset scale.
         for k, v in PRESETS[args.preset].items():
-            setattr(args, k, v)
+            if getattr(args, k) == p.get_default(k):
+                setattr(args, k, v)
 
     import jax
     import jax.numpy as jnp
@@ -102,7 +109,8 @@ def main():
         heads=args.heads, kv_heads=args.heads, d_ff=args.d_ff,
         max_seq=args.seq, dtype=getattr(jnp, args.dtype),
         num_experts=2 * args.ep if args.ep > 1 else 0,
-        sp=args.sp, ep=args.ep, pp=args.pp, remat=args.remat)
+        sp=args.sp, ep=args.ep, pp=args.pp, remat=args.remat,
+        loss_chunk=args.loss_chunk)
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     rules = transformer_rules()
     axes = transformer_logical_axes(cfg)

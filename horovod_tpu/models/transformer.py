@@ -62,6 +62,7 @@ class TransformerConfig:
     ep: int = 1                  # expert-parallel degree
     pp: int = 1                  # pipeline stages (layers % pp == 0)
     remat: bool = False          # jax.checkpoint each block
+    loss_chunk: int = 0          # >0: chunked-vocab cross entropy
 
     @property
     def head_dim(self) -> int:
@@ -263,9 +264,11 @@ def _scan_blocks(block_params, x, positions, cfg: TransformerConfig):
     return out
 
 
-def transformer_apply(params: Dict, tokens: jax.Array,
-                      cfg: TransformerConfig) -> jax.Array:
-    """Logits for next-token prediction.
+def transformer_hidden(params: Dict, tokens: jax.Array,
+                       cfg: TransformerConfig) -> jax.Array:
+    """Final-norm hidden states [batch, seq, d_model] (everything but the
+    vocab projection — split out so the chunked loss can avoid ever
+    materializing [batch, seq, vocab] logits).
 
     tokens: [batch, seq] int32 — the *local* sp shard of the sequence when
     called inside a shard_map over {'sp'} (positions are globalized with
@@ -315,15 +318,78 @@ def transformer_apply(params: Dict, tokens: jax.Array,
         if missing:
             x = lax.pcast(x, missing, to="varying")
         x = _scan_blocks(params["block"], x, positions, cfg)
-    x = _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"])
+
+
+def transformer_apply(params: Dict, tokens: jax.Array,
+                      cfg: TransformerConfig) -> jax.Array:
+    """Logits for next-token prediction (see transformer_hidden)."""
+    x = transformer_hidden(params, tokens, cfg)
     return (x @ params["embed"].astype(x.dtype).T).astype(jnp.float32)
+
+
+def _chunked_xent(x: jax.Array, embed: jax.Array, targets: jax.Array,
+                  chunk: int) -> jax.Array:
+    """Cross entropy without the [tokens, vocab] logits: scan over vocab
+    chunks with an online logsumexp, checkpointed so the backward pass
+    recomputes each chunk's logits instead of saving them.  Peak memory
+    per step drops from O(tokens x vocab) f32 to O(tokens x chunk) —
+    the lever that lets BERT-Large-scale batches fit in HBM (measured:
+    dense f32 logits at batch 128 x seq 512 x 30k vocab are 8 GB alone).
+    Numerics match the dense path up to fp reassociation."""
+    b, t, d = x.shape
+    vocab = embed.shape[0]
+    n_chunks = -(-vocab // chunk)
+    pad = n_chunks * chunk - vocab
+    w = embed.astype(x.dtype)
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad, d), x.dtype)])
+    w = w.reshape(n_chunks, chunk, d)
+    xf = x.reshape(b * t, d)
+    tgt = targets.reshape(b * t)
+
+    def body(carry, wc_ci):
+        m, s, tl = carry
+        wc, ci = wc_ci
+        logits = (xf @ wc.T).astype(jnp.float32)        # [N, chunk]
+        base = ci * chunk
+        valid = (jnp.arange(chunk) + base) < vocab
+        logits = jnp.where(valid[None, :], logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(-1))
+        s = (s * jnp.exp(m - m_new)
+             + jnp.exp(logits - m_new[:, None]).sum(-1))
+        in_chunk = (tgt >= base) & (tgt < base + chunk)
+        idx = jnp.clip(tgt - base, 0, chunk - 1)
+        tl = jnp.where(
+            in_chunk,
+            jnp.take_along_axis(logits, idx[:, None], 1)[:, 0], tl)
+        return (m_new, s, tl), None
+
+    init = (jnp.full((b * t,), -jnp.inf, jnp.float32),
+            jnp.zeros((b * t,), jnp.float32),
+            jnp.zeros((b * t,), jnp.float32))
+    # Inside a shard_map island (sp/pp) the hidden states are varying, so
+    # the scan body's outputs are too — the carry init must match the
+    # body's output vma or the scan type check rejects it.
+    vma = tuple(set(jax.typeof(xf).vma) | set(jax.typeof(tgt).vma))
+    if vma:
+        init = jax.tree.map(lambda a: lax.pcast(a, vma, to="varying"), init)
+    (m, s, tl), _ = lax.scan(jax.checkpoint(body), init,
+                             (w, jnp.arange(n_chunks)))
+    return (jnp.log(s) + m - tl).mean()
 
 
 def transformer_loss(params: Dict, tokens: jax.Array,
                      cfg: TransformerConfig) -> jax.Array:
-    """Causal LM loss (next-token cross entropy) over the local shard."""
-    logits = transformer_apply(params, tokens[:, :-1], cfg)
+    """Causal LM loss (next-token cross entropy) over the local shard.
+
+    ``cfg.loss_chunk > 0`` switches to the chunked-vocab logsumexp path
+    (no [tokens, vocab] logits tensor)."""
     targets = tokens[:, 1:]
+    if cfg.loss_chunk:
+        x = transformer_hidden(params, tokens[:, :-1], cfg)
+        return _chunked_xent(x, params["embed"], targets, cfg.loss_chunk)
+    logits = transformer_apply(params, tokens[:, :-1], cfg)
     logp = jax.nn.log_softmax(logits, -1)
     ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
     return -ll.mean()

@@ -215,21 +215,25 @@ class EagerController:
                 did_work = self._run_cycle()
             except Exception as e:  # pragma: no cover - defensive
                 with self._lock:
+                    # Idle = nothing in flight anywhere this rank knows
+                    # about: no local entries/announcements/joins AND (on
+                    # the coordinator) no other rank's requests mid-
+                    # negotiation.
                     idle = (not self._entries and not self._to_announce
-                            and not self._local_join_handles)
+                            and not self._local_join_handles
+                            and not self._message_table.pending
+                            and not any(self._joined.values()))
                 if not self._running or idle:
                     # Teardown raced a blocking control-plane call — our
                     # own shutdown(), or a peer's coordination service
                     # going away while this rank idles in the long-poll.
-                    # No tensor/join was in flight so nothing was lost,
-                    # but the controller is DEAD: mark it so later
-                    # enqueues raise instead of queueing forever.  A
-                    # failure DURING pending work still takes the loud
-                    # path below (elastic failure detection depends on
-                    # it).
+                    # Nothing was in flight so nothing was lost, but the
+                    # controller is DEAD: _fail_all (race-free under the
+                    # lock) marks it so later enqueues raise instead of
+                    # queueing forever.  Only the log level differs from
+                    # a real mid-work failure.
                     log.debug("controller loop exiting on teardown: %s", e)
-                    self._running = False
-                    self.handles.abort_all(
+                    self._fail_all(
                         f"controller shut down (control plane gone: {e})")
                     return
                 log.exception("controller cycle failed: %s", e)

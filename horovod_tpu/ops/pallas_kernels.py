@@ -43,7 +43,7 @@ def _use_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _fit_block(n: int, block: int, dtype) -> int:
+def _fit_block(n: int, block: int, *dtypes) -> int:
     """Largest power-of-2 reduction of ``block`` that divides ``n`` (the
     defaults are tuned upper bounds, not divisibility requirements —
     callers gate on 128-divisible sequence lengths, so this lands on
@@ -58,11 +58,13 @@ def _fit_block(n: int, block: int, dtype) -> int:
     while n % fitted:
         fitted //= 2
     fitted = max(fitted, 1)
-    floor = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(dtype).itemsize, 8)
+    floor = max({4: 8, 2: 16, 1: 32}.get(jnp.dtype(d).itemsize, 8)
+                for d in dtypes)
     if fitted < floor and not _use_interpret():
+        names = "/".join(jnp.dtype(d).name for d in dtypes)
         raise ValueError(
             f"sequence length {n} only tiles at block={fitted}, below the "
-            f"TPU sublane tile ({floor} rows for {jnp.dtype(dtype).name}) "
+            f"TPU sublane tile ({floor} rows for {names}) "
             f"— pad the sequence to a multiple of 128")
     return fitted
 
@@ -203,7 +205,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if scale is None:
         scale = d ** -0.5
     block_q = _fit_block(lq, block_q, q.dtype)
-    block_k = _fit_block(k.shape[1], block_k, k.dtype)
+    block_k = _fit_block(k.shape[1], block_k, k.dtype, v.dtype)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -231,7 +233,7 @@ def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
     """
     b, lq, h, d = q.shape
     block_q = _fit_block(lq, block_q, q.dtype)
-    block_k = _fit_block(k_blk.shape[1], block_k, k_blk.dtype)
+    block_k = _fit_block(k_blk.shape[1], block_k, k_blk.dtype, v_blk.dtype)
     qt = q.transpose(0, 2, 1, 3)
     kt = k_blk.transpose(0, 2, 1, 3)
     vt = v_blk.transpose(0, 2, 1, 3)

@@ -98,18 +98,19 @@ def _run_child(args) -> None:
     t0 = time.perf_counter()
     compiled = step.lower(params, stats, opt_state, images, labels).compile()
     print(f"compile: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
-    bytes_per_step = None
     try:
         cost = compiled.cost_analysis()
-        flops_per_step = float(cost["flops"])
     except Exception:
         cost = {}
+    try:
+        flops_per_step = float(cost["flops"])
+    except (KeyError, TypeError, ValueError):
         # Analytic fallback: ~3x forward FLOPs for training ResNet-50.
         flops_per_step = 3 * 4.1e9 * args.batch_size
     try:
         bytes_per_step = float(cost["bytes accessed"])
     except (KeyError, TypeError, ValueError):
-        pass
+        bytes_per_step = None
 
     # Timing contract: end every timed region with a HOST FETCH of a scalar
     # that data-depends on the last step (float(loss)), never
@@ -244,6 +245,7 @@ def main() -> None:
     print(json.dumps({
         "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
         "platform": None, "device_kind": None, "mfu": None,
+        "hbm_util": None,
         "error": "; ".join(notes)[-1500:],
     }))
 

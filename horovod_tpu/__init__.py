@@ -91,6 +91,19 @@ def __getattr__(name):
             from .ops.compression import Compression
 
             return Compression
+        if name in ("mpi_built", "mpi_enabled", "mpi_threads_supported",
+                    "gloo_built", "gloo_enabled", "nccl_built", "ddl_built",
+                    "ccl_built", "cuda_built", "rocm_built", "xla_built",
+                    "tpu_available", "native_built", "tcp_enabled"):
+            from .common import util
+
+            return getattr(util, name)
+        if name in ("start_timeline", "stop_timeline"):
+            # Dynamic timeline control at top level (ref: horovod C API
+            # horovod_start_timeline, operations.cc:1032-1064).
+            from . import timeline as _tl
+
+            return getattr(_tl, name)
         if name == "run":
             # Programmatic launcher (ref: horovod/runner/__init__.py:210
             # hvd.run) — run a function on np workers, results by rank.

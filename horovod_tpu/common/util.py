@@ -1,0 +1,99 @@
+"""Capability predicates (ref: horovod/common/util.py:137-200).
+
+Reference scripts gate behavior and tests on these
+(``hvd.nccl_built()``, ``hvd.mpi_enabled()`` — e.g.
+test/parallel/test_torch.py capability skips).  Keeping the exact names
+lets those scripts port unchanged: the GPU/MPI-transport predicates are
+honestly False here (the XLA data plane replaced them — SURVEY.md §5.8),
+and the TPU build's real capabilities get predicates of their own.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "mpi_built", "mpi_enabled", "mpi_threads_supported",
+    "gloo_built", "gloo_enabled", "nccl_built", "ddl_built", "ccl_built",
+    "cuda_built", "rocm_built",
+    "xla_built", "tpu_available", "native_built", "tcp_enabled",
+]
+
+
+def mpi_built(verbose: bool = False) -> bool:
+    """False: no MPI transport exists in this build (XLA collectives
+    replace it)."""
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def gloo_built(verbose: bool = False) -> bool:
+    """False — the host-CPU fallback here is the native TCP backend; use
+    :func:`native_built` / :func:`tcp_enabled` for that capability."""
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built(verbose: bool = False) -> bool:
+    return False
+
+
+def ddl_built(verbose: bool = False) -> bool:
+    return False
+
+
+def ccl_built(verbose: bool = False) -> bool:
+    return False
+
+
+def cuda_built(verbose: bool = False) -> bool:
+    return False
+
+
+def rocm_built(verbose: bool = False) -> bool:
+    return False
+
+
+def xla_built(verbose: bool = False) -> bool:
+    """True: the XLA data plane is this build's collective backend."""
+    return True
+
+
+def tpu_available(verbose: bool = False) -> bool:
+    """Whether an initialized-or-initializable TPU backend is present.
+
+    Honest probe of the CURRENT process's JAX platform list; unlike the
+    reference's link-time ``*_built`` checks this can differ per process
+    (CPU-pinned test children return False).
+    """
+    try:
+        import jax
+
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+def native_built(verbose: bool = False) -> bool:
+    """Whether the C++ native core (TCP collectives, Adasum VHDD,
+    timeline writer) compiled and loads — the analog of the reference's
+    transport ``*_built`` probes."""
+    from ..native import available
+
+    return available()
+
+
+def tcp_enabled() -> bool:
+    """Whether the native TCP data plane is selected for host collectives
+    (HVDT_CPU_OPERATIONS=tcp with a rank-address contract present)."""
+    from ..ops import tcp_backend
+
+    return tcp_backend.enabled()

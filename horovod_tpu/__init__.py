@@ -91,6 +91,11 @@ def __getattr__(name):
             from .ops.compression import Compression
 
             return Compression
+        if name in ("sparse_allreduce", "sparse_allreduce_async"):
+            # ref: torch/mpi_ops.py:556-578 sparse_allreduce_async
+            from .ops import sparse
+
+            return getattr(sparse, name)
         if name in ("mpi_built", "mpi_enabled", "mpi_threads_supported",
                     "gloo_built", "gloo_enabled", "nccl_built", "ddl_built",
                     "ccl_built", "cuda_built", "rocm_built", "xla_built",

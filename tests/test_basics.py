@@ -104,5 +104,6 @@ def test_reference_example_api_surface():
               "broadcast_parameters", "init", "local_rank", "local_size",
               "nccl_built", "rank", "size", "start_timeline",
               "stop_timeline", "join", "barrier", "poll", "synchronize",
-              "elastic", "run", "is_initialized", "shutdown"):
+              "elastic", "run", "is_initialized", "shutdown",
+              "sparse_allreduce", "sparse_allreduce_async"):
         assert hasattr(hvd, n), n

@@ -38,13 +38,26 @@ class _Hooks:
     """Per-parameter async-allreduce state shared by the mixin methods."""
 
     def __init__(self, optimizer, named_parameters, op, process_set,
-                 backward_passes_per_step: int):
+                 backward_passes_per_step: int, compression=None,
+                 gradient_predivide_factor: float = 1.0,
+                 num_groups: int = 0, groups=None,
+                 sparse_as_dense: bool = False):
+        from ..ops.compression import Compression
+
         self.op = op
         self.process_set = process_set
         self.k = max(1, int(backward_passes_per_step))
+        self.compression = compression or Compression.none
+        self.predivide = float(gradient_predivide_factor)
+        if self.predivide != 1.0 and op != ReduceOp.AVERAGE:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average "
+                "(ref: optimizer.py:560)")
+        self.sparse_as_dense = bool(sparse_as_dense)
         self._handles: Dict[Any, int] = {}       # param -> eager handle
         self._names: Dict[Any, str] = {}
         self._delay: Dict[Any, int] = {}         # param -> backwards left
+        self._decompress_ctx: Dict[Any, Any] = {}
         self._hook_refs = []
         self._synchronized = False               # grads already reduced
 
@@ -70,9 +83,49 @@ class _Hooks:
             names = {p: f"grad.{i}" for i, p in enumerate(params)}
         self._names = names
 
-        for p in params:
-            if not p.requires_grad:
-                continue
+        # Grouped (all-or-nothing fused) allreduce assignment
+        # (ref: optimizer.py num_groups/groups -> grouped allreduces).
+        trainable = [p for p in params if p.requires_grad]
+        self._group_of: Dict[Any, int] = {}
+        self._group_members: Dict[int, list] = {}
+        if groups is not None and num_groups:
+            raise ValueError("pass either num_groups or groups, not both")
+        if groups is not None:
+            optimized = {id(p) for p in trainable}
+            listed = set()
+            for gi, members in enumerate(groups):
+                for p in members:
+                    if id(p) in listed:
+                        raise ValueError("parameter appears in two groups")
+                    listed.add(id(p))
+                    # Only optimizer-owned trainable params get hooks and
+                    # zeros-fill, so only they can complete a group —
+                    # intersect, or a group holding frozen/non-optimized
+                    # params would never issue.
+                    if p.requires_grad and id(p) in optimized:
+                        self._group_of[p] = gi
+        elif num_groups:
+            n = max(1, min(int(num_groups), len(trainable)))
+            per = -(-len(trainable) // n)
+            for i, p in enumerate(trainable):
+                self._group_of[p] = i // per
+        for p, gi in self._group_of.items():
+            self._group_members.setdefault(gi, []).append(p)
+        self._group_pending: Dict[int, Dict[Any, np.ndarray]] = {}
+        # Stable cross-rank group ids: allocate NOW, in group-index order.
+        # Hook order (and therefore issue order) varies across ranks, so
+        # taking a fresh id at issue time would misalign the coordinator's
+        # all-or-nothing gate; construction order is deterministic
+        # (identical model/optimizer structure on every rank).
+        self._group_gid: Dict[int, int] = {}
+        if self._group_members:
+            from ..ops import eager
+
+            ctl = eager._controller()
+            for gi in sorted(self._group_members):
+                self._group_gid[gi] = ctl.next_group_id()
+
+        for p in trainable:
             self._delay[p] = self.k
             self._hook_refs.append(
                 p.register_post_accumulate_grad_hook(self._hook))
@@ -87,15 +140,31 @@ class _Hooks:
         if d <= 0:
             self._enqueue(p)
 
-    def _enqueue(self, p, zeros: bool = False) -> None:
-        from ..ops import eager
+    def _scale_factors(self):
+        """op + pre/postscale with gradient_predivide_factor folded in
+        (ref: _allreduce_grad_async, optimizer.py:197-204: averaging is
+        split into SUM with prescale 1/f and postscale f/size)."""
+        if self.predivide == 1.0:
+            return self.op, 1.0, 1.0
+        from ..common.process_sets import global_process_set
 
-        if p in self._handles:          # double-backward past the boundary
-            eager.synchronize(self._handles.pop(p))
+        ps = self.process_set or global_process_set()
+        return (ReduceOp.SUM, 1.0 / self.predivide,
+                self.predivide / ps.size())
+
+    def _grad_array(self, p, zeros: bool):
         if zeros or p.grad is None:
             grad = np.zeros(tuple(p.shape), dtype=_wire_np_dtype(p))
         else:
             g = p.grad.detach()
+            if g.is_sparse:
+                if not self.sparse_as_dense:
+                    raise NotImplementedError(
+                        "sparse gradient for "
+                        f"{self._names[p]!r}: pass sparse_as_dense=True "
+                        "(ref: optimizer.py sparse_as_dense) or use "
+                        "hvd.sparse_allreduce")
+                g = g.to_dense()
             # bf16 (and other numpy-less torch dtypes) go over the wire
             # as f32 — matching the zeros path so every rank negotiates
             # the same dtype for a name.
@@ -107,9 +176,51 @@ class _Hooks:
             grad = np.array(_to_np(g), copy=True)
             if self.k > 1:
                 grad /= self.k
-        self._handles[p] = eager.allreduce_async(
-            grad, name=self._names[p], op=self.op,
-            process_set=self.process_set)
+        # Wire compression (ref: compression.py fp16) — the zeros path
+        # compresses too, so every rank negotiates one dtype per name.
+        grad, ctx = self.compression.compress(grad)
+        self._decompress_ctx[p] = ctx
+        return np.asarray(grad)
+
+    def _enqueue(self, p, zeros: bool = False) -> None:
+        from ..ops import eager
+
+        gi = self._group_of.get(p)
+        if p in self._handles:          # re-enqueue past the boundary
+            if gi is not None:
+                # A grouped param cannot re-issue alone (its mates' old
+                # handles would desynchronize the all-or-nothing set);
+                # the hook's over-backward guard makes this unreachable
+                # in practice — refuse loudly if something new hits it.
+                raise RuntimeError(
+                    f"grouped parameter {self._names[p]!r} re-enqueued "
+                    "while its previous grouped allreduce is outstanding "
+                    "— call step()/synchronize() first")
+            eager.synchronize(self._handles.pop(p))
+        grad = self._grad_array(p, zeros)
+        op, pre, post = self._scale_factors()
+        if gi is None:
+            self._handles[p] = eager.allreduce_async(
+                grad, name=self._names[p], op=op, prescale_factor=pre,
+                postscale_factor=post, process_set=self.process_set)
+            self._synchronized = False
+            return
+        # Grouped mode: buffer until every member of the group has a
+        # gradient, then issue one all-or-nothing grouped allreduce.
+        # Deterministic name order (sorted by collective name) keeps
+        # ranks' request lists aligned regardless of autograd hook order.
+        pending = self._group_pending.setdefault(gi, {})
+        pending[p] = grad
+        if len(pending) == len(self._group_members[gi]):
+            members = sorted(pending, key=lambda q: self._names[q])
+            handles = eager.grouped_allreduce_async(
+                [pending[q] for q in members],
+                name=f"grad_group.{gi}", op=op, prescale_factor=pre,
+                postscale_factor=post, process_set=self.process_set,
+                group_id=self._group_gid[gi])
+            for q, h in zip(members, handles):
+                self._handles[q] = h
+            del self._group_pending[gi]
         self._synchronized = False
 
     def mid_accumulation(self) -> bool:
@@ -130,10 +241,16 @@ class _Hooks:
                 if p.requires_grad and p not in self._handles:
                     self._enqueue(p, zeros=p.grad is None)
         for p, handle in list(self._handles.items()):
-            out = np.asarray(eager.synchronize(handle))
-            t = torch.from_numpy(out)
+            out = eager.synchronize(handle)
+            out = self.compression.decompress(out,
+                                              self._decompress_ctx.pop(p,
+                                                                       None))
+            t = torch.from_numpy(np.asarray(out))
             with torch.no_grad():
-                if p.grad is None:
+                if p.grad is None or p.grad.is_sparse:
+                    # sparse_as_dense reduced a densified gradient; the
+                    # reduced result replaces the sparse grad outright
+                    # (ref: _DistributedOptimizer sparse_as_dense).
                     p.grad = t.view(p.shape).to(p.dtype).clone()
                 else:
                     p.grad.copy_(t.view_as(p.grad))
@@ -159,9 +276,14 @@ def _wire_np_dtype(p):
 def DistributedOptimizer(optimizer,
                          named_parameters: Optional[
                              Iterable[Tuple[str, Any]]] = None,
+                         compression=None,
+                         backward_passes_per_step: int = 1,
                          op: ReduceOp = ReduceOp.AVERAGE,
-                         process_set=None,
-                         backward_passes_per_step: int = 1):
+                         gradient_predivide_factor: float = 1.0,
+                         num_groups: int = 0,
+                         groups=None,
+                         sparse_as_dense: bool = False,
+                         process_set=None):
     """Wrap a ``torch.optim`` optimizer with gradient-allreduce hooks
     (ref: torch/optimizer.py:516 DistributedOptimizer — same call shape:
     construct your optimizer, wrap it, train as usual)::
@@ -182,11 +304,16 @@ def DistributedOptimizer(optimizer,
         "step": _step,
         "synchronize": _synchronize,
         "zero_grad": _zero_grad,
+        "skip_synchronize": _skip_synchronize,
         "_hvdt_base": base,
     })
     optimizer.__class__ = cls
-    optimizer._hvdt = _Hooks(optimizer, named, op, process_set,
-                             backward_passes_per_step)
+    optimizer._hvdt = _Hooks(
+        optimizer, named, op, process_set, backward_passes_per_step,
+        compression=compression,
+        gradient_predivide_factor=gradient_predivide_factor,
+        num_groups=num_groups, groups=groups,
+        sparse_as_dense=sparse_as_dense)
     return optimizer
 
 
@@ -217,6 +344,33 @@ def _synchronize(self):
     """Wait for all outstanding gradient allreduces and install the
     reduced gradients (ref: optimizer.py synchronize :255)."""
     self._hvdt.synchronize(self)
+
+
+def _skip_synchronize(self):
+    """Context manager: tell the following step() not to synchronize
+    again — the caller already did, e.g. around gradient clipping
+    (ref: optimizer.py skip_synchronize :303-310)::
+
+        opt.synchronize()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        with opt.skip_synchronize():
+            opt.step()
+    """
+    import contextlib
+
+    h = self._hvdt
+
+    @contextlib.contextmanager
+    def _ctx():
+        # step() itself skips re-synchronizing when h._synchronized is
+        # set, so the context only needs the misuse guard.
+        if not h._synchronized:
+            raise RuntimeError(
+                "skip_synchronize() entered without a prior synchronize() "
+                "— step() would apply unreduced gradients")
+        yield
+
+    return _ctx()
 
 
 def _zero_grad(self, set_to_none: bool = True):

@@ -76,12 +76,19 @@ class BF16Compressor(_CastCompressor):
 
     @classmethod
     def compress(cls, tensor):
-        import jax.numpy as jnp
-
         dtype = getattr(tensor, "dtype", None)
-        if dtype is not None and np.dtype(dtype).kind == "f":
-            return jnp.asarray(tensor).astype(jnp.bfloat16), dtype
-        return tensor, None
+        if dtype is None or np.dtype(dtype).kind != "f":
+            return tensor, None
+        if type(tensor).__module__.startswith("jax"):
+            import jax.numpy as jnp
+
+            return tensor.astype(jnp.bfloat16), dtype
+        # numpy path via ml_dtypes — deliberately jax-free so host-side
+        # users (the torch grad-hook optimizer) never trigger an
+        # accelerator backend init just to cast a gradient.
+        import ml_dtypes
+
+        return np.asarray(tensor).astype(ml_dtypes.bfloat16), dtype
 
     @classmethod
     def decompress(cls, tensor, ctx):

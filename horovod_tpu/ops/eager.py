@@ -780,13 +780,20 @@ def grouped_allreduce_async(tensors: Sequence, average=None,
                             name: Optional[str] = None, op=None,
                             prescale_factor: float = 1.0,
                             postscale_factor: float = 1.0,
-                            process_set: Optional[ProcessSet] = None) -> List[int]:
+                            process_set: Optional[ProcessSet] = None,
+                            group_id: Optional[int] = None) -> List[int]:
     """Grouped allreduce: all-or-nothing fusion
-    (ref: EnqueueTensorAllreduces operations.cc:1384, GroupTable)."""
+    (ref: EnqueueTensorAllreduces operations.cc:1384, GroupTable).
+
+    ``group_id`` lets callers with a fixed group structure reuse a stable
+    id: the coordinator's all-or-nothing gate keys member-name sets by
+    group id, so a caller whose groups may be ISSUED in different orders
+    on different ranks (e.g. autograd-hook order) must pre-allocate ids
+    deterministically instead of taking a fresh one per call."""
     ps = process_set or global_process_set()
     ctl = _controller()
     rop = _resolve_op(op, average)
-    gid = ctl.next_group_id()
+    gid = ctl.next_group_id() if group_id is None else int(group_id)
     base = _auto_name("grouped_allreduce", name)
     names = [f"{base}.{i}" for i in range(len(tensors))]
     ctl.register_group(gid, names)

@@ -234,3 +234,212 @@ class TestGuards:
             losses.append(float(loss.detach()))
         assert model.weight.dtype == torch.bfloat16
         assert losses[-1] < losses[0]
+
+
+class TestReferenceOptionsParity:
+    """compression / gradient_predivide_factor / groups / sparse_as_dense /
+    skip_synchronize (ref: optimizer.py:516-605 factory surface)."""
+
+    def _train(self, hvd, steps=40, **kwargs):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        model = _make_model(torch)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(), **kwargs)
+        x = torch.randn(32, 4)
+        y = x @ torch.tensor([[1.0], [-2.0], [0.5], [3.0]])
+        losses = []
+        for _ in range(steps):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        return losses, model
+
+    def test_bf16_compression_trains(self, hvd):
+        import horovod_tpu as hv
+
+        losses, _ = self._train(hvd, compression=hv.Compression.bf16)
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_fp16_compression_trains(self, hvd):
+        import horovod_tpu as hv
+
+        losses, _ = self._train(hvd, compression=hv.Compression.fp16)
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_predivide_matches_plain_average(self, hvd):
+        # size-1 world: predivide(f) = sum with pre 1/f, post f/1 — must
+        # equal plain averaging exactly.
+        l_plain, m_plain = self._train(hvd, steps=10)
+        l_pre, m_pre = self._train(hvd, steps=10,
+                                   gradient_predivide_factor=4.0)
+        np.testing.assert_allclose(l_plain, l_pre, rtol=1e-5)
+
+    def test_predivide_requires_average(self, hvd):
+        import torch
+
+        import horovod_tpu as hv
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        model = _make_model(torch)
+        with pytest.raises(ValueError, match="requires op=Average"):
+            DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=model.named_parameters(),
+                op=hv.Sum, gradient_predivide_factor=2.0)
+
+    def test_num_groups_trains_same(self, hvd):
+        l_plain, _ = self._train(hvd, steps=10)
+        l_grp, _ = self._train(hvd, steps=10, num_groups=2)
+        np.testing.assert_allclose(l_plain, l_grp, rtol=1e-6)
+
+    def test_explicit_groups(self, hvd):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        model = _make_model(torch)
+        params = list(model.parameters())
+        opt = torch.optim.SGD(params, lr=0.1)
+        opt = DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            groups=[params])            # one group holding everything
+        x = torch.randn(16, 4)
+        y = torch.zeros(16, 1)
+        loss0 = None
+        for i in range(5):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            loss0 = loss0 or float(loss)
+        assert float(loss) < loss0
+
+    def test_groups_and_num_groups_mutually_exclusive(self, hvd):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        model = _make_model(torch)
+        with pytest.raises(ValueError, match="not both"):
+            DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=model.named_parameters(),
+                num_groups=2, groups=[list(model.parameters())])
+
+    def test_sparse_grad_guard_and_densify(self, hvd):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        emb = torch.nn.Embedding(8, 3, sparse=True)
+        opt = torch.optim.SGD(emb.parameters(), lr=0.1)
+        opt = DistributedOptimizer(
+            opt, named_parameters=emb.named_parameters())
+        out = emb(torch.tensor([1, 2])).sum()
+        # the grad hook fires inside backward(), so the guard raises there
+        with pytest.raises(NotImplementedError, match="sparse_as_dense"):
+            out.backward()
+
+        emb2 = torch.nn.Embedding(8, 3, sparse=True)
+        opt2 = torch.optim.SGD(emb2.parameters(), lr=0.5)
+        opt2 = DistributedOptimizer(
+            opt2, named_parameters=emb2.named_parameters(),
+            sparse_as_dense=True)
+        before = emb2.weight.detach().clone()
+        emb2(torch.tensor([1, 2])).sum().backward()
+        opt2.step()
+        assert not torch.equal(before, emb2.weight.detach())
+
+    def test_skip_synchronize_context(self, hvd):
+        import torch
+
+        from horovod_tpu.interop.torch import DistributedOptimizer
+
+        model = _make_model(torch)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        x = torch.randn(8, 4)
+        loss = model(x).pow(2).mean()
+        loss.backward()
+        opt.synchronize()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        with opt.skip_synchronize():
+            opt.step()
+        # misuse: entering without a prior synchronize raises
+        loss = model(x).pow(2).mean()
+        loss.backward()
+        with pytest.raises(RuntimeError, match="without a prior"):
+            with opt.skip_synchronize():
+                pass
+        opt.step()
+
+
+def _worker_grouped():
+    """2-rank grouped allreduce with bf16 compression: the stable
+    cross-rank group-id contract under real multi-process negotiation."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import torch
+
+    import horovod_tpu as hvd
+    from horovod_tpu.interop.torch import DistributedOptimizer
+
+    hvd.init()
+    r = hvd.rank()
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(3, 4), torch.nn.Linear(4, 1))
+    opt = DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.2),
+        named_parameters=model.named_parameters(),
+        num_groups=2, compression=hvd.Compression.bf16)
+    xs = torch.full((4, 3), float(r + 1))
+    for _ in range(3):
+        opt.zero_grad()
+        loss = (model(xs) ** 2).mean()
+        loss.backward()
+        opt.step()
+    hvd.shutdown()
+    return {"rank": r,
+            "w": [p.detach().numpy().tolist() for p in model.parameters()]}
+
+
+def test_two_process_grouped_compressed():
+    import horovod_tpu.runner as runner
+
+    results = runner.run(_pickled(_worker_grouped), np=2)
+    by_rank = sorted(results, key=lambda o: o["rank"])
+    for a, b in zip(by_rank[0]["w"], by_rank[1]["w"]):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_group_with_non_optimized_param_still_issues(hvd):
+    """A group listing params the optimizer doesn't own must intersect
+    down to the optimized set — not deadlock waiting for hooks that will
+    never fire."""
+    import torch
+
+    from horovod_tpu.interop.torch import DistributedOptimizer
+
+    torch.manual_seed(0)
+    body = torch.nn.Linear(4, 4)
+    head = torch.nn.Linear(4, 1)
+    opt = torch.optim.SGD(head.parameters(), lr=0.1)   # head only
+    opt = DistributedOptimizer(
+        opt, named_parameters=head.named_parameters(),
+        groups=[list(body.parameters()) + list(head.parameters())])
+    x = torch.randn(8, 4)
+    loss = head(body(x)).pow(2).mean()
+    loss.backward()
+    opt.step()          # completes; head's grads were reduced
+    assert all(p.grad is not None for p in head.parameters())

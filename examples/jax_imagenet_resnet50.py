@@ -133,7 +133,9 @@ def main():
             params, stats, opt_state, loss = step(params, stats, opt_state,
                                                   xb, yb)
             n_steps += 1
-        jax.block_until_ready(loss)
+        # Host fetch, not block_until_ready (a no-op on some tunnelled
+        # PJRT backends) — the timed epoch must cover real device work.
+        float(loss)
         dt = time.perf_counter() - t0
         rate = n_steps * global_batch / dt
         avg_loss = float(np.asarray(hvd.allreduce(

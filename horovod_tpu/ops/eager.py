@@ -529,6 +529,19 @@ class EagerController:
                     + (f"+{len(resp.tensor_names)-1}" if
                        len(resp.tensor_names) > 1 else "")):
                 self._dispatch(resp, entries)
+        except Exception as e:
+            # Entries are already popped here, so the outer
+            # _fail_response cannot find them — fail their handles
+            # directly or the callers' synchronize() would hang forever.
+            # Skip handles _dispatch already completed (a fused response
+            # can fail partway through its finish loop); mark_done has no
+            # already-done guard and would overwrite a good result.
+            for entry in entries:
+                if entry is not None and not self.handles.poll(entry.handle):
+                    self.handles.mark_done(
+                        entry.handle,
+                        Status.unknown(f"{type(e).__name__}: {e}"))
+            raise
         finally:
             if self._timeline:
                 for name, shape in zip(resp.tensor_names,
@@ -737,7 +750,19 @@ def _auto_name(kind: str, name: Optional[str]) -> str:
 
 def _prep(tensor) -> Tuple[np.ndarray, bool]:
     was_jax = type(tensor).__module__.startswith("jax")
-    return np.asarray(tensor), was_jax
+    value = np.asarray(tensor)
+    if (value.dtype.kind in "iu" and value.dtype.itemsize == 8
+            and _controller().cp.size() > 1):
+        from . import tcp_backend
+
+        if not tcp_backend.enabled():
+            # Fail at the call site (rank-local, synchronous) rather than
+            # mid-collective where peers would hang — see
+            # host_collectives.check_device_representable.
+            from .host_collectives import check_device_representable
+
+            check_device_representable(value)
+    return value, was_jax
 
 
 def _resolve_op(op, average):

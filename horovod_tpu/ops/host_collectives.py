@@ -17,6 +17,7 @@ above (ops/eager.py), so these functions assume size > 1.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -25,6 +26,57 @@ from ..common.types import ReduceOp
 
 __all__ = ["host_allreduce", "host_allgather", "host_broadcast",
            "host_alltoall", "host_reducescatter"]
+
+
+def check_device_representable(value: np.ndarray) -> None:
+    """Raise (synchronously, rank-locally) when the XLA host data plane
+    cannot carry ``value`` losslessly — called at ENQUEUE time (ops/
+    eager.py _prep) so the offending rank errors at its own call site.
+    Raising later, inside the multi-process jitted collective, would
+    strand the in-range ranks in a distributed hang with no message."""
+    import jax
+
+    if (value.dtype.kind in "iu" and value.dtype.itemsize == 8
+            and not jax.config.jax_enable_x64):
+        tgt = np.int32 if value.dtype.kind == "i" else np.uint32
+        info = np.iinfo(tgt)
+        if value.size and (value.min() < info.min
+                           or value.max() > info.max):
+            raise ValueError(
+                f"{value.dtype} collective value exceeds 32-bit range and "
+                "JAX x64 is disabled — enable jax_enable_x64 or use the "
+                "TCP data plane (HVDT_CPU_OPERATIONS=tcp)")
+
+
+def _canonical_for_device(value: np.ndarray) -> np.ndarray:
+    """Make a 64-bit array safe for the XLA host data plane.
+
+    Without ``jax_enable_x64``, ``device_put`` silently downcasts 64-bit
+    inputs while the global-array assembly still declares the 64-bit
+    aval — the resulting buffer/aval mismatch CORRUPTS values (measured:
+    int64 [120, -120] MAX-allreduced to [120, 0]).  Canonicalize on the
+    host instead: ints downcast losslessly with a range check, floats
+    with a warning; callers cast the result back to the request dtype.
+    """
+    import jax
+
+    if value.dtype.itemsize != 8 or jax.config.jax_enable_x64:
+        return value
+    kind = value.dtype.kind
+    if kind in "iu":
+        # Backstop only — the user-facing check runs at enqueue time
+        # (check_device_representable); by dispatch the name is already
+        # negotiated, so a raise here strands the peers.
+        check_device_representable(value)
+        return value.astype(np.int32 if kind == "i" else np.uint32)
+    if kind == "f":
+        warnings.warn("float64 collective downcast to float32 on the XLA "
+                      "host data plane (jax_enable_x64 is off)",
+                      stacklevel=3)
+        return value.astype(np.float32)
+    if kind == "c":
+        return value.astype(np.complex64)
+    return value
 
 
 def _identity_value(op: ReduceOp, dtype: np.dtype):
@@ -95,9 +147,11 @@ def _identity_fn(mesh):
 
 
 def _make_global(mesh, rows_per_device: Dict[Any, np.ndarray],
-                 row_shape: Tuple[int, ...], dtype) -> Any:
+                 row_shape: Tuple[int, ...]) -> Any:
     """Build a global (D, *row_shape) array where device d holds
-    rows_per_device[d]."""
+    rows_per_device[d] (dtype comes from the buffers themselves — which
+    is exactly why 64-bit inputs must be canonicalized BEFORE device_put,
+    see _canonical_for_device)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -129,15 +183,23 @@ def host_allreduce(value: np.ndarray, process_set, op: ReduceOp) -> np.ndarray:
         return tcp_backend.tcp_allreduce(np.ascontiguousarray(value),
                                          process_set, op)
     mesh = _flat_mesh(process_set.mesh)
-    value = np.ascontiguousarray(value)
+    orig_dtype = value.dtype
+    value = _canonical_for_device(np.ascontiguousarray(value))
     calc_dtype = value.dtype
     if op == ReduceOp.PRODUCT and value.dtype.kind in "iu":
-        calc_dtype = np.float64  # avoid int overflow surprises in prod
+        import jax
+
+        # f64 avoids int overflow in products — but only when the device
+        # path can actually carry f64; with x64 off, keep the integer
+        # type (C/MPI wraparound semantics) rather than silently rounding
+        # through float32.
+        if jax.config.jax_enable_x64:
+            calc_dtype = np.float64
     rows = _contribution_rows(mesh, value.astype(calc_dtype),
                               _identity_value(op, np.dtype(calc_dtype)))
-    g = _make_global(mesh, rows, value.shape, calc_dtype)
+    g = _make_global(mesh, rows, value.shape)
     out = _reduce_fn(mesh, op, process_set.size())(g)
-    return np.asarray(out.addressable_data(0)).astype(value.dtype)
+    return np.asarray(out.addressable_data(0)).astype(orig_dtype)
 
 
 def host_broadcast(value: Optional[np.ndarray], root_rank: int, process_set,
@@ -155,8 +217,9 @@ def host_broadcast(value: Optional[np.ndarray], root_rank: int, process_set,
     is_root = process_set.rank() == root_rank
     contrib = (np.ascontiguousarray(value) if is_root
                else np.zeros(shape, dtype))
+    contrib = _canonical_for_device(contrib)
     rows = _contribution_rows(mesh, contrib, 0.0)
-    g = _make_global(mesh, rows, tuple(shape), np.dtype(dtype))
+    g = _make_global(mesh, rows, tuple(shape))
     out = _reduce_fn(mesh, ReduceOp.SUM, process_set.size())(g)
     return np.asarray(out.addressable_data(0)).astype(dtype)
 
@@ -172,7 +235,8 @@ def host_allgather(value: np.ndarray, process_set,
         return tcp_backend.tcp_allgather(np.ascontiguousarray(value),
                                          process_set)
     mesh = _flat_mesh(process_set.mesh)
-    value = np.ascontiguousarray(value)
+    orig_dtype = value.dtype
+    value = _canonical_for_device(np.ascontiguousarray(value))
     max0 = max(all_dim0) if all_dim0 else 0
     rest = value.shape[1:]
     padded = np.zeros((max0,) + rest, value.dtype)
@@ -180,7 +244,7 @@ def host_allgather(value: np.ndarray, process_set,
     # Row for first local device = my padded block; zeros elsewhere.  The
     # replicated identity jit forces an all-gather of every row.
     rows = _contribution_rows(mesh, padded, 0.0)
-    g = _make_global(mesh, rows, (max0,) + rest, value.dtype)
+    g = _make_global(mesh, rows, (max0,) + rest)
     full = np.asarray(_identity_fn(mesh)(g).addressable_data(0))
     # row index of each process's first local device in mesh order
     devs = list(mesh.devices.flat)
@@ -194,7 +258,8 @@ def host_allgather(value: np.ndarray, process_set,
     for set_rank, proc in enumerate(proc_ids):
         n = all_dim0[set_rank]
         pieces.append(full[first_row_of_proc[proc], :n])
-    return np.concatenate(pieces, axis=0) if pieces else value
+    out = np.concatenate(pieces, axis=0) if pieces else value
+    return out.astype(orig_dtype)
 
 
 def host_alltoall(value: np.ndarray, splits: Sequence[int], process_set,

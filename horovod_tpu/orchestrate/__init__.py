@@ -11,6 +11,6 @@ wrapper the Spark estimators provided.
 
 from .executor import Executor
 from .ray_adapter import RayExecutor
-from .estimator import JaxEstimator
+from .estimator import JaxEstimator, ParquetSource
 
-__all__ = ["Executor", "RayExecutor", "JaxEstimator"]
+__all__ = ["Executor", "RayExecutor", "JaxEstimator", "ParquetSource"]

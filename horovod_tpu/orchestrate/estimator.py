@@ -22,6 +22,7 @@ Two fit paths:
 
 from __future__ import annotations
 
+import dataclasses
 import socket
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -29,7 +30,23 @@ import numpy as np
 
 from .executor import Executor
 
-__all__ = ["JaxEstimator", "JaxModel"]
+__all__ = ["JaxEstimator", "JaxModel", "ParquetSource"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ParquetSource:
+    """Train directly from a Parquet file (ref: the Spark estimators'
+    defining input path — Petastorm over Parquet row groups,
+    spark/common/util.py).  Workers read only their assigned row groups;
+    the driver never materializes the dataset.
+
+    feature_cols: columns forming the feature matrix (None = all columns
+    except ``label_col``).
+    """
+
+    path: str
+    label_col: str
+    feature_cols: Optional[Tuple[str, ...]] = None
 
 
 class JaxModel:
@@ -52,6 +69,50 @@ def _worker_fit(train_fn, fit_kwargs, x_shard, y_shard):
     return train_fn(x_shard, y_shard, **fit_kwargs)
 
 
+def _load_parquet_shard(hvd, spec: Dict[str, Any], row_groups):
+    """Worker-side Parquet ingestion: read this rank's row groups, split
+    validation locally (before padding, so no train row can leak in), and
+    wrap-pad train rows to the cross-rank MAX length so every rank runs
+    the same number of lockstep collective steps."""
+    import pyarrow.parquet as pq
+
+    meta = spec["parquet"]
+    pf = pq.ParquetFile(meta["path"])
+    table = pf.read_row_groups(list(row_groups))
+    label = meta["label_col"]
+    feats = meta["feature_cols"] or [c for c in table.column_names
+                                     if c != label]
+    x = np.column_stack(
+        [np.asarray(table[c], dtype=np.float32) for c in feats])
+    # Labels keep their native dtype (int labels index logits in
+    # classification losses; array-mode fit preserves the caller's dtype
+    # too).
+    y = np.asarray(table[label].to_numpy(zero_copy_only=False))
+
+    split = spec["validation_split"]
+    n_val = max(1, int(round(len(x) * split))) if split > 0 else 0
+    x_train, y_train = x[:len(x) - n_val], y[:len(y) - n_val]
+    x_val = x[len(x) - n_val:] if n_val else None
+    y_val = y[len(y) - n_val:] if n_val else None
+
+    # Equal lockstep length across ranks: wrap-pad to the max shard size.
+    # One MAX allreduce carries (len, -len) so every rank also learns the
+    # MIN — a rank with zero train rows must fail on ALL ranks at once,
+    # not strand the others in the next collective until timeout.
+    agg = np.asarray(hvd.allreduce(
+        np.asarray([len(x_train), -len(x_train)], np.int64), op=hvd.Max,
+        name="est_parquet/target"))
+    target, min_len = int(agg[0]), int(-agg[1])
+    if min_len == 0:
+        raise ValueError("a worker received only validation rows — "
+                         "use more row groups or a smaller split")
+    if len(x_train) < target:
+        reps = [i % len(x_train) for i in range(target - len(x_train))]
+        x_train = np.concatenate([x_train, x_train[reps]])
+        y_train = np.concatenate([y_train, y_train[reps]])
+    return x_train, y_train, x_val, y_val
+
+
 def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
     """Runs inside each Executor worker: the estimator-owned training loop.
 
@@ -61,11 +122,14 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
     through it — the same per-step gradient-allreduce shape as the
     reference's estimator workers (ref: spark/keras/remote.py train loop).
 
-    The train/validation split already happened driver-side on the global
-    dataset (``JaxEstimator.fit``): every rank receives an equal-length
-    train shard (padding never touches validation rows) and, when a
-    validation set exists, a non-empty validation shard — so the
-    validation-metric collective below is entered by every rank or none.
+    Lockstep invariant (the val-metric collective below must be entered
+    by every rank or none, and batch counts must match): in ARRAY mode
+    the driver established it before dispatch — global tail split, then
+    equal-length train shards (padding never touches validation rows).
+    In PARQUET mode ``_load_parquet_shard`` establishes the same
+    invariant worker-side: local pre-padding split with ``n_val >= 1``
+    whenever validation is on, then MAX-allreduce wrap-padding of the
+    train rows.  Any change to either path must preserve both halves.
     """
     import jax
 
@@ -78,6 +142,12 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
     hvd.init()
     rank = hvd.rank()
 
+    if spec.get("parquet"):
+        # Parquet mode: x_train carries this rank's ROW-GROUP indices; the
+        # worker reads only those groups (the Petastorm-shape contract —
+        # ref: spark/common/util.py Parquet row-group partitioning).
+        x_train, y_train, x_val, y_val = _load_parquet_shard(
+            hvd, spec, x_train)
     x_train = np.asarray(x_train)
     y_train = np.asarray(y_train)
 
@@ -232,11 +302,18 @@ class JaxEstimator:
     def fit(self, x: np.ndarray, y: Optional[np.ndarray] = None,
             **fit_kwargs) -> JaxModel:
         env = dict(self._env or {})
+        if isinstance(x, ParquetSource) and self._spec is None:
+            raise ValueError(
+                "ParquetSource requires the declarative estimator "
+                "(model_init/loss_fn); a custom train_fn receives numpy "
+                "shards")
         if self._spec is not None:
             if fit_kwargs:
                 raise TypeError(
                     "declarative fit() takes no per-call kwargs — pass "
                     f"them to the constructor (got {sorted(fit_kwargs)})")
+            if isinstance(x, ParquetSource):
+                return self._fit_parquet(x, y, env)
             if y is None:
                 raise ValueError("declarative fit needs y (loss_fn is "
                                  "called as loss_fn(params, xb, yb))")
@@ -264,21 +341,9 @@ class JaxEstimator:
                 yv = [s if len(s) else y[len(y) - n_val:] for s in yv]
             else:
                 xv = yv = [None] * self.num_workers
-            # Declarative workers run collective training: pin them to the
-            # CPU platform (an accelerator-steering outer env would make
-            # every worker claim the real TPU) and give them a JAX
-            # coordination service address so hvd.init() connects the pool.
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            env.setdefault("PALLAS_AXON_POOL_IPS", "")
-            env.setdefault("HVDT_COORDINATOR_ADDR",
-                           f"127.0.0.1:{_free_port()}")
-            with Executor(self.num_workers, env=env) as ex:
-                results = ex.run(
-                    _declarative_fit, args=(self._spec,),
-                    per_rank_args=[(xs[r], ys[r], xv[r], yv[r])
-                                   for r in range(self.num_workers)])
-            self.history_ = results[0]["history"]
-            return JaxModel(results[0]["params"], self.predict_fn)
+            return self._run_declarative(
+                self._spec, [(xs[r], ys[r], xv[r], yv[r])
+                             for r in range(self.num_workers)], env)
 
         xs, ys = self._shards(x, y)
         with Executor(self.num_workers, env=env) as ex:
@@ -290,6 +355,49 @@ class JaxEstimator:
                              per_rank_args=[(xs[r], ys[r])
                                             for r in range(self.num_workers)])
         return JaxModel(results[0], self.predict_fn)
+
+
+    def _fit_parquet(self, source: ParquetSource, y, env) -> JaxModel:
+        """Assign Parquet row groups round-robin and let each worker read
+        its own (driver touches only metadata)."""
+        import pyarrow.parquet as pq
+
+        if y is not None:
+            raise ValueError(
+                "ParquetSource carries labels via label_col; pass y=None")
+        n_rg = pq.ParquetFile(source.path).metadata.num_row_groups
+        if n_rg < self.num_workers:
+            raise ValueError(
+                f"{source.path} has {n_rg} row groups < num_workers="
+                f"{self.num_workers}; rewrite with smaller row groups "
+                "or fewer workers")
+        assign = [list(range(r, n_rg, self.num_workers))
+                  for r in range(self.num_workers)]
+        spec = dict(self._spec)
+        spec["parquet"] = {"path": source.path,
+                           "label_col": source.label_col,
+                           "feature_cols": (list(source.feature_cols)
+                                            if source.feature_cols
+                                            else None)}
+        return self._run_declarative(
+            spec, [(assign[r], None, None, None)
+                   for r in range(self.num_workers)], env)
+
+    def _run_declarative(self, spec, per_rank_args, env) -> JaxModel:
+        """Shared dispatch tail for both declarative input modes.
+
+        Workers run collective training: pin them to the CPU platform (an
+        accelerator-steering outer env would make every worker claim the
+        real TPU) and give them a JAX coordination service address so
+        ``hvd.init()`` connects the pool."""
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
+        env.setdefault("HVDT_COORDINATOR_ADDR", f"127.0.0.1:{_free_port()}")
+        with Executor(self.num_workers, env=env) as ex:
+            results = ex.run(_declarative_fit, args=(spec,),
+                             per_rank_args=per_rank_args)
+        self.history_ = results[0]["history"]
+        return JaxModel(results[0]["params"], self.predict_fn)
 
 
 def _free_port() -> int:

@@ -207,3 +207,49 @@ def test_two_process_error_battery():
         assert out["op_mismatch"] != "no-error"
         # and the controller keeps working afterwards
         np.testing.assert_allclose(out["recovered"], [3.0, 3.0])
+
+
+def _worker_64bit():
+    """64-bit dtype regression: without x64, device_put used to corrupt
+    int64 through the host data plane (negative MAX clamped to 0)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {}
+    out["max"] = np.asarray(hvd.allreduce(
+        np.asarray([120, -120 - r], np.int64), op=hvd.Max,
+        name="i64max")).tolist()
+    out["min"] = np.asarray(hvd.allreduce(
+        np.asarray([7 + r, -5], np.int64), op=hvd.Min,
+        name="i64min")).tolist()
+    try:
+        hvd.allreduce(np.asarray([2 ** 40], np.int64), op=hvd.Max,
+                      name="i64big")
+        out["overflow"] = "no error"
+    except Exception as e:
+        out["overflow"] = type(e).__name__
+    hvd.shutdown()
+    return out
+
+
+def test_two_process_int64_minmax():
+    from conftest import pickle_by_value
+
+    import horovod_tpu.runner as runner
+
+    results = runner.run(pickle_by_value(_worker_64bit), np=2)
+    for out in results:
+        assert out["max"] == [120, -120]
+        assert out["min"] == [7, -5]
+        # raised synchronously at the call site (enqueue-time check) so
+        # peers are never stranded mid-collective
+        assert out["overflow"] == "ValueError"

@@ -233,3 +233,60 @@ class TestDeclarativeEstimator:
         with pytest.raises(ValueError, match=r"validation_split must be"):
             JaxEstimator(model_init=_lin_init, loss_fn=_lin_loss,
                          predict_fn=_lin_predict, validation_split=1.0)
+
+
+class TestParquetEstimator:
+    def test_fit_from_parquet_row_groups(self, tmp_path):
+        import optax
+        import pandas as pd
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+
+        from horovod_tpu.orchestrate import ParquetSource
+
+        rng = np.random.default_rng(9)
+        true_w = np.array([2.0, -1.0, 0.5], np.float32)
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        y = (X @ true_w).astype(np.float32)
+        df = pd.DataFrame({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                           "label": y})
+        path = str(tmp_path / "train.parquet")
+        # several small row groups so 2 workers get distinct shards
+        pq.write_table(pa.Table.from_pandas(df), path, row_group_size=50)
+
+        est = JaxEstimator(
+            model_init=_lin_init, loss_fn=_lin_loss,
+            predict_fn=_lin_predict, optimizer=optax.sgd(0.3),
+            epochs=3, batch_size=25, validation_split=0.2,
+            num_workers=2, seed=2)
+        model = est.fit(ParquetSource(path, label_col="label"))
+        np.testing.assert_allclose(model.predict(X), y, atol=0.3)
+        assert est.history_[-1]["val_loss"] < est.history_[0]["val_loss"]
+
+    def test_parquet_guards(self, tmp_path):
+        import pandas as pd
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+
+        from horovod_tpu.orchestrate import ParquetSource
+
+        df = pd.DataFrame({"f0": [1.0, 2.0], "label": [0.0, 1.0]})
+        path = str(tmp_path / "tiny.parquet")
+        pq.write_table(pa.Table.from_pandas(df), path, row_group_size=2)
+        est = JaxEstimator(model_init=_lin_init, loss_fn=_lin_loss,
+                           predict_fn=_lin_predict, num_workers=4)
+        with pytest.raises(ValueError, match="row groups < num_workers"):
+            est.fit(ParquetSource(path, label_col="label"))
+        est2 = JaxEstimator(model_init=_lin_init, loss_fn=_lin_loss,
+                            predict_fn=_lin_predict, num_workers=1)
+        with pytest.raises(ValueError, match="y=None"):
+            est2.fit(ParquetSource(path, label_col="label"),
+                     np.zeros(2, np.float32))
+
+    def test_parquet_rejected_on_custom_path(self, tmp_path):
+        from horovod_tpu.orchestrate import ParquetSource
+
+        est = JaxEstimator(_fit_linear, _predict_linear, num_workers=1)
+        with pytest.raises(ValueError, match="declarative estimator"):
+            est.fit(ParquetSource(str(tmp_path / "x.parquet"),
+                                  label_col="y"))

@@ -1,0 +1,21 @@
+"""Tiny training main for the static-CLI end-to-end test
+(analog of ref: test/integration/data/run_main.py driven by
+test_static_run.py)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+import horovod_tpu as hvd
+
+hvd.init()
+r, s = hvd.rank(), hvd.size()
+red = np.asarray(hvd.allreduce(np.full(3, float(r + 1), np.float32),
+                               name="static_main"))
+# AVERAGE of (1, 2) = 1.5 with 2 ranks
+print(f"STATIC_MAIN rank={r} size={s} red={red[0]:.2f}", flush=True)
+hvd.shutdown()

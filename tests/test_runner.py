@@ -345,3 +345,30 @@ class TestConfigParser:
         from horovod_tpu import runner
 
         assert hvd.run is runner.run
+
+
+@pytest.mark.integration
+def test_static_cli_end_to_end(tmp_path):
+    """The real CLI as a subprocess: `hvdtrun -np 2 -- python main.py`
+    (ref: test/integration/test_static_run.py)."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--coordinator-port", "29763",
+         "--fusion-threshold-mb", "8",
+         "--", sys.executable,
+         os.path.join(repo, "tests", "data", "static_main.py")],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=180)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    text = out.stdout
+    assert "STATIC_MAIN rank=0 size=2 red=1.50" in text
+    assert "STATIC_MAIN rank=1 size=2 red=1.50" in text

@@ -22,8 +22,13 @@ import argparse
 import os
 
 # Torch does the compute; JAX is only the communication runtime here, so
-# pin it to CPU regardless of what the outer environment points JAX at.
+# pin it to CPU regardless of what the outer environment points JAX at —
+# both the env var (pre-registration) and the config (a sitecustomize may
+# have force-registered an accelerator platform at interpreter start).
 os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import torch
 import torch.nn as nn

@@ -372,3 +372,27 @@ def test_static_cli_end_to_end(tmp_path):
     text = out.stdout
     assert "STATIC_MAIN rank=0 size=2 red=1.50" in text
     assert "STATIC_MAIN rank=1 size=2 red=1.50" in text
+
+
+@pytest.mark.integration
+def test_ported_torch_mnist_under_cli(tmp_path):
+    """The porting-guide proof artifact keeps working: the reference's
+    pytorch_mnist port runs under the real CLI with 2 workers."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", "")})
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--coordinator-port", "29764",
+         "--", sys.executable,
+         os.path.join(repo, "examples", "torch_mnist_ported.py"),
+         "--epochs", "1", "--train-size", "512", "--test-batch-size",
+         "256", "--log-interval", "100"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "Test set: Average loss" in out.stdout

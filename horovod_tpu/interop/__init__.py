@@ -3,5 +3,8 @@
 The reference binds TF/PyTorch/MXNet natively (SURVEY.md §2.4); here JAX
 is the first-class citizen and other frameworks interoperate through the
 eager named-collective path (host arrays ride the same negotiation,
-fusion, and data plane).  Available adapters: ``interop.torch``.
+fusion, and data plane).  Available adapters: ``interop.torch`` (incl.
+the grad-hook ``DistributedOptimizer``), ``interop.tf``
+(``DistributedGradientTape``, ``broadcast_variables``, Keras callbacks).
+Both import their framework lazily.
 """

@@ -1,0 +1,239 @@
+"""TensorFlow interop — the reference's TF binding surface on this
+framework's eager controller.
+
+Re-conception of ref: horovod/tensorflow/__init__.py (allreduce :55,
+DistributedGradientTape :758-842), tensorflow/functions.py
+(broadcast_variables), _keras/callbacks.py (BroadcastGlobalVariables,
+MetricAverage).  TF eager tensors cross into the controller as numpy
+(same adapter shape as interop/torch.py); collectives are differentiable
+via ``tf.custom_gradient`` exactly like the reference registers TF
+gradients for its custom ops (ref: tensorflow/mpi_ops.py gradient
+registrations).
+
+TensorFlow is imported lazily — importing horovod_tpu.interop.tf only
+costs TF when a function is first called.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..common.types import ReduceOp
+
+__all__ = ["allreduce", "allgather", "broadcast", "broadcast_variables",
+           "DistributedGradientTape", "BroadcastGlobalVariablesCallback",
+           "MetricAverageCallback"]
+
+
+def _to_np(t) -> np.ndarray:
+    return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+
+
+def allreduce(tensor, name: Optional[str] = None,
+              op: ReduceOp = ReduceOp.AVERAGE,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              process_set=None):
+    """Differentiable allreduce of a TF tensor (ref: tensorflow/
+    __init__.py:55 allreduce; gradient = allreduce of the upstream
+    gradient with the same op, ref: mpi_ops.py _allreduce_grad)."""
+    import tensorflow as tf
+
+    from ..ops import eager
+
+    @tf.custom_gradient
+    def _ar(x):
+        red = eager.allreduce(_to_np(x), name=name, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
+        out = tf.convert_to_tensor(np.asarray(red), dtype=x.dtype)
+
+        def grad(dy):
+            # Same op AND the same pre/postscale as the forward op (ref:
+            # _allreduce_grad reads both factors off the op attrs).
+            g = eager.allreduce(
+                _to_np(dy), name=None if name is None else f"{name}.grad",
+                op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set)
+            return tf.convert_to_tensor(np.asarray(g), dtype=dy.dtype)
+
+        return out, grad
+
+    return _ar(tf.convert_to_tensor(tensor))
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    """Allgather along dim 0 (ref: tensorflow allgather; ragged sizes
+    negotiated by the controller)."""
+    import tensorflow as tf
+
+    from ..ops import eager
+
+    out = eager.allgather(_to_np(tensor), name=name,
+                          process_set=process_set)
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
+    import tensorflow as tf
+
+    from ..ops import eager
+
+    out = eager.broadcast(_to_np(tensor), root_rank, name=name,
+                          process_set=process_set)
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def broadcast_variables(variables: Iterable, root_rank: int = 0,
+                        process_set=None) -> None:
+    """Assign rank ``root_rank``'s values into ``variables`` on every rank
+    (ref: tensorflow/functions.py broadcast_variables)."""
+    from ..functions import broadcast_parameters
+
+    variables = list(variables)
+    synced = broadcast_parameters([v.numpy() for v in variables],
+                                  root_rank=root_rank,
+                                  process_set=process_set)
+    for v, val in zip(variables, synced):
+        v.assign(val)
+
+
+class DistributedGradientTape:
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` returns allreduced
+    gradients (ref: tensorflow/__init__.py:758 _DistributedGradientTape).
+
+    Usage::
+
+        with tf.GradientTape() as tape:
+            loss = loss_fn(model(x))
+        tape = hvd.interop.tf.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+    """
+
+    def __init__(self, tape, op: ReduceOp = ReduceOp.AVERAGE,
+                 compression=None, process_set=None,
+                 sparse_as_dense: bool = False):
+        from ..ops.compression import Compression
+
+        self._tape = tape
+        self._op = op
+        self._compression = compression or Compression.none
+        self._process_set = process_set
+        self._sparse_as_dense = sparse_as_dense
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    # Implicit dunder lookup bypasses instance __getattr__, so the
+    # context-manager protocol must be delegated explicitly for the
+    # `with DistributedGradientTape(tf.GradientTape()):` porting pattern.
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
+        import tensorflow as tf
+
+        from ..ops import eager
+
+        grads = self._tape.gradient(target, sources,
+                                    output_gradients=output_gradients,
+                                    **kwargs)
+        # Arbitrary nests (dict/list-of-lists), like tf.GradientTape
+        # itself (ref uses tf.nest the same way).
+        flat = tf.nest.flatten(grads)
+        handles, ctxs = [], []
+        for i, g in enumerate(flat):
+            if g is None:
+                handles.append(None)
+                ctxs.append(None)
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                if not self._sparse_as_dense:
+                    raise NotImplementedError(
+                        "IndexedSlices gradient (embedding layer?): pass "
+                        "sparse_as_dense=True to DistributedGradientTape "
+                        "(ref: tensorflow sparse_as_dense) or allreduce "
+                        "via hvd.sparse_allreduce")
+                g = tf.convert_to_tensor(g)
+            arr, ctx = self._compression.compress(_to_np(g))
+            ctxs.append(ctx)
+            handles.append(eager.allreduce_async(
+                np.asarray(arr), name=f"tfgrad.{i}", op=self._op,
+                process_set=self._process_set))
+        out = []
+        for g, h, ctx in zip(flat, handles, ctxs):
+            if h is None:
+                out.append(None)
+                continue
+            red = self._compression.decompress(eager.synchronize(h), ctx)
+            dtype = (g.dtype if isinstance(g, tf.IndexedSlices)
+                     else getattr(g, "dtype", None))
+            out.append(tf.convert_to_tensor(np.asarray(red), dtype=dtype))
+        return tf.nest.pack_sequence_as(grads, out)
+
+
+def _keras_callback_base():
+    import tensorflow as tf
+
+    return tf.keras.callbacks.Callback
+
+
+class BroadcastGlobalVariablesCallback:
+    """Keras callback: broadcast initial model+optimizer variables from
+    ``root_rank`` on the first batch (ref: _keras/callbacks.py:28)."""
+
+    def __new__(cls, root_rank: int = 0, *, process_set=None):
+        Base = _keras_callback_base()
+
+        class _Impl(Base):
+            def __init__(self):
+                super().__init__()
+                self._done = False
+
+            def on_train_batch_end(self, batch, logs=None):
+                # after the first batch: optimizer slots now exist
+                # (ref: broadcast happens on_batch_end of batch 0)
+                if self._done:
+                    return
+                broadcast_variables(self.model.variables,
+                                    root_rank=root_rank,
+                                    process_set=process_set)
+                opt_vars = getattr(self.model.optimizer, "variables", None)
+                if callable(opt_vars):
+                    opt_vars = opt_vars()
+                if opt_vars:
+                    broadcast_variables(opt_vars, root_rank=root_rank,
+                                        process_set=process_set)
+                self._done = True
+
+        return _Impl()
+
+
+class MetricAverageCallback:
+    """Keras callback: allreduce-average epoch metrics across ranks
+    (ref: _keras/callbacks.py:49 MetricAverageCallback)."""
+
+    def __new__(cls, *, process_set=None):
+        Base = _keras_callback_base()
+
+        class _Impl(Base):
+            def on_epoch_end(self, epoch, logs=None):
+                from ..ops import eager
+
+                if not logs:
+                    return
+                for k in sorted(logs):
+                    v = logs[k]
+                    if isinstance(v, (int, float, np.floating)):
+                        logs[k] = float(np.asarray(eager.allreduce(
+                            np.float32(v), name=f"metric.{k}")))
+
+        return _Impl()

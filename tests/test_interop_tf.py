@@ -1,0 +1,186 @@
+"""TensorFlow interop binding (ref: test/parallel/test_tensorflow.py —
+allreduce correctness, DistributedGradientTape grad averaging,
+broadcast_variables; here over the eager controller)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+
+class TestSingleProcess:
+    def test_allreduce_identity_and_grad(self, hvd):
+        from horovod_tpu.interop import tf as htf
+
+        x = tf.Variable([1.0, -2.0, 3.0])
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(htf.allreduce(x, name="tfar") * 2.0)
+        g = tape.gradient(y, x)
+        # size-1 world: allreduce is identity; gradient flows through the
+        # custom_gradient (itself an allreduce) -> d/dx sum(2x) = 2
+        np.testing.assert_allclose(g.numpy(), [2.0, 2.0, 2.0])
+
+    def test_tape_wrapper_trains(self, hvd):
+        from horovod_tpu.interop.tf import DistributedGradientTape
+
+        w = tf.Variable([0.0, 0.0, 0.0])
+        x = tf.constant(np.random.RandomState(0).randn(64, 3)
+                        .astype(np.float32))
+        y = tf.linalg.matvec(x, tf.constant([1.0, -2.0, 0.5]))
+        opt = tf.keras.optimizers.SGD(0.2)
+        for _ in range(60):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean(
+                    tf.square(tf.linalg.matvec(x, w) - y))
+            tape = DistributedGradientTape(tape)
+            grads = tape.gradient(loss, [w])
+            opt.apply_gradients(zip(grads, [w]))
+        np.testing.assert_allclose(w.numpy(), [1.0, -2.0, 0.5], atol=0.05)
+
+    def test_broadcast_variables(self, hvd):
+        from horovod_tpu.interop.tf import broadcast_variables
+
+        v = tf.Variable([5.0, 6.0])
+        broadcast_variables([v], root_rank=0)
+        np.testing.assert_allclose(v.numpy(), [5.0, 6.0])
+
+    def test_allgather_and_broadcast(self, hvd):
+        from horovod_tpu.interop import tf as htf
+
+        out = htf.allgather(tf.constant([[1.0, 2.0]]), name="tfag")
+        np.testing.assert_allclose(out.numpy(), [[1.0, 2.0]])
+        out = htf.broadcast(tf.constant([3, 4]), root_rank=0, name="tfbc")
+        np.testing.assert_array_equal(out.numpy(), [3, 4])
+
+    def test_metric_average_callback(self, hvd):
+        from horovod_tpu.interop.tf import MetricAverageCallback
+
+        cb = MetricAverageCallback()
+        logs = {"loss": 2.0, "acc": 0.5}
+        cb.on_epoch_end(0, logs)
+        assert logs == {"loss": 2.0, "acc": 0.5}   # size-1: identity
+
+
+def _worker_tf():
+    """2-rank: DistributedGradientTape averages grads across ranks, and
+    broadcast_variables propagates rank 0's values."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu as hvd
+    from horovod_tpu.interop.tf import (DistributedGradientTape,
+                                        broadcast_variables)
+
+    hvd.init()
+    r = hvd.rank()
+
+    v = tf.Variable([float(r + 1), 0.0])
+    broadcast_variables([v], root_rank=0)
+    out = {"bcast": v.numpy().tolist()}          # both ranks: [1, 0]
+
+    w = tf.Variable([0.0])
+    xs = tf.constant([[float(r + 1)]])           # rank-dependent data
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean(tf.square(tf.linalg.matvec(xs, w) - 1.0))
+    tape = DistributedGradientTape(tape)
+    (g,) = tape.gradient(loss, [w])
+    # local grads: rank0 d/dw (w*1-1)^2 = 2*(w-1)*1 = -2; rank1: 2*(2w-1)*2 = -4
+    # average = -3
+    out["grad"] = g.numpy().tolist()
+    hvd.shutdown()
+    return out
+
+
+@pytest.mark.integration
+def test_two_process_tf_tape():
+    from conftest import pickle_by_value
+
+    import horovod_tpu.runner as runner
+
+    results = runner.run(pickle_by_value(_worker_tf), np=2)
+    for out in results:
+        np.testing.assert_allclose(out["bcast"], [1.0, 0.0])
+        np.testing.assert_allclose(out["grad"], [-3.0])
+
+
+def test_keras_fit_with_callbacks(hvd):
+    """tf.keras Model.fit with both callbacks attached (ref: the keras
+    examples' canonical callback list)."""
+    from horovod_tpu.interop.tf import (BroadcastGlobalVariablesCallback,
+                                        MetricAverageCallback)
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, input_shape=(3,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    x = np.random.RandomState(1).randn(64, 3).astype(np.float32)
+    y = (x @ np.array([1.0, -1.0, 0.5], np.float32)).astype(np.float32)
+    hist = model.fit(
+        x, y, epochs=2, batch_size=16, verbose=0,
+        callbacks=[BroadcastGlobalVariablesCallback(0),
+                   MetricAverageCallback()])
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+class TestTapeSurface:
+    def test_context_manager_and_nested_sources(self, hvd):
+        from horovod_tpu.interop.tf import DistributedGradientTape
+
+        w = tf.Variable([1.0])
+        b = tf.Variable([2.0])
+        with DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(w * 3.0 + b)
+        grads = tape.gradient(loss, {"w": w, "b": b})
+        np.testing.assert_allclose(grads["w"].numpy(), [3.0])
+        np.testing.assert_allclose(grads["b"].numpy(), [1.0])
+
+    def test_unconnected_gradients_kwarg(self, hvd):
+        from horovod_tpu.interop.tf import DistributedGradientTape
+
+        w = tf.Variable([1.0])
+        v = tf.Variable([5.0])       # unconnected to the loss
+        with DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(w * 2.0)
+        grads = tape.gradient(
+            loss, [w, v],
+            unconnected_gradients=tf.UnconnectedGradients.ZERO)
+        np.testing.assert_allclose(grads[0].numpy(), [2.0])
+        np.testing.assert_allclose(grads[1].numpy(), [0.0])
+
+    def test_sparse_embedding_guard_and_densify(self, hvd):
+        from horovod_tpu.interop.tf import DistributedGradientTape
+
+        emb = tf.Variable(tf.ones((8, 4)))
+        with DistributedGradientTape(tf.GradientTape()) as tape:
+            rows = tf.gather(emb, [1, 2])
+            loss = tf.reduce_sum(rows)
+        with pytest.raises(NotImplementedError, match="sparse_as_dense"):
+            tape.gradient(loss, [emb])
+
+        with tf.GradientTape() as raw:
+            rows = tf.gather(emb, [1, 2])
+            loss = tf.reduce_sum(rows)
+        tape2 = DistributedGradientTape(raw, sparse_as_dense=True)
+        (g,) = tape2.gradient(loss, [emb])
+        dense = np.zeros((8, 4), np.float32)
+        dense[1] = dense[2] = 1.0
+        np.testing.assert_allclose(g.numpy(), dense)
+
+    def test_allreduce_grad_respects_scaling(self, hvd):
+        import horovod_tpu as hv
+        from horovod_tpu.interop import tf as htf
+
+        x = tf.Variable([1.0])
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(htf.allreduce(
+                x, name="scaled", op=hv.Sum,
+                prescale_factor=0.5, postscale_factor=4.0))
+        g = tape.gradient(y, x)
+        # forward: 4*(0.5*x) -> d/dx = 2 (size-1 world); the backward
+        # allreduce must apply the same factors.
+        np.testing.assert_allclose(g.numpy(), [2.0])

@@ -8,3 +8,13 @@ the grad-hook ``DistributedOptimizer``), ``interop.tf``
 (``DistributedGradientTape``, ``broadcast_variables``, Keras callbacks).
 Both import their framework lazily.
 """
+
+import importlib
+
+
+def __getattr__(name):
+    # `hvd.interop.tf` / `hvd.interop.torch` resolve without an explicit
+    # submodule import (the docstring usage pattern).
+    if name in ("tf", "torch"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -66,26 +66,69 @@ def allreduce(tensor, name: Optional[str] = None,
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
-    """Allgather along dim 0 (ref: tensorflow allgather; ragged sizes
-    negotiated by the controller)."""
+    """Differentiable allgather along dim 0 (ref: tensorflow allgather;
+    HorovodAllgatherOp's registered gradient = this rank's row segment of
+    the SUM-allreduced upstream gradient)."""
     import tensorflow as tf
 
+    from ..common import basics
     from ..ops import eager
 
-    out = eager.allgather(_to_np(tensor), name=name,
-                          process_set=process_set)
-    return tf.convert_to_tensor(np.asarray(out))
+    @tf.custom_gradient
+    def _ag(x):
+        arr = _to_np(x)
+        n_local = arr.shape[0]
+        out = np.asarray(eager.allgather(arr, name=name,
+                                         process_set=process_set))
+
+        def grad(dy):
+            g = np.asarray(eager.allreduce(
+                _to_np(dy), name=None if name is None else f"{name}.grad",
+                op=ReduceOp.SUM, process_set=process_set))
+            rank = (process_set.rank() if process_set is not None
+                    else basics.rank())
+            # Rows are rank-ordered; ragged sizes require knowing every
+            # rank's count — gather them the same way the forward did.
+            counts = np.asarray(eager.allgather(
+                np.asarray([n_local], np.int32),
+                name=None if name is None else f"{name}.counts",
+                process_set=process_set))
+            off = int(counts[:rank].sum())
+            return tf.convert_to_tensor(g[off:off + n_local],
+                                        dtype=dy.dtype)
+
+        return tf.convert_to_tensor(out), grad
+
+    return _ag(tf.convert_to_tensor(tensor))
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
               process_set=None):
+    """Differentiable broadcast (ref: HorovodBroadcastOp gradient =
+    SUM-allreduced upstream gradient on the root, zeros elsewhere)."""
     import tensorflow as tf
 
+    from ..common import basics
     from ..ops import eager
 
-    out = eager.broadcast(_to_np(tensor), root_rank, name=name,
-                          process_set=process_set)
-    return tf.convert_to_tensor(np.asarray(out))
+    @tf.custom_gradient
+    def _bc(x):
+        out = eager.broadcast(_to_np(x), root_rank, name=name,
+                              process_set=process_set)
+
+        def grad(dy):
+            g = np.asarray(eager.allreduce(
+                _to_np(dy), name=None if name is None else f"{name}.grad",
+                op=ReduceOp.SUM, process_set=process_set))
+            rank = (process_set.rank() if process_set is not None
+                    else basics.rank())
+            if rank != root_rank:
+                g = np.zeros_like(g)
+            return tf.convert_to_tensor(g, dtype=dy.dtype)
+
+        return tf.convert_to_tensor(np.asarray(out)), grad
+
+    return _bc(tf.convert_to_tensor(tensor))
 
 
 def broadcast_variables(variables: Iterable, root_rank: int = 0,
@@ -234,6 +277,7 @@ class MetricAverageCallback:
                     v = logs[k]
                     if isinstance(v, (int, float, np.floating)):
                         logs[k] = float(np.asarray(eager.allreduce(
-                            np.float32(v), name=f"metric.{k}")))
+                            np.float32(v), name=f"metric.{k}",
+                            process_set=process_set)))
 
         return _Impl()

@@ -25,7 +25,7 @@ from ..common.types import ReduceOp
 __all__ = ["allreduce", "allreduce_async", "allgather", "allgather_async",
            "broadcast", "broadcast_async", "alltoall", "synchronize",
            "broadcast_parameters", "broadcast_optimizer_state",
-           "DistributedOptimizer"]
+           "DistributedOptimizer", "SyncBatchNorm"]
 
 
 def __getattr__(name):
@@ -33,6 +33,10 @@ def __getattr__(name):
         from .torch_optimizer import DistributedOptimizer
 
         return DistributedOptimizer
+    if name == "SyncBatchNorm":
+        from .torch_sync_batch_norm import SyncBatchNorm
+
+        return SyncBatchNorm
     raise AttributeError(name)
 
 

@@ -1,0 +1,141 @@
+"""Cross-rank synchronized BatchNorm for torch models.
+
+Re-conception of ref: horovod/torch/sync_batch_norm.py:40-210 — the same
+two-piece design: an ``nn.Module`` that runs plain BN when it wouldn't
+change anything (eval mode, or world size 1) and a
+``torch.autograd.Function`` that synchronizes batch statistics in
+forward (count/mean/var summed across ranks through the eager
+controller) and the gradient reductions (sum_dy, sum_dy_xmu) in
+backward.  The math follows torch's native SyncBatchNorm formulas;
+weight/bias gradients stay local (they ride the optimizer's own
+gradient allreduce like every other parameter).
+
+This module imports torch at import time (it IS the torch binding);
+``interop.torch`` re-exports ``SyncBatchNorm`` lazily.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+__all__ = ["SyncBatchNorm"]
+
+
+def _allreduce_sum(arr: np.ndarray, name: str) -> np.ndarray:
+    from ..common.types import ReduceOp
+    from ..ops import eager
+
+    return np.asarray(eager.allreduce(arr, name=name, op=ReduceOp.SUM))
+
+
+class _SyncBNFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, eps):
+        # x: [N, C, *]; reduce over all dims but C
+        dims = [0] + list(range(2, x.dim()))
+        n_local = x.numel() // x.shape[1]
+        s = x.sum(dims)                       # [C]
+        ss = (x * x).sum(dims)                # [C]
+        packed = np.concatenate([
+            np.asarray([float(n_local)], np.float64),
+            s.detach().numpy().astype(np.float64),
+            ss.detach().numpy().astype(np.float64)])
+        packed = _allreduce_sum(packed, "sync_bn.stats")
+        c = x.shape[1]
+        n_total = float(packed[0])
+        mean = torch.from_numpy(
+            (packed[1:1 + c] / n_total).astype(np.float32))
+        var = torch.from_numpy(
+            (packed[1 + c:] / n_total).astype(np.float32)) - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        shape = [1, c] + [1] * (x.dim() - 2)
+        out = (x - mean.view(shape)) * invstd.view(shape)
+        if weight is not None:
+            out = out * weight.view(shape) + bias.view(shape)
+        ctx.save_for_backward(x, weight, mean, invstd)
+        ctx.n_total = n_total
+        ctx.dims = dims
+        ctx.bn_shape = shape
+        count = torch.tensor(n_total)
+        ctx.mark_non_differentiable(mean, var, count)
+        return out, mean, var, count
+
+    @staticmethod
+    def backward(ctx, grad_output, _gmean, _gvar, _gcount):
+        x, weight, mean, invstd = ctx.saved_tensors
+        dims, shape, n = ctx.dims, ctx.bn_shape, ctx.n_total
+        xmu = x - mean.view(shape)
+
+        sum_dy = grad_output.sum(dims)                     # [C]
+        sum_dy_xmu = (grad_output * xmu).sum(dims)         # [C]
+        packed = np.concatenate([
+            sum_dy.detach().numpy().astype(np.float64),
+            sum_dy_xmu.detach().numpy().astype(np.float64)])
+        packed = _allreduce_sum(packed, "sync_bn.grads")
+        c = x.shape[1]
+        g_sum_dy = torch.from_numpy(packed[:c].astype(np.float32))
+        g_sum_dy_xmu = torch.from_numpy(packed[c:].astype(np.float32))
+
+        w = (weight.view(shape) if weight is not None
+             else torch.ones_like(invstd).view(shape))
+        inv = invstd.view(shape)
+        dx = w * inv * (
+            grad_output
+            - g_sum_dy.view(shape) / n
+            - xmu * (inv ** 2) * g_sum_dy_xmu.view(shape) / n)
+
+        if weight is not None:
+            dw = (grad_output * xmu * inv).sum(dims)
+            db = sum_dy
+        else:
+            dw = db = None
+        return dx, dw, db, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in ``nn.BatchNorm*`` replacement with cross-rank statistics
+    (ref: hvd.SyncBatchNorm — same constructor surface).  Module-level
+    class: picklable (``torch.save(model)``) and isinstance-able."""
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 track_running_stats: bool = True):
+        super().__init__(num_features, eps=eps, momentum=momentum,
+                         affine=affine,
+                         track_running_stats=track_running_stats)
+
+    def _check_input_dim(self, x):
+        if x.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {x.dim()}D)")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        from ..common import basics
+
+        world = basics.size() if basics.is_initialized() else 1
+        if not self.training or world == 1:
+            # plain BN (eval mode uses running stats; size-1 sync is a
+            # no-op) — ref: _maybe_run_sync_bn fallthrough
+            return super().forward(x)
+        out, mean, var, count = _SyncBNFunction.apply(
+            x, self.weight if self.affine else None,
+            self.bias if self.affine else None, self.eps)
+        if self.track_running_stats:
+            with torch.no_grad():
+                self.num_batches_tracked += 1
+                if self.momentum is None:
+                    # cumulative moving average (torch semantics)
+                    m = 1.0 / float(self.num_batches_tracked)
+                else:
+                    m = self.momentum
+                # unbiased correction from the TRUE global count the
+                # forward reduced (ragged per-rank batches stay exact)
+                n = float(count)
+                unbiased = var * (n / max(n - 1.0, 1.0))
+                self.running_mean.mul_(1 - m).add_(mean, alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased, alpha=m)
+        return out

@@ -184,3 +184,27 @@ class TestTapeSurface:
         # forward: 4*(0.5*x) -> d/dx = 2 (size-1 world); the backward
         # allreduce must apply the same factors.
         np.testing.assert_allclose(g.numpy(), [2.0])
+
+
+def test_lazy_submodule_access(hvd):
+    import horovod_tpu as hv
+
+    assert callable(hv.interop.tf.allreduce)
+    assert callable(hv.interop.torch.DistributedOptimizer)
+
+
+def test_allgather_broadcast_gradients(hvd):
+    from horovod_tpu.interop import tf as htf
+
+    x = tf.Variable([[1.0, 2.0]])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(htf.allgather(x, name="dag") * 3.0)
+    g = tape.gradient(y, x)
+    # size-1: allgather identity; grad = 3 everywhere
+    np.testing.assert_allclose(g.numpy(), [[3.0, 3.0]])
+
+    v = tf.Variable([2.0])
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(htf.broadcast(v, root_rank=0, name="dbc") * 5.0)
+    g = tape.gradient(y, v)
+    np.testing.assert_allclose(g.numpy(), [5.0])   # rank 0 IS the root

@@ -83,7 +83,7 @@ def __getattr__(name):
 
             return DistributedOptimizer
         if name in ("broadcast_parameters", "broadcast_optimizer_state",
-                    "broadcast_object"):
+                    "broadcast_object", "allgather_object"):
             from . import functions
 
             return getattr(functions, name)

@@ -5,8 +5,9 @@ is the first-class citizen and other frameworks interoperate through the
 eager named-collective path (host arrays ride the same negotiation,
 fusion, and data plane).  Available adapters: ``interop.torch`` (incl.
 the grad-hook ``DistributedOptimizer``), ``interop.tf``
-(``DistributedGradientTape``, ``broadcast_variables``, Keras callbacks).
-Both import their framework lazily.
+(``DistributedGradientTape``, ``broadcast_variables``, Keras callbacks),
+``interop.mxnet`` (``DistributedOptimizer``/``DistributedTrainer``).
+All import their framework lazily.
 """
 
 import importlib
@@ -15,6 +16,6 @@ import importlib
 def __getattr__(name):
     # `hvd.interop.tf` / `hvd.interop.torch` resolve without an explicit
     # submodule import (the docstring usage pattern).
-    if name in ("tf", "torch"):
+    if name in ("tf", "torch", "mxnet"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

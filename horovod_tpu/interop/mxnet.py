@@ -1,0 +1,370 @@
+"""MXNet interop: the reference's mxnet API over the eager core.
+
+Re-conception of ref: horovod/mxnet/__init__.py + mpi_ops.py — the same
+user-facing surface (allreduce/allreduce_/grouped variants, allgather,
+broadcast/broadcast_, alltoall, ``DistributedOptimizer`` wrapping an
+``mx.optimizer.Optimizer``, ``DistributedTrainer`` subclassing
+``mx.gluon.Trainer``, ``broadcast_parameters``) accepting NDArrays.
+
+Like the torch interop, tensors cross into the framework as host arrays
+(``NDArray.asnumpy()`` / slice-assignment back) and ride the eager
+controller's negotiation/fusion + host data plane — no C++ binding to
+maintain (the reference needs ~1.2k LoC of mxnet/mpi_ops.cc + adapters).
+``mxnet`` itself is imported lazily on first use, so the module is
+importable (and the pure-protocol pieces testable) without mxnet
+installed.
+
+The reference's ``priority=`` argument is accepted and ignored: it maps
+to MXNet's dependency-engine priority queues, which have no analog in
+this host data plane (ops complete in negotiation order).
+"""
+
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict, defaultdict
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = ["allreduce", "allreduce_", "grouped_allreduce",
+           "grouped_allreduce_", "allgather", "broadcast", "broadcast_",
+           "alltoall", "broadcast_parameters",
+           "broadcast_object", "allgather_object", "Compression",
+           "DistributedOptimizer", "DistributedTrainer"]
+
+
+def __getattr__(name):
+    if name == "DistributedOptimizer":
+        return _optimizer_cls()
+    if name == "DistributedTrainer":
+        return _trainer_cls()
+    if name == "Compression":
+        from ..ops.compression import Compression
+
+        return Compression
+    if name in ("broadcast_object", "allgather_object"):
+        from .. import functions
+
+        return getattr(functions, name)
+    raise AttributeError(name)
+
+
+def _mx():
+    import mxnet
+
+    return mxnet
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "asnumpy"):
+        return t.asnumpy()
+    return np.asarray(t)
+
+
+def _from_np(a: np.ndarray, like):
+    if hasattr(like, "asnumpy"):
+        mx = _mx()
+        return mx.nd.array(a, dtype=a.dtype)
+    return a
+
+
+def allreduce(tensor, average=None, name: Optional[str] = None, op=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+              priority: int = 0, process_set=None):
+    from ..ops import eager
+
+    out = eager.allreduce(_to_np(tensor), average=average, name=name, op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
+    return _from_np(np.asarray(out), tensor)
+
+
+def allreduce_(tensor, average=None, name: Optional[str] = None, op=None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+               priority: int = 0, process_set=None):
+    """In-place allreduce (ref: mxnet/mpi_ops.py allreduce_)."""
+    from ..ops import eager
+
+    out = eager.allreduce(_to_np(tensor), average=average, name=name, op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
+    tensor[:] = np.asarray(out)
+    return tensor
+
+
+def grouped_allreduce(tensors, average=None, name: Optional[str] = None,
+                      op=None, prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0, priority: int = 0,
+                      process_set=None):
+    from ..ops import eager
+
+    outs = eager.grouped_allreduce(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    return [_from_np(np.asarray(o), t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors, average=None, name: Optional[str] = None,
+                       op=None, prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0, priority: int = 0,
+                       process_set=None):
+    from ..ops import eager
+
+    outs = eager.grouped_allreduce(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    for t, o in zip(tensors, outs):
+        t[:] = np.asarray(o)
+    return list(tensors)
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0,
+              process_set=None):
+    from ..ops import eager
+
+    out = eager.allgather(_to_np(tensor), name=name, process_set=process_set)
+    return _from_np(np.asarray(out), tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              priority: int = 0, process_set=None):
+    from ..ops import eager
+
+    out = eager.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                          process_set=process_set)
+    return _from_np(np.asarray(out), tensor)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
+               priority: int = 0, process_set=None):
+    from ..ops import eager
+
+    out = eager.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
+                          process_set=process_set)
+    tensor[:] = np.asarray(out)
+    return tensor
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             priority: int = 0, process_set=None):
+    from ..ops import eager
+
+    out, recv_splits = eager.alltoall(
+        _to_np(tensor), splits=None if splits is None else _to_np(splits),
+        name=name, process_set=process_set)
+    return _from_np(np.asarray(out), tensor), recv_splits
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         prefix: Optional[str] = None) -> None:
+    """Broadcast ``Block.collect_params()`` / ``Module.get_params()`` /
+    a plain dict of NDArrays from root (ref: mxnet/__init__.py
+    broadcast_parameters — same three accepted shapes; name-keyed so the
+    negotiation matches across ranks regardless of insertion order)."""
+    prefix = prefix or ""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    elif isinstance(params, (list, tuple)):
+        items = list(enumerate(params))
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        # gluon Parameter vs raw NDArray
+        tensor = p.data() if hasattr(p, "data") and callable(p.data) else p
+        broadcast_(tensor, root_rank=root_rank,
+                   name=f"{prefix}param.{name}")
+
+
+def _split_list(xs, parts: int):
+    """Near-equal contiguous split (ref: common/util.py split_list)."""
+    n = len(xs)
+    k, r = divmod(n, parts)
+    out, i = [], 0
+    for j in range(parts):
+        step = k + (1 if j < r else 0)
+        if step:
+            out.append(xs[i:i + step])
+        i += step
+    return out
+
+
+_CLS_CACHE: dict = {}
+
+
+def _optimizer_cls():
+    if "opt" in _CLS_CACHE:
+        return _CLS_CACHE["opt"]
+    mx = _mx()
+    from ..common.process_sets import global_process_set
+
+    class DistributedOptimizer(mx.optimizer.Optimizer):
+        """Wrap an ``mx.optimizer.Optimizer``: allreduce each grad before
+        the underlying update (ref: mxnet/__init__.py:42-104 — same
+        rescale_grad normalization so the sum-allreduce averages)."""
+
+        def __init__(self, optimizer, gradient_predivide_factor: float = 1.0,
+                     num_groups: int = 0, process_set=None):
+            self._optimizer = optimizer
+            self._process_set = process_set or global_process_set()
+            self._optimizer.rescale_grad *= (
+                gradient_predivide_factor / self._process_set.size())
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._num_groups = num_groups
+
+        def __getattr__(self, item):
+            return getattr(self._optimizer, item)
+
+        def create_state(self, index, weight):
+            return self._optimizer.create_state(index, weight)
+
+        def create_state_multi_precision(self, index, weight):
+            return self._optimizer.create_state_multi_precision(index,
+                                                                weight)
+
+        def _do_allreduce(self, index, grad):
+            if self._process_set.size() == 1:
+                return
+            pre = 1.0 / self._gradient_predivide_factor
+            if isinstance(index, (tuple, list)):
+                if self._num_groups > 0:
+                    for i, (grads, indices) in enumerate(zip(
+                            _split_list(grad, self._num_groups),
+                            _split_list(index, self._num_groups))):
+                        grouped_allreduce_(
+                            tensors=grads, average=False,
+                            name=f"{indices[0]}:{indices[-1]}", priority=-i,
+                            prescale_factor=pre,
+                            process_set=self._process_set)
+                else:
+                    for i in range(len(index)):
+                        allreduce_(grad[i], average=False,
+                                   name=str(index[i]), priority=-i,
+                                   prescale_factor=pre,
+                                   process_set=self._process_set)
+            else:
+                allreduce_(grad, average=False, name=str(index),
+                           prescale_factor=pre,
+                           process_set=self._process_set)
+
+        def update(self, index, weight, grad, state):
+            if self._process_set.included():
+                self._do_allreduce(index, grad)
+            self._optimizer.update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            if self._process_set.included():
+                self._do_allreduce(index, grad)
+            self._optimizer.update_multi_precision(index, weight, grad,
+                                                   state)
+
+        def set_learning_rate(self, lr):
+            self._optimizer.set_learning_rate(lr)
+
+        def set_lr_mult(self, args_lr_mult):
+            self._optimizer.set_lr_mult(args_lr_mult)
+
+        def set_wd_mult(self, args_wd_mult):
+            self._optimizer.set_wd_mult(args_wd_mult)
+
+    _CLS_CACHE["opt"] = DistributedOptimizer
+    return DistributedOptimizer
+
+
+def _trainer_cls():
+    if "trainer" in _CLS_CACHE:
+        return _CLS_CACHE["trainer"]
+    mx = _mx()
+    from ..common.process_sets import global_process_set
+    from ..ops.compression import Compression
+
+    class DistributedTrainer(mx.gluon.Trainer):
+        """gluon Trainer whose ``_allreduce_grads`` rides our collectives
+        instead of kvstore push/pull (ref: mxnet/__init__.py:110-216 —
+        same sum+rescale averaging, dtype-homogeneous grouped enqueue,
+        optional wire compression)."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     compression=Compression.none,
+                     gradient_predivide_factor: float = 1.0,
+                     prefix: Optional[str] = None, num_groups: int = 0,
+                     process_set=None):
+            self._compression = compression
+            self._process_set = process_set or global_process_set()
+            if isinstance(optimizer, _optimizer_cls()):
+                optimizer = optimizer._optimizer
+                warnings.warn("DistributedTrainer does not take "
+                              "DistributedOptimizer as its optimizer. "
+                              "We have unwrapped it for you.")
+            # Deterministic parameter order across ranks.  gluon
+            # Parameter objects define no ordering, so sequences sort by
+            # name when available and otherwise keep the caller's order
+            # (already deterministic when built identically per rank).
+            if isinstance(params, dict):
+                params = OrderedDict(sorted(params.items()))
+            elif isinstance(params, (list, tuple)):
+                if all(hasattr(p, "name") for p in params):
+                    params = sorted(params, key=lambda p: p.name)
+                else:
+                    params = list(params)
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params, kvstore=None)
+            self._scale *= (gradient_predivide_factor /
+                            self._process_set.size())
+            self._gradient_predivide_factor = gradient_predivide_factor
+            assert prefix is None or isinstance(prefix, str)
+            self._prefix = prefix if prefix else ""
+            self._num_groups = num_groups
+
+        def _allreduce_grads(self):
+            ps = self._process_set
+            if ps.size() == 1 or not ps.included():
+                return
+            pre = 1.0 / self._gradient_predivide_factor
+            none = Compression.none
+            if self._num_groups > 0:
+                grads, names, compressed, ctxs = [], [], [], []
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        tc, ctx = self._compression.compress(
+                            param.list_grad()[0])
+                        grads.append(tc)
+                        compressed.append(tc)
+                        ctxs.append(ctx)
+                        names.append(self._prefix + str(i))
+                for i, (group_grads, group_names) in enumerate(zip(
+                        _split_list(grads, self._num_groups),
+                        _split_list(names, self._num_groups))):
+                    by_dtype = defaultdict(list)
+                    for g, n in zip(group_grads, group_names):
+                        by_dtype[np.dtype(g.dtype)].append((g, n))
+                    for entries in by_dtype.values():
+                        gs, ns = zip(*entries)
+                        grouped_allreduce_(
+                            tensors=list(gs), average=False,
+                            name=f"{ns[0]}:{ns[-1]}", priority=-i,
+                            prescale_factor=pre, process_set=ps)
+                if self._compression is not none:
+                    for param in self._params:
+                        if param.grad_req != "null":
+                            param.list_grad()[0][:] = _to_np(
+                                self._compression.decompress(
+                                    compressed.pop(0), ctxs.pop(0)))
+            else:
+                for i, param in enumerate(self._params):
+                    if param.grad_req != "null":
+                        tc, ctx = self._compression.compress(
+                            param.list_grad()[0])
+                        allreduce_(tc, average=False,
+                                   name=self._prefix + str(i), priority=-i,
+                                   prescale_factor=pre, process_set=ps)
+                        if self._compression is not none:
+                            param.list_grad()[0][:] = _to_np(
+                                self._compression.decompress(tc, ctx))
+
+    _CLS_CACHE["trainer"] = DistributedTrainer
+    return DistributedTrainer

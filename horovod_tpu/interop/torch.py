@@ -22,9 +22,16 @@ import numpy as np
 
 from ..common.types import ReduceOp
 
-__all__ = ["allreduce", "allreduce_async", "allgather", "allgather_async",
-           "broadcast", "broadcast_async", "alltoall", "synchronize",
+__all__ = ["allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+           "grouped_allreduce", "grouped_allreduce_async",
+           "grouped_allreduce_", "grouped_allreduce_async_",
+           "sparse_allreduce_async",
+           "allgather", "allgather_async",
+           "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
+           "alltoall", "alltoall_async", "join", "barrier", "poll",
+           "synchronize",
            "broadcast_parameters", "broadcast_optimizer_state",
+           "broadcast_object", "allgather_object", "Compression",
            "DistributedOptimizer", "SyncBatchNorm"]
 
 
@@ -37,6 +44,14 @@ def __getattr__(name):
         from .torch_sync_batch_norm import SyncBatchNorm
 
         return SyncBatchNorm
+    if name == "Compression":
+        from ..ops.compression import Compression
+
+        return Compression
+    if name in ("broadcast_object", "allgather_object"):
+        from .. import functions
+
+        return getattr(functions, name)
     raise AttributeError(name)
 
 
@@ -51,13 +66,61 @@ def _to_np(t) -> np.ndarray:
     if isinstance(t, torch.Tensor):
         if t.device.type != "cpu":
             raise ValueError("interop.torch supports CPU tensors only")
-        return t.detach().numpy()
+        t = t.detach()
+        if t.dtype == torch.bfloat16:
+            # torch has no direct numpy conversion for bf16; reinterpret
+            # the bits so the wire dtype stays bfloat16 (ml_dtypes).
+            import ml_dtypes
+
+            return t.contiguous().view(torch.int16).numpy().view(
+                ml_dtypes.bfloat16)
+        return t.numpy()
     return np.asarray(t)
 
 
-def _from_np(a: np.ndarray, like) -> "Any":
+def _np_to_torch(a: np.ndarray):
     torch = _torch()
-    return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype)
+    a = np.ascontiguousarray(a)
+    try:
+        import ml_dtypes
+
+        if a.dtype == ml_dtypes.bfloat16:
+            return torch.from_numpy(a.view(np.int16)).view(torch.bfloat16)
+    except ImportError:
+        pass
+    return torch.from_numpy(a)
+
+
+def _from_np(a: np.ndarray, like) -> "Any":
+    return _np_to_torch(a).to(like.dtype)
+
+
+# handle -> (result torch dtype, weakref to in-place target or None).
+# Handles issued through this module resolve to torch tensors in
+# ``synchronize`` (the reference contract: mpi_ops.py synchronize
+# returns the output tensor, the in-place variants mutate their
+# argument).  Only the dtype is kept for out-of-place results (tiny,
+# immortal objects); in-place targets are weak references so an
+# abandoned handle (exception between enqueue and synchronize,
+# poll-then-drop) never pins a tensor.  Dead/abandoned entries are swept
+# once the table grows past a threshold.
+_handle_targets: dict = {}
+_SWEEP_AT = 1024
+
+
+def _register(handle: int, like, inplace=None) -> int:
+    import weakref
+
+    from ..ops import eager
+
+    if len(_handle_targets) >= _SWEEP_AT:
+        for h in [h for h in _handle_targets
+                  if not eager._controller().handles.known(h)]:
+            del _handle_targets[h]
+    _handle_targets[handle] = (like.dtype,
+                               None if inplace is None
+                               else weakref.ref(inplace))
+    return handle
 
 
 def allreduce_async(tensor, average: Optional[bool] = None,
@@ -65,8 +128,94 @@ def allreduce_async(tensor, average: Optional[bool] = None,
                     process_set=None) -> int:
     from ..ops import eager
 
-    return eager.allreduce_async(_to_np(tensor), average=average, name=name,
-                                 op=op, process_set=process_set)
+    h = eager.allreduce_async(_to_np(tensor), average=average, name=name,
+                              op=op, process_set=process_set)
+    return _register(h, tensor)
+
+
+def allreduce_async_(tensor, average: Optional[bool] = None,
+                     name: Optional[str] = None, op=None,
+                     process_set=None) -> int:
+    """In-place async allreduce (ref: mpi_ops.py allreduce_async_):
+    ``synchronize`` copies the result back into ``tensor``."""
+    from ..ops import eager
+
+    h = eager.allreduce_async(_to_np(tensor), average=average, name=name,
+                              op=op, process_set=process_set)
+    return _register(h, tensor, inplace=tensor)
+
+
+def allreduce_(tensor, average: Optional[bool] = None,
+               name: Optional[str] = None, op=None, process_set=None):
+    return synchronize(allreduce_async_(tensor, average=average, name=name,
+                                        op=op, process_set=process_set))
+
+
+def grouped_allreduce_async(tensors, average: Optional[bool] = None,
+                            name: Optional[str] = None, op=None,
+                            process_set=None):
+    from ..ops import eager
+
+    handles = eager.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        process_set=process_set)
+    return [_register(h, t) for h, t in zip(handles, tensors)]
+
+
+def grouped_allreduce_async_(tensors, average: Optional[bool] = None,
+                             name: Optional[str] = None, op=None,
+                             process_set=None):
+    from ..ops import eager
+
+    handles = eager.grouped_allreduce_async(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        process_set=process_set)
+    return [_register(h, t, inplace=t) for h, t in zip(handles, tensors)]
+
+
+def grouped_allreduce(tensors, **kwargs):
+    return [synchronize(h)
+            for h in grouped_allreduce_async(tensors, **kwargs)]
+
+
+def grouped_allreduce_(tensors, **kwargs):
+    return [synchronize(h)
+            for h in grouped_allreduce_async_(tensors, **kwargs)]
+
+
+def sparse_allreduce_async(tensor, name: str, op=None, process_set=None):
+    """Sparse (COO) allreduce via double allgather
+    (ref: torch/mpi_ops.py:556-578 sparse_allreduce_async).
+
+    Returns a zero-arg callable that, when invoked, synchronizes both
+    allgathers and builds the combined sparse tensor — the reference's
+    handle contract for the torch optimizer's sparse path."""
+    torch = _torch()
+    from ..common.types import ReduceOp
+    from ..common.process_sets import global_process_set
+
+    ps = process_set or global_process_set()
+    t = tensor.coalesce() if tensor.layout == torch.sparse_coo else tensor
+    indices_h = allgather_async(
+        t._indices().transpose(0, 1).contiguous(),
+        name=f"{name}.indices", process_set=ps)
+    values_h = allgather_async(t._values(), name=f"{name}.values",
+                               process_set=ps)
+    average = op is None or op == ReduceOp.AVERAGE
+
+    def handle():
+        values = synchronize(values_h)
+        indices = synchronize(indices_h)
+        if average:
+            values = values / ps.size()
+        if indices.dim() == 0 or values.dim() == 0:
+            return torch.sparse_coo_tensor(
+                torch.zeros((t._indices().shape[0], 0), dtype=torch.long),
+                torch.zeros((0,), dtype=t._values().dtype), t.size())
+        return torch.sparse_coo_tensor(indices.transpose(0, 1), values,
+                                       t.size())
+
+    return handle
 
 
 def allreduce(tensor, average: Optional[bool] = None,
@@ -82,8 +231,9 @@ def allgather_async(tensor, name: Optional[str] = None,
                     process_set=None) -> int:
     from ..ops import eager
 
-    return eager.allgather_async(_to_np(tensor), name=name,
-                                 process_set=process_set)
+    h = eager.allgather_async(_to_np(tensor), name=name,
+                              process_set=process_set)
+    return _register(h, tensor)
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
@@ -97,8 +247,25 @@ def broadcast_async(tensor, root_rank: int = 0,
                     name: Optional[str] = None, process_set=None) -> int:
     from ..ops import eager
 
-    return eager.broadcast_async(_to_np(tensor), root_rank=root_rank,
-                                 name=name, process_set=process_set)
+    h = eager.broadcast_async(_to_np(tensor), root_rank=root_rank,
+                              name=name, process_set=process_set)
+    return _register(h, tensor)
+
+
+def broadcast_async_(tensor, root_rank: int = 0,
+                     name: Optional[str] = None, process_set=None) -> int:
+    """In-place async broadcast (ref: mpi_ops.py broadcast_async_)."""
+    from ..ops import eager
+
+    h = eager.broadcast_async(_to_np(tensor), root_rank=root_rank,
+                              name=name, process_set=process_set)
+    return _register(h, tensor, inplace=tensor)
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None,
+               process_set=None):
+    return synchronize(broadcast_async_(tensor, root_rank=root_rank,
+                                        name=name, process_set=process_set))
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
@@ -108,6 +275,17 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
     out = eager.broadcast(_to_np(tensor), root_rank=root_rank, name=name,
                           process_set=process_set)
     return _from_np(np.asarray(out), tensor)
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set=None) -> int:
+    from ..ops import eager
+
+    h = eager.alltoall_async(
+        _to_np(tensor),
+        splits=None if splits is None else _to_np(splits),
+        name=name, process_set=process_set)
+    return _register(h, tensor)
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
@@ -121,12 +299,54 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     return _from_np(np.asarray(out), tensor), recv_splits
 
 
-def synchronize(handle: int):
-    """Resolve an async handle to a numpy array (callers re-wrap as torch
-    if needed; ref: mpi_ops.py synchronize)."""
+def join(process_set=None) -> int:
+    """Signal no more work on this rank (ref: torch/mpi_ops.py:954)."""
     from ..ops import eager
 
-    return eager.synchronize(handle)
+    return eager.join(process_set)
+
+
+def barrier(process_set=None) -> None:
+    from ..ops import eager
+
+    eager.barrier(process_set)
+
+
+def poll(handle: int) -> bool:
+    from ..ops import eager
+
+    return eager.poll(handle)
+
+
+def synchronize(handle: int):
+    """Resolve an async handle (ref: mpi_ops.py synchronize).  Handles
+    issued through this module come back as torch tensors (alltoall: a
+    ``(tensor, recv_splits)`` pair); in-place handles additionally copy
+    the result into the original tensor and return it.  Foreign handles
+    resolve to the eager layer's numpy result."""
+    from ..ops import eager
+
+    out = eager.synchronize(handle)
+    dtype, inplace_ref = _handle_targets.pop(handle, (None, None))
+    inplace = inplace_ref() if inplace_ref is not None else None
+    if dtype is None:
+        return out
+    torch = _torch()
+    recv_splits = None
+    if isinstance(out, tuple):          # alltoall: (output, recv_splits)
+        out, recv_splits = out
+    result = _np_to_torch(np.asarray(out)).to(dtype)
+    if inplace is not None:
+        # Mutate through .data so leaf tensors with requires_grad=True
+        # (model parameters — the broadcast_parameters use case) accept
+        # the copy; shapes never change for allreduce/broadcast.
+        with torch.no_grad():
+            if inplace.shape != result.shape:
+                inplace.data = result
+            else:
+                inplace.data.copy_(result)
+        result = inplace
+    return result if recv_splits is None else (result, recv_splits)
 
 
 def broadcast_parameters(params, root_rank: int = 0,

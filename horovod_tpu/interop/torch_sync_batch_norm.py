@@ -38,10 +38,12 @@ class _SyncBNFunction(torch.autograd.Function):
         n_local = x.numel() // x.shape[1]
         s = x.sum(dims)                       # [C]
         ss = (x * x).sum(dims)                # [C]
+        # .to(float64) in torch first: half/bf16 tensors have no direct
+        # numpy conversion.
         packed = np.concatenate([
             np.asarray([float(n_local)], np.float64),
-            s.detach().numpy().astype(np.float64),
-            ss.detach().numpy().astype(np.float64)])
+            s.detach().to(torch.float64).numpy(),
+            ss.detach().to(torch.float64).numpy()])
         packed = _allreduce_sum(packed, "sync_bn.stats")
         c = x.shape[1]
         n_total = float(packed[0])
@@ -52,7 +54,11 @@ class _SyncBNFunction(torch.autograd.Function):
         invstd = torch.rsqrt(var + eps)
 
         shape = [1, c] + [1] * (x.dim() - 2)
-        out = (x - mean.view(shape)) * invstd.view(shape)
+        # Normalize in the INPUT dtype (half/bf16 models must get
+        # half/bf16 out, matching torch's native SyncBatchNorm); the f32
+        # mean/var returned for running-stats stay f32.
+        out = (x - mean.to(x.dtype).view(shape)) * \
+            invstd.to(x.dtype).view(shape)
         if weight is not None:
             out = out * weight.view(shape) + bias.view(shape)
         ctx.save_for_backward(x, weight, mean, invstd)
@@ -67,21 +73,23 @@ class _SyncBNFunction(torch.autograd.Function):
     def backward(ctx, grad_output, _gmean, _gvar, _gcount):
         x, weight, mean, invstd = ctx.saved_tensors
         dims, shape, n = ctx.dims, ctx.bn_shape, ctx.n_total
-        xmu = x - mean.view(shape)
+        xmu = x - mean.to(x.dtype).view(shape)
 
         sum_dy = grad_output.sum(dims)                     # [C]
         sum_dy_xmu = (grad_output * xmu).sum(dims)         # [C]
         packed = np.concatenate([
-            sum_dy.detach().numpy().astype(np.float64),
-            sum_dy_xmu.detach().numpy().astype(np.float64)])
+            sum_dy.detach().to(torch.float64).numpy(),
+            sum_dy_xmu.detach().to(torch.float64).numpy()])
         packed = _allreduce_sum(packed, "sync_bn.grads")
         c = x.shape[1]
-        g_sum_dy = torch.from_numpy(packed[:c].astype(np.float32))
-        g_sum_dy_xmu = torch.from_numpy(packed[c:].astype(np.float32))
+        g_sum_dy = torch.from_numpy(
+            packed[:c].astype(np.float32)).to(x.dtype)
+        g_sum_dy_xmu = torch.from_numpy(
+            packed[c:].astype(np.float32)).to(x.dtype)
 
-        w = (weight.view(shape) if weight is not None
-             else torch.ones_like(invstd).view(shape))
-        inv = invstd.view(shape)
+        w = (weight.to(x.dtype).view(shape) if weight is not None
+             else torch.ones_like(invstd, dtype=x.dtype).view(shape))
+        inv = invstd.to(x.dtype).view(shape)
         dx = w * inv * (
             grad_output
             - g_sum_dy.view(shape) / n

@@ -47,6 +47,13 @@ class HandleManager:
         e.result = result
         e.event.set()
 
+    def known(self, handle: int) -> bool:
+        """True while the handle has an unresolved entry (resolved or
+        never-allocated handles return False) — lets framework-side
+        registries sweep entries for handles resolved elsewhere."""
+        with self._lock:
+            return handle in self._entries
+
     def poll(self, handle: int) -> bool:
         """True if the operation completed (ref: mpi_ops.py:914 poll)."""
         with self._lock:

@@ -1,0 +1,241 @@
+"""MXNet interop binding tests (ref analogs: test/parallel/base_test_mxnet.py
+API cases; horovod/mxnet/__init__.py DistributedOptimizer/Trainer).
+
+mxnet is not in this image, so the binding's framework boundary is
+exercised through a minimal stub that implements exactly the NDArray /
+optimizer / gluon.Trainer surface the binding touches (``asnumpy``,
+slice assignment, ``astype``, ``rescale_grad``, ``_params``/``_scale``).
+The collective path underneath is the real eager controller.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _NDArray:
+    def __init__(self, arr, dtype=None):
+        self._a = np.array(arr, dtype=dtype)
+
+    def asnumpy(self):
+        return self._a.copy()
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    def astype(self, dt):
+        return _NDArray(self._a.astype(dt))
+
+    def __setitem__(self, key, value):
+        self._a[key] = value._a if isinstance(value, _NDArray) else value
+
+
+class _Optimizer:
+    def __init__(self, learning_rate=0.1):
+        self.lr = learning_rate
+        self.rescale_grad = 1.0
+        self.updated = []
+
+    def update(self, index, weight, grad, state):
+        self.updated.append(index)
+        weight[:] = weight.asnumpy() - self.lr * self.rescale_grad * \
+            grad.asnumpy()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+class _Parameter:
+    def __init__(self, name, value):
+        self.name = name
+        self.grad_req = "write"
+        self._data = _NDArray(value)
+        self._grad = _NDArray(np.ones_like(np.asarray(value)))
+
+    def data(self):
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class _Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if isinstance(params, dict):
+            params = list(params.values())
+        self._params = list(params)
+        self._optimizer = optimizer
+        self._scale = 1.0
+
+
+@pytest.fixture()
+def mx_stub(monkeypatch):
+    mx = types.ModuleType("mxnet")
+    mx.nd = types.SimpleNamespace(
+        NDArray=_NDArray, array=lambda a, dtype=None: _NDArray(a, dtype))
+    mx.optimizer = types.SimpleNamespace(Optimizer=_Optimizer)
+    mx.gluon = types.SimpleNamespace(Trainer=_Trainer)
+    monkeypatch.setitem(sys.modules, "mxnet", mx)
+    from horovod_tpu.interop import mxnet as binding
+
+    binding._CLS_CACHE.clear()
+    yield mx
+    binding._CLS_CACHE.clear()
+
+
+class TestMxnetOps:
+    def test_allreduce_roundtrip(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        t = _NDArray([1.0, 2.0, 3.0])
+        out = hmx.allreduce(t, name="mx0")
+        assert isinstance(out, _NDArray)
+        np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0, 3.0])
+
+    def test_allreduce_inplace_prescale(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        t = _NDArray([2.0, 4.0])
+        out = hmx.allreduce_(t, average=False, name="mx1",
+                             prescale_factor=0.5)
+        assert out is t
+        np.testing.assert_allclose(t.asnumpy(), [1.0, 2.0])
+
+    def test_grouped_inplace(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        ts = [_NDArray([1.0]), _NDArray([2.0, 3.0])]
+        outs = hmx.grouped_allreduce_(ts, average=False, name="mxg")
+        assert outs[0] is ts[0]
+        np.testing.assert_allclose(ts[1].asnumpy(), [2.0, 3.0])
+
+    def test_broadcast_and_allgather(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        t = _NDArray([5.0, 6.0])
+        np.testing.assert_allclose(
+            hmx.broadcast(t, root_rank=0, name="mxb").asnumpy(), [5.0, 6.0])
+        np.testing.assert_allclose(
+            hmx.allgather(t, name="mxag").asnumpy(), [5.0, 6.0])
+
+    def test_alltoall(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        t = _NDArray([7.0, 8.0])
+        out, splits = hmx.alltoall(t, name="mxa2a")
+        np.testing.assert_allclose(out.asnumpy(), [7.0, 8.0])
+        assert splits == [2]
+
+    def test_broadcast_parameters_dict(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        params = {"w": _Parameter("w", [1.0, 2.0]),
+                  "b": _NDArray([3.0])}
+        hmx.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(params["w"].data().asnumpy(), [1.0, 2.0])
+        np.testing.assert_allclose(params["b"].asnumpy(), [3.0])
+
+
+class TestMxnetOptimizer:
+    def test_rescale_and_delegation(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        base = _Optimizer(learning_rate=0.5)
+        opt = hmx.DistributedOptimizer(base, gradient_predivide_factor=2.0)
+        # rescale_grad normalized by predivide/size (size 1 here).
+        assert base.rescale_grad == 2.0
+        w, g = _NDArray([1.0]), _NDArray([1.0])
+        opt.update(3, w, g, None)
+        assert base.updated == [3]
+        assert opt.lr == 0.5                     # __getattr__ delegation
+        opt.set_learning_rate(0.1)
+        assert base.lr == 0.1
+
+    def test_do_allreduce_multirank_paths(self, hvd, mx_stub):
+        """With a size-2 process set view the optimizer must enqueue real
+        collectives (actual world is 1 rank, so sum == identity)."""
+        from horovod_tpu.interop import mxnet as hmx
+        from horovod_tpu.common.process_sets import global_process_set
+
+        gps = global_process_set()
+        ps = types.SimpleNamespace(id=gps.id, size=lambda: 2,
+                                   included=lambda: True)
+        base = _Optimizer()
+        opt = hmx.DistributedOptimizer(base, process_set=ps)
+        g1, g2 = _NDArray([2.0]), _NDArray([4.0])
+        opt._do_allreduce([0, 1], [g1, g2])      # per-index path
+        np.testing.assert_allclose(g1.asnumpy(), [2.0])
+        opt2 = hmx.DistributedOptimizer(_Optimizer(), num_groups=1,
+                                        process_set=ps)
+        opt2._do_allreduce([0, 1], [g1, g2])     # grouped path
+        np.testing.assert_allclose(g2.asnumpy(), [4.0])
+
+
+class TestMxnetTrainer:
+    def _params(self):
+        return {"a": _Parameter("a", [1.0, 1.0]),
+                "b": _Parameter("b", [2.0])}
+
+    def test_scale_and_unwrap(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        base = _Optimizer()
+        with pytest.warns(UserWarning, match="unwrapped"):
+            tr = hmx.DistributedTrainer(
+                self._params(), hmx.DistributedOptimizer(base),
+                gradient_predivide_factor=4.0)
+        assert tr._optimizer is base
+        assert tr._scale == 4.0                  # predivide/size(=1)
+
+    def test_allreduce_grads_size1_noop(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+
+        tr = hmx.DistributedTrainer(self._params(), _Optimizer())
+        tr._allreduce_grads()                    # early-out, no enqueue
+
+    def test_allreduce_grads_multirank(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+        from horovod_tpu.common.process_sets import global_process_set
+
+        gps = global_process_set()
+        ps = types.SimpleNamespace(id=gps.id, size=lambda: 2,
+                                   included=lambda: True)
+        tr = hmx.DistributedTrainer(self._params(), _Optimizer(),
+                                    process_set=ps, prefix="t0.")
+        tr._allreduce_grads()
+        for p in tr._params:
+            np.testing.assert_allclose(p.list_grad()[0].asnumpy(),
+                                       np.ones(p.data().shape))
+
+    def test_allreduce_grads_grouped_compressed(self, hvd, mx_stub):
+        from horovod_tpu.interop import mxnet as hmx
+        from horovod_tpu.common.process_sets import global_process_set
+
+        gps = global_process_set()
+        ps = types.SimpleNamespace(id=gps.id, size=lambda: 2,
+                                   included=lambda: True)
+        tr = hmx.DistributedTrainer(self._params(), _Optimizer(),
+                                    process_set=ps, num_groups=1,
+                                    compression=hmx.Compression.fp16,
+                                    prefix="t1.")
+        tr._allreduce_grads()
+        for p in tr._params:
+            np.testing.assert_allclose(p.list_grad()[0].asnumpy(),
+                                       np.ones(p.data().shape))

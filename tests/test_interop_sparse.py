@@ -103,3 +103,122 @@ class TestTorchInterop:
             _to_np(fake)
         # sanity: the happy path still converts
         assert _to_np(torch.ones(2)).shape == (2,)
+
+
+class TestTorchInteropParity:
+    """Reference torch/mpi_ops.py surface: in-place + async variants,
+    grouped ops, sparse handle, join/barrier/poll, torch-typed
+    synchronize (ref: torch/__init__.py import list)."""
+
+    def test_async_synchronize_returns_torch(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        t = torch.arange(4, dtype=torch.float32)
+        h = hvd_torch.allreduce_async(t, name="p_async")
+        assert hvd_torch.poll(h) in (True, False)
+        out = hvd_torch.synchronize(h)
+        assert isinstance(out, torch.Tensor)
+        assert torch.allclose(out, t)
+
+    def test_allreduce_inplace(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        t = torch.arange(4, dtype=torch.float32)
+        expected = t.clone()
+        out = hvd_torch.allreduce_(t, name="p_inplace")
+        assert out is t
+        assert torch.allclose(t, expected)
+
+    def test_broadcast_inplace_async(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        t = torch.ones(3)
+        h = hvd_torch.broadcast_async_(t, root_rank=0, name="p_bcast")
+        out = hvd_torch.synchronize(h)
+        assert out is t
+        assert torch.allclose(t, torch.ones(3))
+
+    def test_grouped_allreduce_variants(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        ts = [torch.ones(2), torch.full((3,), 2.0)]
+        outs = hvd_torch.grouped_allreduce(ts, name="p_grp")
+        assert all(isinstance(o, torch.Tensor) for o in outs)
+        assert torch.allclose(outs[1], ts[1])
+
+        ts2 = [torch.ones(2), torch.full((3,), 5.0)]
+        outs2 = hvd_torch.grouped_allreduce_(ts2, name="p_grp_ip")
+        assert outs2[0] is ts2[0] and outs2[1] is ts2[1]
+        assert torch.allclose(ts2[1], torch.full((3,), 5.0))
+
+    def test_alltoall_async(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        t = torch.arange(4, dtype=torch.float32)
+        h = hvd_torch.alltoall_async(t, name="p_a2a")
+        out, splits = hvd_torch.synchronize(h)
+        assert isinstance(out, torch.Tensor)
+        assert torch.allclose(out, t)
+        assert splits == [4]
+
+    def test_sparse_allreduce_async(self, hvd):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        t = torch.sparse_coo_tensor([[0, 2]], [1.0, 2.0], (4,))
+        resolve = hvd_torch.sparse_allreduce_async(t, name="p_sparse",
+                                                   op=None)
+        out = resolve()
+        assert out.is_sparse
+        dense = out.to_dense()
+        assert torch.allclose(dense, torch.tensor([1.0, 0.0, 2.0, 0.0]))
+
+    def test_join_barrier(self, hvd):
+        pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        hvd_torch.barrier()
+        assert hvd_torch.join() >= 0
+
+    def test_object_helpers_and_compression(self, hvd):
+        pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        assert hvd_torch.broadcast_object({"a": 1}, root_rank=0) == {"a": 1}
+        assert hvd_torch.allgather_object([2, 3]) == [[2, 3]]
+        assert hvd_torch.Compression.fp16 is not None
+
+    def test_top_level_allgather_object(self, hvd):
+        import horovod_tpu
+
+        assert horovod_tpu.allgather_object(7) == [7]
+
+    def test_bfloat16_tensor_roundtrip(self, hvd):
+        """bf16 — THE TPU dtype — has no direct torch<->numpy conversion;
+        the boundary reinterprets bits through ml_dtypes.bfloat16."""
+        torch = pytest.importorskip("torch")
+        import ml_dtypes
+        from horovod_tpu.interop import torch as hvd_torch
+        from horovod_tpu.interop.torch import _to_np
+
+        t = torch.tensor([1.5, -2.25, 3.0], dtype=torch.bfloat16)
+        arr = _to_np(t)
+        assert arr.dtype == ml_dtypes.bfloat16
+        out = hvd_torch.allreduce(t, name="p_bf16")
+        assert out.dtype == torch.bfloat16
+        assert torch.allclose(out, t)
+
+    def test_requires_grad_param_broadcast_inplace(self, hvd):
+        """broadcast_ on a requires_grad leaf (model parameter) must not
+        raise (regression: resize_ on variables that require grad)."""
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as hvd_torch
+
+        p = torch.nn.Parameter(torch.ones(3))
+        out = hvd_torch.broadcast_(p, root_rank=0, name="p_rg")
+        assert out is p and p.requires_grad

@@ -166,3 +166,19 @@ def test_ragged_batches_running_stats_exact():
     for out in results:
         np.testing.assert_allclose(out["rv"], out["ref_rv"],
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_half_input_keeps_dtype(hvd):
+    """Half/bf16 models must get half/bf16 activations out (torch native
+    SyncBatchNorm contract); stats still reduce in f64 on the wire."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.interop.torch_sync_batch_norm import SyncBatchNorm
+
+    for dt in (torch.float16, torch.bfloat16):
+        sbn = SyncBatchNorm(3).to(dt)
+        sbn.train()
+        x = torch.randn(4, 3, dtype=dt, requires_grad=True)
+        out = sbn(x)
+        assert out.dtype == dt
+        out.sum().backward()
+        assert x.grad is not None and x.grad.dtype == dt

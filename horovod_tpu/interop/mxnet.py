@@ -327,13 +327,12 @@ def _trainer_cls():
             pre = 1.0 / self._gradient_predivide_factor
             none = Compression.none
             if self._num_groups > 0:
-                grads, names, compressed, ctxs = [], [], [], []
+                grads, names, ctxs = [], [], []
                 for i, param in enumerate(self._params):
                     if param.grad_req != "null":
                         tc, ctx = self._compression.compress(
                             param.list_grad()[0])
                         grads.append(tc)
-                        compressed.append(tc)
                         ctxs.append(ctx)
                         names.append(self._prefix + str(i))
                 for i, (group_grads, group_names) in enumerate(zip(
@@ -349,11 +348,12 @@ def _trainer_cls():
                             name=f"{ns[0]}:{ns[-1]}", priority=-i,
                             prescale_factor=pre, process_set=ps)
                 if self._compression is not none:
+                    reduced = iter(zip(grads, ctxs))
                     for param in self._params:
                         if param.grad_req != "null":
+                            tc, ctx = next(reduced)
                             param.list_grad()[0][:] = _to_np(
-                                self._compression.decompress(
-                                    compressed.pop(0), ctxs.pop(0)))
+                                self._compression.decompress(tc, ctx))
             else:
                 for i, param in enumerate(self._params):
                     if param.grad_req != "null":

@@ -80,21 +80,22 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
         n_local = arr.shape[0]
         out = np.asarray(eager.allgather(arr, name=name,
                                          process_set=process_set))
-        # Rows are rank-ordered; ragged sizes require knowing every
-        # rank's count.  Gather them NOW (counts are invariant for this
-        # call) so the backward pass pays one collective, not two.
-        counts = np.asarray(eager.allgather(
-            np.asarray([n_local], np.int32),
-            name=None if name is None else f"{name}.counts",
-            process_set=process_set))
-        rank = (process_set.rank() if process_set is not None
-                else basics.rank())
-        off = int(counts[:rank].sum())
 
         def grad(dy):
             g = np.asarray(eager.allreduce(
                 _to_np(dy), name=None if name is None else f"{name}.grad",
                 op=ReduceOp.SUM, process_set=process_set))
+            rank = (process_set.rank() if process_set is not None
+                    else basics.rank())
+            # Rows are rank-ordered; ragged sizes require every rank's
+            # count, gathered HERE so gradient-free calls (eval loops)
+            # pay a single collective — sizes may legitimately differ
+            # call to call (last batch), so they cannot be cached.
+            counts = np.asarray(eager.allgather(
+                np.asarray([n_local], np.int32),
+                name=None if name is None else f"{name}.counts",
+                process_set=process_set))
+            off = int(counts[:rank].sum())
             return tf.convert_to_tensor(g[off:off + n_local],
                                         dtype=dy.dtype)
 

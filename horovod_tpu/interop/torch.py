@@ -95,31 +95,22 @@ def _from_np(a: np.ndarray, like) -> "Any":
     return _np_to_torch(a).to(like.dtype)
 
 
-# handle -> (result torch dtype, weakref to in-place target or None).
-# Handles issued through this module resolve to torch tensors in
-# ``synchronize`` (the reference contract: mpi_ops.py synchronize
-# returns the output tensor, the in-place variants mutate their
-# argument).  Only the dtype is kept for out-of-place results (tiny,
-# immortal objects); in-place targets are weak references so an
-# abandoned handle (exception between enqueue and synchronize,
-# poll-then-drop) never pins a tensor.  Dead/abandoned entries are swept
-# once the table grows past a threshold.
-_handle_targets: dict = {}
-_SWEEP_AT = 1024
-
-
 def _register(handle: int, like, inplace=None) -> int:
+    """Attach (result torch dtype, weakref to in-place target) to the
+    handle so this module's ``synchronize`` resolves it to a torch
+    tensor (the reference contract: mpi_ops.py synchronize returns the
+    output tensor, the in-place variants mutate their argument).  The
+    metadata lives INSIDE the handle entry (HandleManager.set_meta), so
+    it shares the handle's lifetime exactly — no side table to leak for
+    abandoned or foreign-resolved handles.  The in-place target is a
+    weak reference: a dropped tensor is never pinned by a pending op."""
     import weakref
 
     from ..ops import eager
 
-    if len(_handle_targets) >= _SWEEP_AT:
-        for h in [h for h in _handle_targets
-                  if not eager._controller().handles.known(h)]:
-            del _handle_targets[h]
-    _handle_targets[handle] = (like.dtype,
-                               None if inplace is None
-                               else weakref.ref(inplace))
+    eager._controller().handles.set_meta(
+        handle, (like.dtype,
+                 None if inplace is None else weakref.ref(inplace)))
     return handle
 
 
@@ -326,8 +317,9 @@ def synchronize(handle: int):
     resolve to the eager layer's numpy result."""
     from ..ops import eager
 
+    meta = eager._controller().handles.take_meta(handle)
     out = eager.synchronize(handle)
-    dtype, inplace_ref = _handle_targets.pop(handle, (None, None))
+    dtype, inplace_ref = meta if meta is not None else (None, None)
     inplace = inplace_ref() if inplace_ref is not None else None
     if dtype is None:
         return out

@@ -17,12 +17,13 @@ __all__ = ["HandleManager"]
 
 
 class _Entry:
-    __slots__ = ("event", "status", "result")
+    __slots__ = ("event", "status", "result", "meta")
 
     def __init__(self) -> None:
         self.event = threading.Event()
         self.status: Optional[Status] = None
         self.result: Any = None
+        self.meta: Any = None
 
 
 class HandleManager:
@@ -47,12 +48,26 @@ class HandleManager:
         e.result = result
         e.event.set()
 
-    def known(self, handle: int) -> bool:
-        """True while the handle has an unresolved entry (resolved or
-        never-allocated handles return False) — lets framework-side
-        registries sweep entries for handles resolved elsewhere."""
+    def set_meta(self, handle: int, meta: Any) -> None:
+        """Attach framework-side metadata (e.g. the torch binding's
+        result dtype / in-place target) to a live handle.  Metadata
+        shares the entry's lifetime — dropped with the entry at
+        ``synchronize`` — so framework registries cannot outlive or leak
+        past the handles they describe."""
         with self._lock:
-            return handle in self._entries
+            e = self._entries.get(handle)
+            if e is not None:
+                e.meta = meta
+
+    def take_meta(self, handle: int) -> Any:
+        """Return and clear the handle's metadata (None if the handle is
+        unknown, already resolved, or carries none)."""
+        with self._lock:
+            e = self._entries.get(handle)
+            if e is None or e.meta is None:
+                return None
+            meta, e.meta = e.meta, None
+            return meta
 
     def poll(self, handle: int) -> bool:
         """True if the operation completed (ref: mpi_ops.py:914 poll)."""

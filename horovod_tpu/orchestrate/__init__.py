@@ -12,5 +12,7 @@ wrapper the Spark estimators provided.
 from .executor import Executor
 from .ray_adapter import RayExecutor
 from .estimator import JaxEstimator, ParquetSource
+from . import spark  # noqa: F401  (pyspark itself is imported lazily)
 
-__all__ = ["Executor", "RayExecutor", "JaxEstimator", "ParquetSource"]
+__all__ = ["Executor", "RayExecutor", "JaxEstimator", "ParquetSource",
+           "spark"]

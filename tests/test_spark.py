@@ -1,0 +1,168 @@
+"""Spark adapter tests (ref analogs: test/integration/test_spark.py run
+cases; horovod/spark/runner.py:197).
+
+pyspark is not in this image, so the adapter runs against a stub
+implementing exactly the Spark surface it touches (active context,
+defaultParallelism, parallelize -> barrier -> mapPartitions -> collect,
+BarrierTaskContext, job groups).  Partitions execute sequentially in
+process — rank layout, env contract, result ordering, and cancellation
+logic are what's under test; the distributed init underneath is covered
+by the runner/eager suites.
+"""
+
+import os
+import sys
+import types
+
+import pytest
+
+
+class _TaskInfo:
+    def __init__(self, address):
+        self.address = address
+
+
+class _BarrierTaskContext:
+    current = None
+
+    def __init__(self, rank, addresses):
+        self._rank = rank
+        self._addresses = addresses
+
+    @classmethod
+    def get(cls):
+        return cls.current
+
+    def partitionId(self):
+        return self._rank
+
+    def getTaskInfos(self):
+        return [_TaskInfo(a) for a in self._addresses]
+
+    def barrier(self):
+        pass
+
+
+class _BarrierRDD:
+    def __init__(self, sc, n):
+        self._sc, self._n = sc, n
+
+    def mapPartitions(self, f):
+        self._f = f
+        return self
+
+    def collect(self):
+        if self._sc.fail_with is not None:
+            raise self._sc.fail_with
+        out = []
+        for rank in range(self._n):
+            _BarrierTaskContext.current = _BarrierTaskContext(
+                rank, self._sc.addresses(self._n))
+            try:
+                out.extend(self._f(iter([rank])))
+            finally:
+                _BarrierTaskContext.current = None
+        return out
+
+
+class _RDD(_BarrierRDD):
+    def barrier(self):
+        return _BarrierRDD(self._sc, self._n)
+
+
+class _StubContext:
+    def __init__(self, default_parallelism=3, hosts=None):
+        self.defaultParallelism = default_parallelism
+        self._hosts = hosts
+        self.cancelled = []
+        self.job_groups = []
+        self.fail_with = None
+
+    def addresses(self, n):
+        if self._hosts:
+            return [f"{self._hosts[i % len(self._hosts)]}:{40000 + i}"
+                    for i in range(n)]
+        return [f"host0:{40000 + i}" for i in range(n)]
+
+    def parallelize(self, data, n):
+        return _RDD(self, n)
+
+    def setJobGroup(self, group, desc, interruptOnCancel=False):
+        self.job_groups.append(group)
+
+    def cancelJobGroup(self, group):
+        self.cancelled.append(group)
+
+
+@pytest.fixture()
+def spark_stub(monkeypatch):
+    mod = types.ModuleType("pyspark")
+    ctx = _StubContext()
+    mod.SparkContext = types.SimpleNamespace(_active_spark_context=ctx)
+    mod.BarrierTaskContext = _BarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    yield ctx
+
+
+def _echo_contract():
+    return {k: os.environ[k] for k in
+            ("HVDT_RANK", "HVDT_SIZE", "HVDT_LOCAL_RANK", "HVDT_LOCAL_SIZE",
+             "HVDT_CROSS_RANK", "HVDT_CROSS_SIZE",
+             "HVDT_RENDEZVOUS_ADDR", "HVDT_RENDEZVOUS_PORT", "HVDT_SECRET")}
+
+
+class TestSparkRun:
+    def test_results_in_rank_order_with_contract(self, spark_stub):
+        from horovod_tpu.orchestrate import spark as hspark
+
+        res = hspark.run(_echo_contract, num_proc=3)
+        assert [int(r["HVDT_RANK"]) for r in res] == [0, 1, 2]
+        assert all(r["HVDT_SIZE"] == "3" for r in res)
+        # single stub host: local == global rank, one cross rank
+        assert [int(r["HVDT_LOCAL_RANK"]) for r in res] == [0, 1, 2]
+        assert all(r["HVDT_CROSS_SIZE"] == "1" for r in res)
+        assert all(r["HVDT_SECRET"] for r in res)
+
+    def test_num_proc_defaults_to_parallelism(self, spark_stub):
+        from horovod_tpu.orchestrate import spark as hspark
+
+        res = hspark.run(lambda: int(os.environ["HVDT_SIZE"]))
+        assert res == [3, 3, 3]
+
+    def test_multihost_rank_layout(self, spark_stub):
+        spark_stub._hosts = ["hostA", "hostB"]
+        from horovod_tpu.orchestrate import spark as hspark
+
+        res = hspark.run(_echo_contract, num_proc=4)
+        # round-robin placement: A,B,A,B
+        assert [int(r["HVDT_LOCAL_RANK"]) for r in res] == [0, 0, 1, 1]
+        assert [int(r["HVDT_LOCAL_SIZE"]) for r in res] == [2, 2, 2, 2]
+        assert [int(r["HVDT_CROSS_RANK"]) for r in res] == [0, 1, 0, 1]
+        assert all(int(r["HVDT_CROSS_SIZE"]) == 2 for r in res)
+
+    def test_args_kwargs_and_env_passthrough(self, spark_stub):
+        from horovod_tpu.orchestrate import spark as hspark
+
+        def fn(a, b=0):
+            return a + b + int(os.environ["HVDT_TEST_EXTRA"])
+
+        res = hspark.run(fn, args=(10,), kwargs={"b": 5}, num_proc=2,
+                         env={"HVDT_TEST_EXTRA": "100"})
+        assert res == [115, 115]
+
+    def test_no_active_context_raises(self, spark_stub, monkeypatch):
+        import pyspark
+
+        monkeypatch.setattr(pyspark.SparkContext, "_active_spark_context",
+                            None)
+        from horovod_tpu.orchestrate import spark as hspark
+
+        with pytest.raises(RuntimeError, match="active SparkContext"):
+            hspark.run(lambda: 0, num_proc=1)
+
+    def test_job_failure_propagates(self, spark_stub):
+        from horovod_tpu.orchestrate import spark as hspark
+
+        spark_stub.fail_with = ValueError("executor lost")
+        with pytest.raises(ValueError, match="executor lost"):
+            hspark.run(lambda: 0, num_proc=2)

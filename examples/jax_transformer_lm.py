@@ -52,6 +52,11 @@ def main():
                    help="activation/compute dtype (bfloat16 on TPU)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (trade FLOPs for HBM)")
+    p.add_argument("--remat-policy", choices=["full", "dots"],
+                   default="full",
+                   help="full: recompute the whole block; dots: save "
+                        "matmul outputs, recompute only elementwise "
+                        "(more HBM, no MXU recompute)")
     p.add_argument("--loss-chunk", type=int, default=0,
                    help=">0: chunked-vocab cross entropy (no "
                         "[tokens, vocab] logits tensor)")
@@ -110,7 +115,7 @@ def main():
         max_seq=args.seq, dtype=getattr(jnp, args.dtype),
         num_experts=2 * args.ep if args.ep > 1 else 0,
         sp=args.sp, ep=args.ep, pp=args.pp, remat=args.remat,
-        loss_chunk=args.loss_chunk)
+        remat_policy=args.remat_policy, loss_chunk=args.loss_chunk)
     params = transformer_init(jax.random.PRNGKey(0), cfg)
     rules = transformer_rules()
     axes = transformer_logical_axes(cfg)

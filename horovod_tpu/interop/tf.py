@@ -23,8 +23,9 @@ import numpy as np
 from ..common.types import ReduceOp
 
 __all__ = ["allreduce", "allgather", "broadcast", "broadcast_variables",
-           "DistributedGradientTape", "BroadcastGlobalVariablesCallback",
-           "MetricAverageCallback"]
+           "DistributedGradientTape", "DistributedOptimizer", "load_model",
+           "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+           "LearningRateScheduleCallback", "LearningRateWarmupCallback"]
 
 
 def _to_np(t) -> np.ndarray:
@@ -283,3 +284,264 @@ class MetricAverageCallback:
                             process_set=process_set)))
 
         return _Impl()
+
+
+def _wrap_optimizer_class(cls, op=None, compression=None, process_set=None,
+                          name_prefix: str = "DistributedOptimizer"):
+    """Dynamic keras-optimizer subclass whose ``apply`` allreduces every
+    gradient across ranks first (ref: _keras/__init__.py
+    create_distributed_optimizer — same dynamic-subclass trick, keyed to
+    Keras 3's ``apply`` so both ``apply_gradients`` and ``model.fit``'s
+    trainer path are covered).
+
+    Inside a ``tf.function`` graph the reduction runs as a
+    ``tf.py_function`` (the eager controller is host-side Python — same
+    constraint as the reference's CPU-negotiated ops); XLA-jitted
+    training (``jit_compile=True``) is not supported on this interop
+    path — use the JAX-native API for compiled training.
+    """
+    import tensorflow as tf
+
+    from ..ops import eager
+    from ..ops.compression import Compression
+
+    comp = compression or Compression.none
+
+    class _DistributedOptimizer(cls):
+        _hvd_wrapped = True
+
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            if trainable_variables is None:
+                reduced = _reduce_grads(grads, list(range(len(grads))))
+                return super().apply(reduced, **kwargs)
+            reduced = _reduce_grads(
+                grads, [getattr(v, "path", getattr(v, "name", i))
+                        for i, v in enumerate(trainable_variables)])
+            return super().apply(reduced, trainable_variables, **kwargs)
+
+    def _reduce_all_np(arrs, names):
+        """Async-enqueue every gradient, then synchronize — the handles
+        overlap through one negotiation cycle instead of paying one
+        blocking round trip per tensor (same pattern as
+        DistributedGradientTape.gradient)."""
+        wires, ctxs = zip(*(comp.compress(a) for a in arrs))
+        handles = [eager.allreduce_async(w, name=nm, op=op,
+                                         process_set=process_set)
+                   for w, nm in zip(wires, names)]
+        return [np.asarray(comp.decompress(eager.synchronize(h), c))
+                .astype(a.dtype)
+                for h, c, a in zip(handles, ctxs, arrs)]
+
+    def _reduce_grads(grads, names):
+        dense, full_names, slots = [], [], []
+        out = list(grads)
+        for i, (g, nm) in enumerate(zip(grads, names)):
+            if g is None:
+                continue
+            if isinstance(g, tf.IndexedSlices):
+                # sparse_as_dense (ref default for keras wrappers)
+                g = tf.convert_to_tensor(g)
+            dense.append(g)
+            full_names.append(f"{name_prefix}.grad.{nm}")
+            slots.append(i)
+        if not dense:
+            return out
+        if tf.executing_eagerly():
+            reduced = [tf.convert_to_tensor(r) for r in _reduce_all_np(
+                [_to_np(g) for g in dense], full_names)]
+        else:
+            # One py_function for the whole bundle: the host call enqueues
+            # every allreduce before synchronizing any.
+            def _host(*tensors):
+                return _reduce_all_np([t.numpy() for t in tensors],
+                                      full_names)
+
+            reduced = tf.py_function(_host, dense,
+                                     Tout=[g.dtype for g in dense])
+            for r, g in zip(reduced, dense):
+                r.set_shape(g.shape)
+        for i, r in zip(slots, reduced):
+            out[i] = r
+        return out
+
+    _DistributedOptimizer.__name__ = cls.__name__
+    _DistributedOptimizer.__qualname__ = cls.__qualname__
+    return _DistributedOptimizer
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None, op=None,
+                         compression=None, process_set=None):
+    """Wrap a configured ``keras.optimizers.Optimizer`` so every gradient
+    is averaged across ranks before the update (ref:
+    tensorflow/keras/__init__.py:49 DistributedOptimizer)."""
+    cls = _wrap_optimizer_class(
+        optimizer.__class__, op=op, compression=compression,
+        process_set=process_set,
+        name_prefix=name or "DistributedOptimizer")
+    return cls.from_config(optimizer.get_config())
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None, op=None, process_set=None):
+    """``keras.models.load_model`` that rebuilds the model's optimizer as
+    a :func:`DistributedOptimizer` (ref: tensorflow/keras/__init__.py:216
+    load_model).
+
+    The reference injects wrapped classes through ``custom_objects``;
+    Keras 3 resolves built-in optimizers by registered name before
+    consulting ``custom_objects``, so instead the loaded optimizer is
+    re-instantiated as the wrapped subclass AFTER loading, with its
+    restored state (iteration count, momentum/slot variables) copied
+    over.  ``custom_optimizers`` (a list of custom optimizer classes)
+    feeds deserialization of non-builtin optimizers, as in the
+    reference."""
+    import keras
+
+    co = dict(custom_objects or {})
+    for cls in custom_optimizers or []:
+        co.setdefault(cls.__name__, cls)
+    model = keras.models.load_model(filepath, custom_objects=co)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(opt, "_hvd_wrapped", False):
+        cls = _wrap_optimizer_class(opt.__class__, op=op,
+                                    compression=compression,
+                                    process_set=process_set)
+        new_opt = cls.from_config(opt.get_config())
+        if getattr(opt, "built", False):
+            new_opt.build(model.trainable_variables)
+            for dst, src in zip(new_opt.variables, opt.variables):
+                dst.assign(src)
+        model.optimizer = new_opt
+    return model
+
+
+class LearningRateScheduleCallback:
+    """Keras callback scaling the LR by ``multiplier(epoch)`` relative to
+    ``initial_lr`` (ref: _keras/callbacks.py:95 — same staircase /
+    fractional-epoch semantics and momentum correction)."""
+
+    def __new__(cls, initial_lr, multiplier, start_epoch: int = 0,
+                end_epoch: Optional[int] = None, staircase: bool = True,
+                momentum_correction: bool = True,
+                steps_per_epoch: Optional[int] = None):
+        Base = _keras_callback_base()
+        if initial_lr is None:
+            raise ValueError("Parameter `initial_lr` is required")
+        if not callable(multiplier):
+            mult = lambda epoch: multiplier ** (epoch - start_epoch)  # noqa: E731
+        else:
+            mult = multiplier
+
+        class _Impl(Base):
+            def __init__(self):
+                super().__init__()
+                self.current_epoch = None
+                self.restore_momentum = None
+                self.steps_per_epoch = steps_per_epoch
+
+            def _lr_var(self):
+                return self.model.optimizer.learning_rate
+
+            def _adjust(self, epoch):
+                import numpy as _np
+                import tensorflow as tf
+
+                opt = self.model.optimizer
+                old_lr = float(_np.asarray(self._lr_var()))
+                new_lr = initial_lr * mult(epoch)
+                self._lr_var().assign(new_lr)
+                # Momentum correction (Goyal et al.) only works when the
+                # optimizer's momentum is a variable the traced train
+                # step actually reads.  Keras-3 built-ins keep momentum
+                # as a plain Python float that is constant-folded into
+                # the tf.function graph — mutating it there would take
+                # effect once at trace time and never restore, so it is
+                # skipped (a schedule without correction, not a silently
+                # wrong one).
+                mom = getattr(opt, "momentum", None)
+                if momentum_correction and isinstance(
+                        mom, (tf.Variable,)):
+                    self.restore_momentum = float(_np.asarray(mom))
+                    mom.assign(self.restore_momentum * new_lr /
+                               max(old_lr, 1e-30))
+
+            def on_train_begin(self, logs=None):
+                if not staircase and not self.steps_per_epoch:
+                    self.steps_per_epoch = self.params.get("steps")
+                    if not self.steps_per_epoch:
+                        raise ValueError(
+                            "Could not autodetect steps_per_epoch: pass "
+                            "steps_per_epoch= explicitly")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                self.current_epoch = epoch
+
+            def on_train_batch_begin(self, batch, logs=None):
+                if (self.current_epoch < start_epoch or
+                        (end_epoch is not None and
+                         self.current_epoch >= end_epoch)):
+                    return
+                if staircase and batch == 0:
+                    self._adjust(self.current_epoch)
+                elif not staircase:
+                    self._adjust(self.current_epoch +
+                                 float(batch) / self.steps_per_epoch)
+
+            def on_train_batch_end(self, batch, logs=None):
+                if self.restore_momentum is not None:
+                    self.model.optimizer.momentum.assign(
+                        self.restore_momentum)
+                    self.restore_momentum = None
+
+            def on_epoch_end(self, epoch, logs=None):
+                import numpy as _np
+
+                if logs is not None:
+                    logs["lr"] = float(_np.asarray(self._lr_var()))
+
+        return _Impl()
+
+
+class LearningRateWarmupCallback:
+    """Gradual linear LR warmup from ``initial_lr / size`` up to
+    ``initial_lr`` over ``warmup_epochs`` (ref: _keras/callbacks.py:181
+    — Goyal et al.; the multiplier ramps 1/size -> 1, so pass the final
+    already-size-scaled LR as ``initial_lr``)."""
+
+    def __new__(cls, initial_lr, warmup_epochs: int = 5,
+                momentum_correction: bool = True,
+                steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        from ..common import basics
+
+        size = basics.size()
+        holder = {}
+
+        def multiplier(epoch):
+            epoch += 1.0 / holder.get("steps_per_epoch", 1)
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+
+        cb = LearningRateScheduleCallback(
+            initial_lr, multiplier, start_epoch=0, end_epoch=warmup_epochs,
+            staircase=False, momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch)
+        orig_train_begin = cb.on_train_begin
+        orig_epoch_end = cb.on_epoch_end
+
+        def on_train_begin(logs=None):
+            orig_train_begin(logs)
+            holder["steps_per_epoch"] = cb.steps_per_epoch or 1
+
+        def on_epoch_end(epoch, logs=None):
+            orig_epoch_end(epoch, logs)
+            if epoch == warmup_epochs - 1 and verbose > 0 and \
+                    basics.rank() == 0:
+                import numpy as _np
+
+                lr = float(_np.asarray(
+                    cb.model.optimizer.learning_rate))
+                print(f"\nEpoch {epoch + 1}: finished gradual learning "
+                      f"rate warmup to {lr:g}.")
+
+        cb.on_train_begin = on_train_begin
+        cb.on_epoch_end = on_epoch_end
+        return cb

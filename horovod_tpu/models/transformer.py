@@ -62,6 +62,15 @@ class TransformerConfig:
     ep: int = 1                  # expert-parallel degree
     pp: int = 1                  # pipeline stages (layers % pp == 0)
     remat: bool = False          # jax.checkpoint each block
+    # Rematerialization policy when remat=True:
+    #   "full" — save only block inputs, recompute everything (min HBM,
+    #            +1/3 FLOPs — the classic trade);
+    #   "dots" — jax.checkpoint_policies.dots_with_no_batch_dims_saveable:
+    #            save non-batched matmul outputs (projections, FF), so
+    #            the backward recomputes only cheap elementwise work and
+    #            attention scores.  ~MXU-free recompute at the cost of
+    #            O(layers * 6*b*l*d + b*l*4d) extra HBM residency.
+    remat_policy: str = "full"
     loss_chunk: int = 0          # >0: chunked-vocab cross entropy
 
     @property
@@ -255,7 +264,17 @@ def _block(p, x, positions, cfg: TransformerConfig):
 def _scan_blocks(block_params, x, positions, cfg: TransformerConfig):
     body = functools.partial(_block, positions=positions, cfg=cfg)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies
+                .dots_with_no_batch_dims_saveable)
+        elif cfg.remat_policy == "full":
+            body = jax.checkpoint(body)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} "
+                "(expected 'full' or 'dots')")
 
     def step(h, layer_p):
         return body(layer_p, h), None

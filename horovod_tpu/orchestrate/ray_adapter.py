@@ -1,10 +1,13 @@
 """RayExecutor — the reference's Ray API surface on this framework.
 
 Re-conception of ref: ray/runner.py:168 RayExecutor (+ create_settings,
-strategy.py placement).  When Ray is importable, workers become Ray
-actors placed by a colocation strategy; otherwise the same API degrades
-to the local Executor pool so code written against it still runs (and is
-testable in this image, which has no Ray).
+strategy.py placement).  When Ray is initialized, workers become Ray
+actors: created with the caller's cpu/gpu resource options, located by
+node IP, then handed the full HVDT_* env contract (local/cross ranks
+from co-location + the driver's rendezvous KV) so ``hvd.init()`` inside
+actors works like CLI-launched workers.  Without Ray the same API runs
+on the local Executor pool.  The Ray branch is exercised against a stub
+runtime in tests/test_ray.py (Ray itself is not in this image).
 """
 
 from __future__ import annotations
@@ -60,16 +63,16 @@ class RayExecutor:
                     "provide num_workers or num_hosts*num_workers_per_host")
         self.settings = settings or Settings()
         self.num_workers = num_workers
+        self._cpus_per_worker = cpus_per_worker
+        self._use_gpu = use_gpu
+        self._gpus_per_worker = gpus_per_worker
         # Record only options the caller actually changed from their
-        # defaults (placement/elastic knobs have no local-pool meaning).
-        defaults = dict(cpus_per_worker=1, use_gpu=False,
-                        gpus_per_worker=None,
-                        use_current_placement_group=True, min_workers=None,
+        # defaults (placement/elastic knobs have no meaning on either
+        # backend here; the resource knobs above feed Ray actor options).
+        defaults = dict(use_current_placement_group=True, min_workers=None,
                         max_workers=None, reset_limit=None,
                         elastic_timeout=600, override_discovery=True)
-        passed = dict(cpus_per_worker=cpus_per_worker, use_gpu=use_gpu,
-                      gpus_per_worker=gpus_per_worker,
-                      use_current_placement_group=use_current_placement_group,
+        passed = dict(use_current_placement_group=use_current_placement_group,
                       min_workers=min_workers, max_workers=max_workers,
                       reset_limit=reset_limit,
                       elastic_timeout=elastic_timeout,
@@ -79,6 +82,7 @@ class RayExecutor:
         self._env = env
         self._local: Optional[Executor] = None
         self._ray_workers: List[Any] = []
+        self._ray_kv = None
         self._use_ray = False  # decided at start() — ray.init may be late
 
     @staticmethod
@@ -102,35 +106,93 @@ class RayExecutor:
             self._start_ray(executable_cls, executable_args,
                             executable_kwargs or {})
         else:
+            # Resource knobs feed Ray actor options; on the local pool
+            # they do nothing — record any non-default ask so the caller
+            # can see their request was dropped.
+            for k, v, d in (("cpus_per_worker", self._cpus_per_worker, 1),
+                            ("use_gpu", self._use_gpu, False),
+                            ("gpus_per_worker", self._gpus_per_worker,
+                             None)):
+                if v != d:
+                    self.ignored_options[k] = v
             self._local = Executor(self.num_workers, env=self._env,
                                    start_timeout=self.settings.start_timeout)
             self._local.start()
 
-    def _start_ray(self, cls, args, kwargs) -> None:  # pragma: no cover
-        # Ray path: one actor per worker running the same worker loop
-        # contract; exercised only where Ray is installed.
+    def _start_ray(self, cls, args, kwargs) -> None:
+        """Ray path (ref: ray/runner.py RayExecutor.start): one actor
+        per worker.  Two-phase like the reference — create actors, learn
+        where Ray placed them (node IPs), then push the full HVDT_* env
+        contract (local/cross ranks from co-location + the driver's
+        rendezvous KV) before constructing the user payload, so
+        ``hvd.init()`` inside actors rendezvouses exactly like
+        CLI-launched workers."""
+        import socket
+
         import ray
+
+        from ..runner.hosts import rank_env_from_hosts
+        from ..runner.http_kv import RendezvousServer, new_secret
 
         @ray.remote
         class _Worker:
-            def __init__(self, rank, size):
+            def __init__(self):
+                self.payload = None
+
+            def node_ip(self):
+                import ray as _ray
+
+                return _ray.util.get_node_ip_address()
+
+            def setup(self, env, has_payload):
                 import os
 
-                os.environ.update({"HVDT_RANK": str(rank),
-                                   "HVDT_SIZE": str(size)})
-                self.payload = cls(*args, **kwargs) if cls else None
+                os.environ.update(env)
+                if has_payload:
+                    self.payload = cls(*args, **kwargs)
+                return True
 
             def execute(self, fn, *a, **kw):
                 if self.payload is not None:
                     return fn(self.payload, *a, **kw)
                 return fn(*a, **kw)
 
-        self._ray_workers = [_Worker.remote(r, self.num_workers)
-                             for r in range(self.num_workers)]
+        opts: Dict[str, Any] = {"num_cpus": self._cpus_per_worker}
+        if self._use_gpu:
+            opts["num_gpus"] = self._gpus_per_worker or 1
+        worker_cls = _Worker.options(**opts)
+        self._ray_workers = [worker_cls.remote()
+                             for _ in range(self.num_workers)]
+        ips = ray.get([w.node_ip.remote() for w in self._ray_workers])
+
+        self._ray_kv = RendezvousServer(secret=new_secret())
+        port = self._ray_kv.start()
+        self._ray_kv.put_local("/cluster/size",
+                               str(self.num_workers).encode())
+        # The driver's externally-routable IP, from Ray itself —
+        # gethostbyname(gethostname()) commonly yields 127.0.1.1 on
+        # Debian-style /etc/hosts, unreachable from other nodes.
+        try:
+            addr = ray.util.get_node_ip_address()
+        except Exception:
+            try:
+                addr = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                addr = "127.0.0.1"
+        base = {
+            "HVDT_RENDEZVOUS_ADDR": addr,
+            "HVDT_RENDEZVOUS_PORT": str(port),
+            "HVDT_SECRET": self._ray_kv.secret.hex(),
+        }
+        ray.get([
+            w.setup.remote(
+                rank_env_from_hosts(r, ips, base, self._env),
+                cls is not None)
+            for r, w in enumerate(self._ray_workers)])
 
     def run(self, fn: Callable, args: Sequence = (),
             kwargs: Optional[Dict] = None) -> List[Any]:
-        if self._use_ray:  # pragma: no cover
+        if self._use_ray:
             import ray
 
             return ray.get([w.execute.remote(fn, *(args or ()),
@@ -145,7 +207,7 @@ class RayExecutor:
                    kwargs: Optional[Dict] = None):
         """Async dispatch returning a waitable (ref returns ObjectRefs);
         locally a thunk that materializes on call."""
-        if self._use_ray:  # pragma: no cover
+        if self._use_ray:
             return [w.execute.remote(fn, *(args or ()), **(kwargs or {}))
                     for w in self._ray_workers]
         import functools
@@ -154,8 +216,11 @@ class RayExecutor:
                                  kwargs=kwargs)
 
     def shutdown(self) -> None:
-        if self._use_ray:  # pragma: no cover
+        if self._use_ray:
             self._ray_workers = []
+            if self._ray_kv is not None:
+                self._ray_kv.stop()
+                self._ray_kv = None
             return
         if self._local is not None:
             self._local.shutdown()

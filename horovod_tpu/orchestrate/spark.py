@@ -35,32 +35,13 @@ __all__ = ["run"]
 
 def _task_env(rank: int, addresses: List[str], base: Dict[str, str],
               extra: Optional[Dict[str, str]]) -> Dict[str, str]:
-    """Per-rank HVDT_* contract from barrier task addresses.
+    """Per-rank HVDT_* contract from barrier task ``host:port``
+    addresses (shared layout rule: runner/hosts.py
+    rank_env_from_hosts)."""
+    from ..runner.hosts import rank_env_from_hosts
 
-    ``addresses[i]`` is task i's ``host:port``; tasks sharing a host get
-    consecutive local ranks, hosts are cross-ranked in first-appearance
-    order (same layout rule as runner/hosts.py get_host_assignments)."""
-    hosts = [a.rsplit(":", 1)[0] for a in addresses]
-    my_host = hosts[rank]
-    local_rank = sum(1 for h in hosts[:rank] if h == my_host)
-    local_size = hosts.count(my_host)
-    host_order: List[str] = []
-    for h in hosts:
-        if h not in host_order:
-            host_order.append(h)
-    env = dict(base)
-    env.update({
-        "HVDT_RANK": str(rank),
-        "HVDT_SIZE": str(len(addresses)),
-        "HVDT_LOCAL_RANK": str(local_rank),
-        "HVDT_LOCAL_SIZE": str(local_size),
-        "HVDT_CROSS_RANK": str(host_order.index(my_host)),
-        "HVDT_CROSS_SIZE": str(len(host_order)),
-        "HVDT_HOSTNAME": my_host,
-    })
-    if extra:
-        env.update(extra)
-    return env
+    return rank_env_from_hosts(rank, [a.rsplit(":", 1)[0]
+                                      for a in addresses], base, extra)
 
 
 def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,

@@ -14,7 +14,7 @@ import re
 from typing import Dict, List, Sequence
 
 __all__ = ["HostInfo", "SlotInfo", "parse_hosts", "parse_host_files",
-           "get_host_assignments"]
+           "get_host_assignments", "rank_env_from_hosts"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,3 +109,35 @@ def get_host_assignments(hosts: Sequence[HostInfo], min_np: int,
     return [dataclasses.replace(a, local_size=local_sizes[a.hostname],
                                 cross_size=cross_size)
             for a in assignments]
+
+
+def rank_env_from_hosts(rank: int, hosts: Sequence[str],
+                        base: "dict | None" = None,
+                        extra: "dict | None" = None) -> dict:
+    """Per-rank HVDT_* env contract from an already-placed host list.
+
+    ``hosts[i]`` is rank i's hostname/IP (as reported by the
+    orchestrator — Spark barrier task addresses, Ray actor node IPs).
+    Ranks sharing a host get consecutive local ranks; hosts are
+    cross-ranked in first-appearance order — the same layout rule as
+    ``get_host_assignments`` (ref: runner/common/util/hosts.py), applied
+    post hoc to an externally scheduled set."""
+    my_host = hosts[rank]
+    host_order: list = []
+    for h in hosts:
+        if h not in host_order:
+            host_order.append(h)
+    env = dict(base or {})
+    env.update({
+        "HVDT_RANK": str(rank),
+        "HVDT_SIZE": str(len(hosts)),
+        "HVDT_LOCAL_RANK": str(sum(1 for h in hosts[:rank]
+                                   if h == my_host)),
+        "HVDT_LOCAL_SIZE": str(hosts.count(my_host)),
+        "HVDT_CROSS_RANK": str(host_order.index(my_host)),
+        "HVDT_CROSS_SIZE": str(len(host_order)),
+        "HVDT_HOSTNAME": my_host,
+    })
+    if extra:
+        env.update(extra)
+    return env

@@ -1,0 +1,164 @@
+"""RayExecutor Ray-branch tests (ref analogs: test/single/test_ray.py).
+
+Ray is not in this image; the branch runs against a stub implementing
+the exact surface the adapter touches (remote actor classes with
+options/resources, ray.get, util.get_node_ip_address).  Actors execute
+synchronously in process — actor placement env, resource options, the
+rendezvous contract, and payload dispatch are what's under test.
+"""
+
+import sys
+import types
+
+import pytest
+
+
+class _Ref:
+    def __init__(self, value):
+        self.value = value
+
+
+class _ActorHandle:
+    def __init__(self, cls, args, kwargs, stub):
+        self._instance = cls(*args, **kwargs)
+        self._stub = stub
+
+    def __getattr__(self, name):
+        method = getattr(self._instance, name)
+        stub = self._stub
+
+        class _Caller:
+            @staticmethod
+            def remote(*a, **kw):
+                stub.calls.append((name, a, kw))
+                return _Ref(method(*a, **kw))
+
+        return _Caller()
+
+
+class _RemoteClass:
+    def __init__(self, cls, stub, options=None):
+        self._cls, self._stub = cls, stub
+        self.options_used = options or {}
+
+    def options(self, **kw):
+        rc = _RemoteClass(self._cls, self._stub, kw)
+        self._stub.actor_options.append(kw)
+        return rc
+
+    def remote(self, *a, **kw):
+        h = _ActorHandle(self._cls, a, kw, self._stub)
+        self._stub.actors.append(h)
+        return h
+
+
+@pytest.fixture(autouse=True)
+def _env_guard():
+    """Stub actors run setup() in THIS process: restore os.environ so no
+    stale HVDT_* contract (dead rendezvous, wrong rank) leaks into later
+    tests."""
+    import os
+
+    before = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(before)
+
+
+@pytest.fixture()
+def ray_stub(monkeypatch):
+    stub = types.ModuleType("ray")
+    stub.actors = []
+    stub.actor_options = []
+    stub.calls = []
+    stub.node_ips = ["10.0.0.1"]
+    stub._ip_iter = None
+    stub.is_initialized = lambda: True
+    stub.remote = lambda cls: _RemoteClass(cls, stub)
+
+    def _get(refs):
+        if isinstance(refs, list):
+            return [r.value for r in refs]
+        return refs.value
+
+    stub.get = _get
+
+    def _next_ip():
+        if stub._ip_iter is None:
+            ips = iter(stub.node_ips * 64)
+            stub._ip_iter = ips
+        return next(stub._ip_iter)
+
+    stub.util = types.SimpleNamespace(get_node_ip_address=_next_ip)
+    monkeypatch.setitem(sys.modules, "ray", stub)
+    yield stub
+
+
+def _setup_envs(stub):
+    return [a[0] for name, a, kw in stub.calls if name == "setup"]
+
+
+class TestRayBranch:
+    def test_contract_and_layout(self, ray_stub):
+        from horovod_tpu.orchestrate import RayExecutor
+
+        ray_stub.node_ips = ["10.0.0.1", "10.0.0.1", "10.0.0.2",
+                             "10.0.0.2"]
+        ex = RayExecutor(num_workers=4, cpus_per_worker=2)
+        ex.start()
+        try:
+            assert ex._use_ray
+            assert ray_stub.actor_options == [{"num_cpus": 2}]
+            envs = _setup_envs(ray_stub)
+            assert [e["HVDT_RANK"] for e in envs] == ["0", "1", "2", "3"]
+            assert [e["HVDT_LOCAL_RANK"] for e in envs] == \
+                ["0", "1", "0", "1"]
+            assert [e["HVDT_CROSS_RANK"] for e in envs] == \
+                ["0", "0", "1", "1"]
+            assert all(e["HVDT_SIZE"] == "4" for e in envs)
+            assert all(e["HVDT_RENDEZVOUS_PORT"] for e in envs)
+            assert all(e["HVDT_SECRET"] for e in envs)
+        finally:
+            ex.shutdown()
+        assert ex._ray_kv is None
+
+    def test_run_dispatches_through_actors(self, ray_stub, monkeypatch):
+        from horovod_tpu.orchestrate import RayExecutor
+
+        ex = RayExecutor(num_workers=2)
+        ex.start()
+        try:
+            res = ex.run(lambda x=5: x * 2)
+            assert res == [10, 10]
+            refs = ex.run_remote(lambda: "ok")
+            import ray
+
+            assert ray.get(refs) == ["ok", "ok"]
+        finally:
+            ex.shutdown()
+
+    def test_payload_class(self, ray_stub, monkeypatch):
+        from horovod_tpu.orchestrate import RayExecutor
+
+        class Trainer:
+            def __init__(self, base):
+                self.base = base
+
+        ex = RayExecutor(num_workers=2)
+        ex.start(executable_cls=Trainer, executable_args=(3,))
+        try:
+            res = ex.run(lambda t, y: t.base + y, args=(4,))
+            assert res == [7, 7]
+        finally:
+            ex.shutdown()
+
+    def test_gpu_options(self, ray_stub):
+        from horovod_tpu.orchestrate import RayExecutor
+
+        ex = RayExecutor(num_workers=1, use_gpu=True, gpus_per_worker=2)
+        ex.start()
+        try:
+            assert ray_stub.actor_options == [{"num_cpus": 1,
+                                               "num_gpus": 2}]
+        finally:
+            ex.shutdown()

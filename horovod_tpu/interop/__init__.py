@@ -19,3 +19,32 @@ def __getattr__(name):
     if name in ("tf", "torch", "mxnet"):
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+#: Core names every reference framework module re-exports (ref: e.g.
+#: horovod/torch/__init__.py imports init/rank/size/... from mpi_ops) —
+#: the interop bindings resolve them from the top-level package so
+#: ``import horovod_tpu.interop.torch as hvd`` is drop-in for
+#: ``import horovod.torch as hvd``.
+CORE_NAMES = (
+    "init", "shutdown", "is_initialized",
+    "rank", "size", "local_rank", "local_size", "cross_rank",
+    "cross_size", "is_homogeneous",
+    "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp",
+    "ProcessSet", "global_process_set", "add_process_set",
+    "remove_process_set", "process_set_by_id",
+    "mpi_built", "mpi_enabled", "mpi_threads_supported",
+    "gloo_built", "gloo_enabled", "nccl_built", "ddl_built", "ccl_built",
+    "cuda_built", "rocm_built", "xla_built", "tpu_available",
+    "start_timeline", "stop_timeline",
+    "HorovodInternalError", "HostsUpdatedInterrupt",
+)
+
+
+def core_attr(name):
+    """Resolve a core-API name against the top-level package, or None."""
+    if name in CORE_NAMES:
+        import horovod_tpu
+
+        return getattr(horovod_tpu, name)
+    return None

@@ -47,6 +47,11 @@ def __getattr__(name):
         from .. import functions
 
         return getattr(functions, name)
+    from . import core_attr
+
+    found = core_attr(name)
+    if found is not None:
+        return found
     raise AttributeError(name)
 
 

@@ -545,3 +545,15 @@ class LearningRateWarmupCallback:
         cb.on_train_begin = on_train_begin
         cb.on_epoch_end = on_epoch_end
         return cb
+
+
+def __getattr__(name):
+    """Core-API names (init/rank/size/..., ref: tensorflow/__init__.py
+    re-exports) resolve from the top-level package so this module is
+    drop-in for ``import horovod.tensorflow as hvd``."""
+    from . import core_attr
+
+    found = core_attr(name)
+    if found is not None:
+        return found
+    raise AttributeError(name)

@@ -22,8 +22,10 @@ __all__ = ["RayExecutor", "create_settings", "Settings"]
 
 @dataclasses.dataclass
 class Settings:
-    """Launch settings (ref: RayExecutor.create_settings — ssh/timeouts
-    collapse away; the KV secret and timeouts remain meaningful)."""
+    """Launch settings (ref: RayExecutor.create_settings — ssh knobs
+    collapse away).  ``placement_group_timeout_s`` bounds actor
+    scheduling; ``start_timeout`` bounds worker env setup / payload
+    construction (both backends) ."""
 
     start_timeout: float = 60.0
     nics: Optional[Sequence[str]] = None
@@ -54,7 +56,8 @@ class RayExecutor:
                  reset_limit: Optional[int] = None,
                  elastic_timeout: int = 600,
                  override_discovery: bool = True,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 coordinator_port: int = 29500):
         if num_workers is None:
             if num_hosts and num_workers_per_host:
                 num_workers = num_hosts * num_workers_per_host
@@ -80,6 +83,7 @@ class RayExecutor:
         self.ignored_options = {k: v for k, v in passed.items()
                                 if v != defaults[k]}
         self._env = env
+        self._coordinator_port = coordinator_port
         self._local: Optional[Executor] = None
         self._ray_workers: List[Any] = []
         self._ray_kv = None
@@ -163,32 +167,55 @@ class RayExecutor:
         worker_cls = _Worker.options(**opts)
         self._ray_workers = [worker_cls.remote()
                              for _ in range(self.num_workers)]
-        ips = ray.get([w.node_ip.remote() for w in self._ray_workers])
+        # Bounded wait: an unschedulable actor set (cluster too small)
+        # must fail loudly, not hang — the reference bounds this with its
+        # placement-group timeout.
+        try:
+            ips = ray.get([w.node_ip.remote() for w in self._ray_workers],
+                          timeout=self.settings.placement_group_timeout_s)
+        except Exception as e:
+            self._ray_workers = []
+            raise RuntimeError(
+                f"Ray could not schedule {self.num_workers} actors within "
+                f"{self.settings.placement_group_timeout_s}s — does the "
+                "cluster have the requested resources?") from e
 
         self._ray_kv = RendezvousServer(secret=new_secret())
-        port = self._ray_kv.start()
-        self._ray_kv.put_local("/cluster/size",
-                               str(self.num_workers).encode())
-        # The driver's externally-routable IP, from Ray itself —
-        # gethostbyname(gethostname()) commonly yields 127.0.1.1 on
-        # Debian-style /etc/hosts, unreachable from other nodes.
         try:
-            addr = ray.util.get_node_ip_address()
-        except Exception:
+            port = self._ray_kv.start()
+            self._ray_kv.put_local("/cluster/size",
+                                   str(self.num_workers).encode())
+            # The driver's externally-routable IP, from Ray itself —
+            # gethostbyname(gethostname()) commonly yields 127.0.1.1 on
+            # Debian-style /etc/hosts, unreachable from other nodes.
             try:
-                addr = socket.gethostbyname(socket.gethostname())
-            except OSError:
-                addr = "127.0.0.1"
-        base = {
-            "HVDT_RENDEZVOUS_ADDR": addr,
-            "HVDT_RENDEZVOUS_PORT": str(port),
-            "HVDT_SECRET": self._ray_kv.secret.hex(),
-        }
-        ray.get([
-            w.setup.remote(
-                rank_env_from_hosts(r, ips, base, self._env),
-                cls is not None)
-            for r, w in enumerate(self._ray_workers)])
+                addr = ray.util.get_node_ip_address()
+            except Exception:
+                try:
+                    addr = socket.gethostbyname(socket.gethostname())
+                except OSError:
+                    addr = "127.0.0.1"
+            base = {
+                "HVDT_RENDEZVOUS_ADDR": addr,
+                "HVDT_RENDEZVOUS_PORT": str(port),
+                "HVDT_SECRET": self._ray_kv.secret.hex(),
+                # JAX coordination service: rank 0's node at the
+                # configured port (ref contract: runner/launch.py:216).
+                "HVDT_COORDINATOR_ADDR":
+                    f"{ips[0]}:{self._coordinator_port}",
+            }
+            ray.get([
+                w.setup.remote(
+                    rank_env_from_hosts(r, ips, base, self._env),
+                    cls is not None)
+                for r, w in enumerate(self._ray_workers)],
+                timeout=self.settings.start_timeout)
+        except BaseException:
+            # Failed start must not leak the KV server thread/port.
+            self._ray_kv.stop()
+            self._ray_kv = None
+            self._ray_workers = []
+            raise
 
     def run(self, fn: Callable, args: Sequence = (),
             kwargs: Optional[Dict] = None) -> List[Any]:

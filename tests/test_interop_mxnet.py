@@ -239,3 +239,18 @@ class TestMxnetTrainer:
         for p in tr._params:
             np.testing.assert_allclose(p.list_grad()[0].asnumpy(),
                                        np.ones(p.data().shape))
+
+
+def test_core_names_resolve_on_bindings(hvd, mx_stub):
+    """Drop-in parity: every reference framework module re-exports the
+    core API (init/rank/size/predicates); the interop bindings must too."""
+    from horovod_tpu.interop import CORE_NAMES
+    from horovod_tpu.interop import mxnet as hmx
+    from horovod_tpu.interop import tf as htf
+    from horovod_tpu.interop import torch as htorch
+
+    for mod in (hmx, htf, htorch):
+        for nm in CORE_NAMES:
+            assert getattr(mod, nm) is not None, (mod.__name__, nm)
+    assert htorch.rank() == 0 and htf.size() >= 1
+    assert hmx.mpi_built() is False and htf.xla_built() is True

@@ -76,7 +76,7 @@ def ray_stub(monkeypatch):
     stub.is_initialized = lambda: True
     stub.remote = lambda cls: _RemoteClass(cls, stub)
 
-    def _get(refs):
+    def _get(refs, timeout=None):
         if isinstance(refs, list):
             return [r.value for r in refs]
         return refs.value
@@ -118,6 +118,10 @@ class TestRayBranch:
             assert all(e["HVDT_SIZE"] == "4" for e in envs)
             assert all(e["HVDT_RENDEZVOUS_PORT"] for e in envs)
             assert all(e["HVDT_SECRET"] for e in envs)
+            # JAX coordination service at rank 0's node: without this,
+            # hvd.init() in actors would come up as size-1 islands.
+            assert all(e["HVDT_COORDINATOR_ADDR"] == "10.0.0.1:29500"
+                       for e in envs)
         finally:
             ex.shutdown()
         assert ex._ray_kv is None
@@ -162,3 +166,16 @@ class TestRayBranch:
                                                "num_gpus": 2}]
         finally:
             ex.shutdown()
+
+    def test_failed_payload_does_not_leak_kv(self, ray_stub):
+        from horovod_tpu.orchestrate import RayExecutor
+
+        class Boom:
+            def __init__(self):
+                raise RuntimeError("payload exploded")
+
+        ex = RayExecutor(num_workers=1)
+        with pytest.raises(RuntimeError, match="payload exploded"):
+            ex.start(executable_cls=Boom)
+        assert ex._ray_kv is None
+        assert ex._ray_workers == []

@@ -250,11 +250,54 @@ def shutdown() -> None:
         if not _state.initialized:
             stop_timeline()  # a timeline may exist without init
             return
+        multi = _state.topology is not None and _state.topology.size > 1
         if _state.eager_controller is not None:
             _state.eager_controller.shutdown()
         _state.reset()
     tcp_backend.shutdown_groups()
     stop_timeline()
+    if multi:
+        _sync_distributed_teardown()
+
+
+def _sync_distributed_teardown() -> None:
+    """Barrier the processes before the coordination service dies.
+
+    Rank 0's process hosts the JAX coordination service; if it exits
+    while a slower rank's client still holds connections/heartbeats, the
+    orphaned client's C++ threads abort the process ("terminate called
+    after throwing an instance of ...", observed on a loaded 1-core box
+    where rank skew at exit is seconds).  A bounded coordination-service
+    barrier lines everyone up, then ``jax.distributed.shutdown``
+    disconnects clients cleanly before interpreter exit.  Best-effort:
+    a crashed peer must not turn OUR exit into a hang."""
+    import jax
+
+    try:
+        from jax._src import distributed as _jd
+
+        client = getattr(_jd.global_state, "client", None)
+        if client is None:
+            return
+        client.wait_at_barrier("hvdt_shutdown", 10_000)  # ms
+    except Exception as e:  # pragma: no cover - peer-crash path
+        log.debug("shutdown barrier skipped: %s", e)
+        return
+    try:
+        # Tear the local PJRT client (and its cross-process collective
+        # threads) down NOW, while every peer is provably alive and idle
+        # (post-barrier) — leaving it to interpreter finalization lets a
+        # faster peer's exit reset sockets under blocked collective
+        # threads, which aborts the process from a C++ destructor.
+        import jax.extend as jex
+
+        jex.backend.clear_backends()
+    except Exception as e:  # pragma: no cover
+        log.debug("clear_backends failed: %s", e)
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover
+        log.debug("jax.distributed.shutdown failed: %s", e)
 
 
 atexit.register(shutdown)

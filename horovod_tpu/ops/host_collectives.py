@@ -205,23 +205,29 @@ def host_allreduce(value: np.ndarray, process_set, op: ReduceOp) -> np.ndarray:
 def host_broadcast(value: Optional[np.ndarray], root_rank: int, process_set,
                    shape: Tuple[int, ...], dtype) -> np.ndarray:
     """Broadcast from set-relative ``root_rank``.  Non-root processes pass
-    value=None and receive the root's tensor."""
+    value=None and receive the root's tensor.
+
+    Scalars: ``np.ascontiguousarray`` promotes 0-d arrays to shape
+    ``(1,)``, so the global array is laid out from the CONTRIBUTION's
+    shape (identical promotion on every rank) and the negotiated
+    ``shape`` is restored on return — building it from ``shape`` directly
+    desynchronizes the per-device buffers from the declared aval for 0-d
+    tensors (e.g. a Keras optimizer's iteration counter)."""
     from . import tcp_backend
 
-    if tcp_backend.enabled():
-        is_root = process_set.rank() == root_rank
-        contrib = (np.ascontiguousarray(value) if is_root
-                   else np.zeros(shape, dtype))
-        return tcp_backend.tcp_broadcast(contrib, process_set, root_rank)
-    mesh = _flat_mesh(process_set.mesh)
     is_root = process_set.rank() == root_rank
-    contrib = (np.ascontiguousarray(value) if is_root
-               else np.zeros(shape, dtype))
+    contrib = np.ascontiguousarray(value if is_root
+                                   else np.zeros(shape, dtype))
+    if tcp_backend.enabled():
+        out = tcp_backend.tcp_broadcast(contrib, process_set, root_rank)
+        return np.asarray(out).astype(dtype, copy=False).reshape(shape)
+    mesh = _flat_mesh(process_set.mesh)
     contrib = _canonical_for_device(contrib)
     rows = _contribution_rows(mesh, contrib, 0.0)
-    g = _make_global(mesh, rows, tuple(shape))
+    g = _make_global(mesh, rows, contrib.shape)
     out = _reduce_fn(mesh, ReduceOp.SUM, process_set.size())(g)
-    return np.asarray(out.addressable_data(0)).astype(dtype)
+    return np.asarray(
+        out.addressable_data(0)).astype(dtype).reshape(shape)
 
 
 def host_allgather(value: np.ndarray, process_set,

@@ -171,14 +171,23 @@ class RayExecutor:
         # must fail loudly, not hang — the reference bounds this with its
         # placement-group timeout.
         try:
+            timeout_error = ray.exceptions.GetTimeoutError
+        except AttributeError:  # pragma: no cover - very old ray
+            timeout_error = TimeoutError
+        try:
             ips = ray.get([w.node_ip.remote() for w in self._ray_workers],
                           timeout=self.settings.placement_group_timeout_s)
-        except Exception as e:
+        except timeout_error as e:
             self._ray_workers = []
             raise RuntimeError(
                 f"Ray could not schedule {self.num_workers} actors within "
                 f"{self.settings.placement_group_timeout_s}s — does the "
                 "cluster have the requested resources?") from e
+        except Exception:
+            # Non-scheduling failure (actor died during creation, import
+            # error in the worker env, ...) — let the real error through.
+            self._ray_workers = []
+            raise
 
         self._ray_kv = RendezvousServer(secret=new_secret())
         try:

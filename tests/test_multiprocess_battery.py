@@ -253,3 +253,55 @@ def test_two_process_int64_minmax():
         # raised synchronously at the call site (enqueue-time check) so
         # peers are never stranded mid-collective
         assert out["overflow"] == "ValueError"
+
+
+def _worker_scalar_broadcast():
+    """0-d tensors through broadcast/allreduce (regression: the host
+    broadcast path desynced its per-device buffers from the negotiated
+    () shape because np.ascontiguousarray promotes 0-d to (1,) — hit by
+    Keras optimizer iteration counters in BroadcastGlobalVariables)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    out = {"rank": r}
+    # scalar int32 broadcast (root's value wins), mixed with array leaves
+    tree = {"it": np.int32(100 + r), "w": np.full((2, 2), float(r))}
+    synced = hvd.broadcast_parameters(tree, root_rank=0)
+    out["it"] = int(synced["it"])
+    out["it_shape"] = list(np.shape(synced["it"]))
+    out["w0"] = float(np.asarray(synced["w"]).ravel()[0])
+    # scalar float allreduce
+    out["m"] = float(np.asarray(hvd.allreduce(np.float32(r + 1.0),
+                                              name="sc_m")))
+    # repeat with a NEW shape under the SAME names (cache invalidation)
+    tree2 = {"it": np.full((3,), r, np.float32), "w": np.float32(r)}
+    synced2 = hvd.broadcast_parameters(tree2, root_rank=1)
+    out["it2"] = np.asarray(synced2["it"]).tolist()
+    out["w2"] = float(synced2["w"])
+    hvd.shutdown()
+    return out
+
+
+@pytest.mark.integration
+def test_two_process_scalar_broadcast():
+    from conftest import pickle_by_value
+
+    import horovod_tpu.runner as runner
+
+    results = runner.run(pickle_by_value(_worker_scalar_broadcast), np=2)
+    for out in results:
+        assert out["it"] == 100, out
+        assert out["it_shape"] == [], out
+        assert out["w0"] == 0.0, out
+        assert abs(out["m"] - 1.5) < 1e-6, out
+        assert out["it2"] == [1.0, 1.0, 1.0], out
+        assert out["w2"] == 1.0, out

@@ -396,3 +396,27 @@ def test_ported_torch_mnist_under_cli(tmp_path):
         timeout=300)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     assert "Test set: Average loss" in out.stdout
+
+
+@pytest.mark.integration
+def test_ported_tf_keras_mnist_under_cli(tmp_path):
+    """The TF/Keras porting proof runs under the real CLI with 2 workers:
+    DistributedOptimizer in model.fit, BroadcastGlobalVariables (incl.
+    the optimizer's SCALAR iteration counter — regression for the 0-d
+    host-broadcast shard bug), MetricAverage, LR warmup."""
+    import os
+    import subprocess
+
+    pytest.importorskip("tensorflow")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", "")})
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch",
+         "-np", "2", "--coordinator-port", "29768",
+         "--", sys.executable,
+         os.path.join(repo, "examples", "tf_keras_mnist_ported.py"),
+         "--epochs", "1", "--steps-per-epoch", "4", "--samples", "256"],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=420)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]

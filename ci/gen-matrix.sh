@@ -1,0 +1,9 @@
+#!/bin/sh
+# Emit the docker-compose test matrix as one runnable command per
+# service (ref: .buildkite/gen-pipeline.sh — the reference generates its
+# Buildkite pipeline the same way).  Usage: ci/gen-matrix.sh | sh -x
+set -eu
+compose=${1:-docker-compose.test.yml}
+for svc in $(sed -n 's/^  \([a-z0-9-]*\):$/\1/p' "$compose"); do
+  echo "docker compose -f $compose run --rm $svc"
+done

@@ -25,7 +25,9 @@ from ..common.types import ReduceOp
 __all__ = ["allreduce", "allgather", "broadcast", "broadcast_variables",
            "DistributedGradientTape", "DistributedOptimizer", "load_model",
            "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
-           "LearningRateScheduleCallback", "LearningRateWarmupCallback"]
+           "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+           "KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
+           "UpdateEpochStateCallback"]
 
 
 def _to_np(t) -> np.ndarray:
@@ -557,3 +559,149 @@ def __getattr__(name):
     if found is not None:
         return found
     raise AttributeError(name)
+
+
+class KerasState:
+    """Elastic state of a keras model + optimizer (ref:
+    tensorflow/keras/elastic.py KerasState / tensorflow/elastic.py
+    TensorFlowKerasState): weights snapshot to host memory on commit,
+    restore on rollback, rank-0 broadcast on (re-)sync; extra kwargs ride
+    the generic ObjectState payload."""
+
+    def __new__(cls, model, optimizer=None, **kwargs):
+        import numpy as _np
+
+        from ..elastic import ObjectState
+
+        opt = optimizer if optimizer is not None else \
+            getattr(model, "optimizer", None)
+
+        class _Impl(ObjectState):
+            def __init__(self):
+                object.__setattr__(self, "model", model)
+                object.__setattr__(self, "optimizer", opt)
+                object.__setattr__(self, "_saved_weights", None)
+                super().__init__(**kwargs)
+
+            def _payload_keys(self):
+                return [k for k in super()._payload_keys()
+                        if k not in ("model", "optimizer")]
+
+            def _variables(self):
+                vs = list(self.model.variables)
+                if self.optimizer is not None:
+                    ov = getattr(self.optimizer, "variables", None)
+                    if callable(ov):
+                        ov = ov()
+                    vs += list(ov or [])
+                return vs
+
+            def save(self):
+                object.__setattr__(
+                    self, "_saved_weights",
+                    [_np.array(v) for v in self._variables()])
+                super().save()
+
+            def restore(self):
+                if self._saved_weights is not None:
+                    for v, w in zip(self._variables(),
+                                    self._saved_weights):
+                        v.assign(w)
+                super().restore()
+
+            def sync(self):
+                broadcast_variables(self._variables(), root_rank=0)
+                super().sync()
+
+        return _Impl()
+
+
+class CommitStateCallback:
+    """Commit ``state`` every ``batches_per_commit`` batches and at epoch
+    end (ref: _keras/elastic.py CommitStateCallbackImpl)."""
+
+    def __new__(cls, state, batches_per_commit: int = 1):
+        Base = _keras_callback_base()
+
+        class _Impl(Base):
+            def __init__(self):
+                super().__init__()
+                self.batches_remaining = batches_per_commit
+
+            def on_train_begin(self, logs=None):
+                self.batches_remaining = batches_per_commit
+
+            def on_train_batch_end(self, batch, logs=None):
+                self.batches_remaining -= 1
+                if self.batches_remaining == 0:
+                    state.commit()
+                    self.batches_remaining = batches_per_commit
+
+            def on_epoch_end(self, epoch, logs=None):
+                state.commit()
+
+        return _Impl()
+
+
+class UpdateBatchStateCallback:
+    """Track ``state.batch`` across batches so a restart knows where the
+    epoch stood (ref: _keras/elastic.py UpdateBatchStateCallbackImpl).
+
+    The reference shortened the restarted epoch by mutating
+    ``params['steps']`` in ``on_epoch_begin``; Keras 3 builds the epoch
+    iterator before callbacks fire and treats ``params`` as metadata, so
+    that mechanism is dead (verified: all steps still run).  Under
+    Keras 3 the RESUME side lives with the caller: on restart pass
+    ``steps_per_epoch=total - state.batch`` and skip the consumed data
+    (``dataset.skip(state.batch)`` / the ElasticSampler), then this
+    callback's tracking keeps ``state.batch`` true for the next commit.
+    The legacy params mutation is still applied for tf.keras 2.x, where
+    ``params`` was live."""
+
+    def __new__(cls, state):
+        Base = _keras_callback_base()
+
+        class _Impl(Base):
+            def __init__(self):
+                super().__init__()
+                self.steps_per_epoch = None
+
+            def on_train_begin(self, logs=None):
+                self.steps_per_epoch = None
+
+            def on_epoch_begin(self, epoch, logs=None):
+                if self.params.get("steps"):
+                    if self.steps_per_epoch is None:
+                        self.steps_per_epoch = self.params.get("steps")
+                    # effective only on legacy tf.keras (see docstring)
+                    self.params["steps"] = self.steps_per_epoch - \
+                        state.batch
+
+            def on_train_batch_end(self, batch, logs=None):
+                state.batch = batch
+
+            def on_epoch_end(self, epoch, logs=None):
+                state.batch = 0
+
+        return _Impl()
+
+
+class UpdateEpochStateCallback:
+    """Track the GLOBAL epoch number across resets in ``state.epoch``
+    (ref: _keras/elastic.py UpdateEpochStateCallbackImpl)."""
+
+    def __new__(cls, state):
+        Base = _keras_callback_base()
+
+        class _Impl(Base):
+            def __init__(self):
+                super().__init__()
+                self.initial_epoch = state.epoch
+
+            def on_train_begin(self, logs=None):
+                self.initial_epoch = state.epoch
+
+            def on_epoch_end(self, epoch, logs=None):
+                state.epoch = self.initial_epoch + epoch + 1
+
+        return _Impl()

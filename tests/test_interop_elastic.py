@@ -1,0 +1,174 @@
+"""Framework-specific elastic state (ref analogs: torch/elastic/state.py
+TorchState tests; keras elastic callbacks, _keras/elastic.py)."""
+
+import numpy as np
+import pytest
+
+
+class TestTorchState:
+    def _bits(self):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.data.sampler import ElasticSampler
+
+        model = torch.nn.Linear(3, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        sampler = ElasticSampler(20, shuffle=False)
+        return torch, model, opt, sampler
+
+    def test_commit_restore_roundtrip(self, hvd):
+        torch, model, opt, sampler = self._bits()
+        from horovod_tpu.interop.torch_elastic import TorchState
+
+        state = TorchState(model=model, optimizer=opt, sampler=sampler,
+                           batch=0, epoch=0)
+        w0 = {k: v.clone() for k, v in model.state_dict().items()}
+        state.commit()
+
+        # mutate everything, then roll back
+        with torch.no_grad():
+            for p in model.parameters():
+                p.add_(1.0)
+        loss = model(torch.ones(2, 3)).sum()
+        loss.backward()
+        opt.step()
+        sampler.record_batch(0, 4)
+        state.batch = 7
+        state.restore()
+
+        for k, v in model.state_dict().items():
+            assert torch.allclose(v, w0[k]), k
+        assert state.batch == 0
+        assert sampler.state_dict()["processed_num"] == 0
+
+    def test_handler_attribute_routing(self, hvd):
+        torch, model, opt, _ = self._bits()
+        from horovod_tpu.interop.torch_elastic import TorchState
+
+        state = TorchState(model=model, optimizer=opt)
+        new_model = torch.nn.Linear(3, 2)
+        state.model = new_model                    # routes to handler
+        assert state._handlers["model"].value is new_model
+        state.restore()                            # restores NEW model
+        assert state.model is new_model
+
+    def test_sync_broadcasts(self, hvd):
+        torch, model, opt, sampler = self._bits()
+        from horovod_tpu.interop.torch_elastic import TorchState
+
+        state = TorchState(model=model, optimizer=opt, sampler=sampler,
+                           step=3)
+        state.sync()                               # size-1: identity
+        assert state.step == 3
+
+    def test_registry_extensible(self, hvd):
+        torch, model, opt, _ = self._bits()
+        from horovod_tpu.interop import torch_elastic as te
+
+        class Custom:
+            pass
+
+        class CustomHandler(te.StateHandler):
+            def save(self):
+                pass
+
+            def restore(self):
+                pass
+
+            def sync(self):
+                pass
+
+        old = te.get_handler_registry()
+        try:
+            te.set_handler_registry(old + [(Custom, CustomHandler)])
+            state = te.TorchState(model=model, thing=Custom())
+            assert isinstance(state._handlers["thing"], CustomHandler)
+        finally:
+            te.set_handler_registry(old)
+
+    def test_submodule_surface(self, hvd):
+        pytest.importorskip("torch")
+        from horovod_tpu.interop import torch as ht
+
+        assert ht.elastic.TorchState is ht.TorchState
+        assert callable(ht.elastic.run)
+
+
+class TestKerasElastic:
+    def _model(self):
+        keras = pytest.importorskip("keras")
+        m = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(2)])
+        m.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                  loss="mse")
+        return keras, m
+
+    def test_state_commit_restore(self, hvd):
+        keras, m = self._model()
+        from horovod_tpu.interop import tf as htf
+
+        state = htf.KerasState(m, batch=0, epoch=0)
+        state.commit()
+        w0 = [np.array(v) for v in m.variables]
+        for v in m.variables:
+            v.assign(np.asarray(v) + 1.0)
+        state.batch = 5
+        state.restore()
+        for v, w in zip(m.variables, w0):
+            np.testing.assert_allclose(np.asarray(v), w)
+        assert state.batch == 0
+        state.sync()                               # size-1: identity
+
+    def test_commit_callback_cadence(self, hvd):
+        keras, m = self._model()
+        from horovod_tpu.interop import tf as htf
+
+        class _State:
+            commits = 0
+            batch = 0
+            epoch = 0
+
+            def commit(self):
+                _State.commits += 1
+
+        st = _State()
+        cbs = [htf.CommitStateCallback(st, batches_per_commit=2),
+               htf.UpdateBatchStateCallback(st),
+               htf.UpdateEpochStateCallback(st)]
+        xs = np.ones((8, 4), np.float32)
+        ys = np.zeros((8, 2), np.float32)
+        m.fit(xs, ys, epochs=2, batch_size=2, verbose=0, callbacks=cbs)
+        # 4 batches/epoch, commit every 2 batches (=2) + epoch end (=1)
+        assert _State.commits == 2 * 3
+        assert st.batch == 0                       # reset at epoch end
+        assert st.epoch == 2                       # global epoch count
+
+    def test_update_batch_tracks_and_resume_recipe(self, hvd):
+        """Keras 3 ignores the reference's params['steps'] mutation
+        (callback params are metadata), so the documented resume recipe
+        is caller-side: steps_per_epoch = total - state.batch.  The
+        callback's job here is accurate tracking."""
+        keras, m = self._model()
+        from horovod_tpu.interop import tf as htf
+
+        class _State:
+            batch = 3
+            epoch = 0
+
+            def commit(self):
+                pass
+
+        st = _State()
+        ran = []
+
+        class Count(keras.callbacks.Callback):
+            def on_train_batch_end(self, batch, logs=None):
+                ran.append(batch)
+
+        xs = np.ones((16, 4), np.float32)
+        ys = np.zeros((16, 2), np.float32)
+        # restart: 8-step epoch committed at batch 3 -> run remaining 5
+        m.fit(xs, ys, epochs=1, batch_size=2,
+              steps_per_epoch=8 - st.batch, verbose=0,
+              callbacks=[htf.UpdateBatchStateCallback(st), Count()])
+        assert len(ran) == 5
+        assert st.batch == 0                       # reset at epoch end

@@ -26,7 +26,8 @@ __all__ = ["allreduce", "allgather", "broadcast", "broadcast_variables",
            "DistributedGradientTape", "DistributedOptimizer", "load_model",
            "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
            "LearningRateScheduleCallback", "LearningRateWarmupCallback",
-           "KerasState", "CommitStateCallback", "UpdateBatchStateCallback",
+           "KerasState", "TensorFlowState", "CommitStateCallback",
+           "UpdateBatchStateCallback",
            "UpdateEpochStateCallback"]
 
 
@@ -703,5 +704,45 @@ class UpdateEpochStateCallback:
 
             def on_epoch_end(self, epoch, logs=None):
                 state.epoch = self.initial_epoch + epoch + 1
+
+        return _Impl()
+
+
+class TensorFlowState:
+    """Elastic state of a plain list of ``tf.Variable``s (ref:
+    tensorflow/elastic.py:156 TensorFlowState — the non-Keras TF
+    surface; TF2-eager only here, like the rest of this binding)."""
+
+    def __new__(cls, variables, **kwargs):
+        import numpy as _np
+
+        from ..elastic import ObjectState
+
+        variables = list(variables)
+
+        class _Impl(ObjectState):
+            def __init__(self):
+                object.__setattr__(self, "variables", variables)
+                object.__setattr__(self, "_saved_values", None)
+                super().__init__(**kwargs)
+
+            def _payload_keys(self):
+                return [k for k in super()._payload_keys()
+                        if k != "variables"]
+
+            def save(self):
+                object.__setattr__(self, "_saved_values",
+                                   [_np.array(v) for v in self.variables])
+                super().save()
+
+            def restore(self):
+                if self._saved_values is not None:
+                    for v, w in zip(self.variables, self._saved_values):
+                        v.assign(w)
+                super().restore()
+
+            def sync(self):
+                broadcast_variables(self.variables, root_rank=0)
+                super().sync()
 
         return _Impl()

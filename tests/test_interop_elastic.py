@@ -172,3 +172,22 @@ class TestKerasElastic:
               callbacks=[htf.UpdateBatchStateCallback(st), Count()])
         assert len(ran) == 5
         assert st.batch == 0                       # reset at epoch end
+
+
+class TestTensorFlowState:
+    def test_variables_state(self, hvd):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.interop import tf as htf
+
+        v1 = tf.Variable([1.0, 2.0])
+        v2 = tf.Variable(3.0)
+        state = htf.TensorFlowState(variables=[v1, v2], step=0)
+        state.commit()
+        v1.assign([9.0, 9.0])
+        v2.assign(0.0)
+        state.step = 4
+        state.restore()
+        np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+        assert float(v2.numpy()) == 3.0
+        assert state.step == 0
+        state.sync()                               # size-1: identity

@@ -15,4 +15,13 @@ from .estimator import JaxEstimator, ParquetSource
 from . import spark  # noqa: F401  (pyspark itself is imported lazily)
 
 __all__ = ["Executor", "RayExecutor", "JaxEstimator", "ParquetSource",
-           "spark"]
+           "KerasEstimator", "KerasModel", "spark"]
+
+
+def __getattr__(name):
+    # keras_estimator pulls in TF-side machinery — resolve lazily.
+    if name in ("KerasEstimator", "KerasModel"):
+        from . import keras_estimator
+
+        return getattr(keras_estimator, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

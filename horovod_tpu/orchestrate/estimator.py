@@ -384,15 +384,8 @@ class JaxEstimator:
                    for r in range(self.num_workers)], env)
 
     def _run_declarative(self, spec, per_rank_args, env) -> JaxModel:
-        """Shared dispatch tail for both declarative input modes.
-
-        Workers run collective training: pin them to the CPU platform (an
-        accelerator-steering outer env would make every worker claim the
-        real TPU) and give them a JAX coordination service address so
-        ``hvd.init()`` connects the pool."""
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        env.setdefault("PALLAS_AXON_POOL_IPS", "")
-        env.setdefault("HVDT_COORDINATOR_ADDR", f"127.0.0.1:{_free_port()}")
+        """Shared dispatch tail for both declarative input modes."""
+        env = collective_worker_env(env)
         with Executor(self.num_workers, env=env) as ex:
             results = ex.run(_declarative_fit, args=(spec,),
                              per_rank_args=per_rank_args)
@@ -404,3 +397,17 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def collective_worker_env(env: Optional[Dict[str, str]]) -> Dict[str, str]:
+    """Env for Executor workers that run COLLECTIVE training: pin them to
+    the CPU platform (an accelerator-steering outer env would make every
+    worker claim the real TPU; the sitecustomize pin rides
+    PALLAS_AXON_POOL_IPS) and give them a JAX coordination-service
+    address so ``hvd.init()`` forms one distributed world — without it
+    every worker is a silent size-1 island and collectives no-op."""
+    env = dict(env or {})
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("PALLAS_AXON_POOL_IPS", "")
+    env.setdefault("HVDT_COORDINATOR_ADDR", f"127.0.0.1:{_free_port()}")
+    return env

@@ -1,0 +1,200 @@
+"""KerasEstimator — the reference's Spark Keras estimator contract.
+
+Re-conception of ref: spark/keras/estimator.py (KerasEstimator ->
+KerasModel with fit/transform) on this framework's process model: the
+driver serializes the COMPILED keras model, an Executor pool of worker
+processes each loads it with the optimizer re-wrapped as the
+distributed one (interop.tf.load_model), trains data-parallel with the
+Broadcast/MetricAverage callbacks over equalized shards, and rank 0's
+trained weights come back as a local ``KerasModel`` handle.  The
+DataFrame/Petastorm plumbing collapses to numpy arrays, exactly like
+``JaxEstimator`` (same sharding/equalization discipline, same store
+layout for rank-0 checkpoints).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .estimator import JaxEstimator
+from .executor import Executor
+
+__all__ = ["KerasEstimator", "KerasModel"]
+
+
+def _model_to_bytes(model) -> bytes:
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.keras")
+        model.save(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+
+def _model_from_bytes(data: bytes, distributed: bool,
+                      custom_objects: Optional[Dict] = None):
+    import keras
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.keras")
+        with open(path, "wb") as f:
+            f.write(data)
+        if distributed:
+            from ..interop.tf import load_model
+
+            return load_model(path, custom_objects=custom_objects)
+        return keras.models.load_model(path,
+                                       custom_objects=custom_objects)
+
+
+class KerasModel:
+    """Trained model handle (ref: spark/keras KerasModel — transform()
+    runs the predict path; the underlying keras model is exposed)."""
+
+    def __init__(self, model, history: Optional[List[Dict]] = None):
+        self.model = model
+        self.history_ = history or []
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.predict(np.asarray(x), verbose=0))
+
+    def transform(self, x: np.ndarray) -> np.ndarray:
+        return self.predict(x)
+
+    def save(self, path: str) -> None:
+        self.model.save(path)
+
+
+def _keras_worker(spec: Dict[str, Any], model_bytes: bytes, x, y, xv, yv):
+    """Executor worker: load + wrap the model, train data-parallel.
+
+    Every rank returns its final-weights checksum and world size so the
+    driver (and tests) can PROVE the ranks formed one world and ended in
+    sync; rank 0 additionally returns the trained model."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from ..interop import tf as htf
+
+    if not hvd.is_initialized():
+        hvd.init()
+    model = _model_from_bytes(model_bytes, distributed=True,
+                              custom_objects=spec["custom_objects"])
+    callbacks = [htf.BroadcastGlobalVariablesCallback(0),
+                 htf.MetricAverageCallback()]
+    if spec["store"] and hvd.rank() == 0:
+        import keras
+
+        os.makedirs(spec["store"], exist_ok=True)
+        callbacks.append(keras.callbacks.ModelCheckpoint(
+            os.path.join(spec["store"], "checkpoint.keras")))
+    hist = model.fit(np.asarray(x), np.asarray(y),
+                     epochs=spec["epochs"],
+                     batch_size=spec["batch_size"],
+                     shuffle=spec["shuffle"],
+                     validation_data=(None if xv is None
+                                      else (np.asarray(xv),
+                                            np.asarray(yv))),
+                     verbose=0, callbacks=callbacks)
+    out = {"size": hvd.size(),
+           "checksum": float(sum(float(np.sum(np.asarray(v, np.float64)))
+                                 for v in model.weights))}
+    if hvd.rank() == 0:
+        out["model"] = _model_to_bytes(model)
+        out["history"] = [
+            dict(zip(hist.history, [float(v[i]) for v in
+                                    hist.history.values()]))
+            for i in range(len(next(iter(hist.history.values()), [])))]
+    return out
+
+
+class KerasEstimator:
+    """Fit a compiled keras model data-parallel over worker processes
+    (ref: spark/keras/estimator.py:KerasEstimator — the model/optimizer/
+    loss travel via keras serialization; ``num_workers`` is the
+    reference's ``num_proc``).
+
+    Args:
+      model: a COMPILED ``keras.Model`` (loss/metrics/optimizer baked
+        in; the optimizer is re-wrapped as the distributed one inside
+        each worker, ref: keras/estimator._load_model_from_checkpoint).
+      num_workers: worker-process pool size.
+      epochs / batch_size / shuffle: forwarded to ``model.fit``.
+      validation_split: GLOBAL tail split before sharding (the
+        reference's ``validation`` param; same discipline as
+        JaxEstimator — equalization padding can never leak train rows
+        into validation); workers evaluate round-robin val shards and
+        MetricAverageCallback averages the metrics.
+      custom_objects: forwarded to model deserialization.
+      store: directory for rank-0 epoch checkpoints (ref: store param).
+    """
+
+    def __init__(self, model=None, num_workers: int = 1, epochs: int = 1,
+                 batch_size: int = 32, shuffle: bool = True,
+                 validation_split: float = 0.0,
+                 custom_objects: Optional[Dict] = None,
+                 store: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None):
+        if model is None:
+            raise ValueError("KerasEstimator requires a compiled model")
+        if getattr(model, "optimizer", None) is None:
+            raise ValueError("model must be compiled (model.compile(...)) "
+                             "before constructing the estimator")
+        if not 0.0 <= validation_split < 1.0:
+            raise ValueError("validation_split must be in [0, 1)")
+        self.model = model
+        self.num_workers = num_workers
+        self._env = env
+        self._spec = {"epochs": int(epochs), "batch_size": int(batch_size),
+                      "shuffle": bool(shuffle),
+                      "validation_split": float(validation_split),
+                      "custom_objects": custom_objects, "store": store}
+        self.history_: List[Dict[str, float]] = []
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> KerasModel:
+        from .estimator import collective_worker_env
+
+        x, y = np.asarray(x), np.asarray(y)
+        if len(x) < self.num_workers:
+            raise ValueError(f"need at least num_workers="
+                             f"{self.num_workers} samples, got {len(x)}")
+        model_bytes = _model_to_bytes(self.model)
+        # Same discipline as JaxEstimator.fit: GLOBAL validation tail
+        # split BEFORE sharding/equalization (padded duplicates of train
+        # rows must never land in validation), then wrap-pad shards so
+        # every worker runs the same number of lockstep collective steps.
+        n_val = int(round(len(x) * self._spec["validation_split"]))
+        x_tr, y_tr = x[:len(x) - n_val], y[:len(y) - n_val]
+        xs = JaxEstimator._equalize(np.array_split(x_tr, self.num_workers))
+        ys = JaxEstimator._equalize(np.array_split(y_tr, self.num_workers))
+        if n_val:
+            xv = [x[len(x) - n_val:][r::self.num_workers]
+                  for r in range(self.num_workers)]
+            yv = [y[len(y) - n_val:][r::self.num_workers]
+                  for r in range(self.num_workers)]
+            xv = [s if len(s) else x[len(x) - n_val:] for s in xv]
+            yv = [s if len(s) else y[len(y) - n_val:] for s in yv]
+        else:
+            xv = yv = [None] * self.num_workers
+        with Executor(self.num_workers,
+                      env=collective_worker_env(self._env)) as ex:
+            results = ex.run(
+                _keras_worker, args=(self._spec, model_bytes),
+                per_rank_args=[(xs[r], ys[r], xv[r], yv[r])
+                               for r in range(self.num_workers)])
+        out = results[0]
+        if out is None or "model" not in out:
+            raise RuntimeError("rank 0 returned no model")
+        sizes = {r["size"] for r in results if r}
+        if sizes != {self.num_workers}:
+            raise RuntimeError(
+                f"workers did not form one world of {self.num_workers} "
+                f"(saw sizes {sizes}) — collective training did not run")
+        trained = _model_from_bytes(out["model"], distributed=False,
+                                    custom_objects=self._spec[
+                                        "custom_objects"])
+        self.history_ = out["history"]
+        return KerasModel(trained, out["history"])

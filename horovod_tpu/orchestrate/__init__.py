@@ -15,13 +15,18 @@ from .estimator import JaxEstimator, ParquetSource
 from . import spark  # noqa: F401  (pyspark itself is imported lazily)
 
 __all__ = ["Executor", "RayExecutor", "JaxEstimator", "ParquetSource",
-           "KerasEstimator", "KerasModel", "spark"]
+           "KerasEstimator", "KerasModel", "TorchEstimator", "TorchModel",
+           "spark"]
 
 
 def __getattr__(name):
-    # keras_estimator pulls in TF-side machinery — resolve lazily.
+    # framework estimators pull in TF/torch machinery — resolve lazily.
     if name in ("KerasEstimator", "KerasModel"):
         from . import keras_estimator
 
         return getattr(keras_estimator, name)
+    if name in ("TorchEstimator", "TorchModel"):
+        from . import torch_estimator
+
+        return getattr(torch_estimator, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

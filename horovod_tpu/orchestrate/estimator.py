@@ -318,29 +318,8 @@ class JaxEstimator:
                 raise ValueError("declarative fit needs y (loss_fn is "
                                  "called as loss_fn(params, xb, yb))")
             x, y = np.asarray(x), np.asarray(y)
-            if len(x) < self.num_workers:
-                raise ValueError(
-                    f"need at least num_workers={self.num_workers} "
-                    f"samples, got {len(x)}")
-            # Global tail split (keras validation_split convention) BEFORE
-            # sharding/equalization so padded duplicates of training rows
-            # can never land in the validation set.
-            n_val = int(round(len(x) * self._spec["validation_split"]))
-            x_tr, y_tr = x[:len(x) - n_val], y[:len(y) - n_val]
-            xs, ys = self._shards(x_tr, y_tr)
-            xs, ys = self._equalize(xs), self._equalize(ys)
-            if n_val:
-                # Round-robin val shards; whole (tiny) val set per rank
-                # when there are fewer val rows than workers, so every
-                # rank enters the val-metric collective.
-                xv = [x[len(x) - n_val:][r::self.num_workers]
-                      for r in range(self.num_workers)]
-                yv = [y[len(y) - n_val:][r::self.num_workers]
-                      for r in range(self.num_workers)]
-                xv = [s if len(s) else x[len(x) - n_val:] for s in xv]
-                yv = [s if len(s) else y[len(y) - n_val:] for s in yv]
-            else:
-                xv = yv = [None] * self.num_workers
+            xs, ys, xv, yv = split_and_shard(
+                x, y, self._spec["validation_split"], self.num_workers)
             return self._run_declarative(
                 self._spec, [(xs[r], ys[r], xv[r], yv[r])
                              for r in range(self.num_workers)], env)
@@ -397,6 +376,35 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def split_and_shard(x: np.ndarray, y: np.ndarray, validation_split: float,
+                    num_workers: int):
+    """Shared estimator data discipline: GLOBAL validation tail split
+    BEFORE sharding/equalization (padding can never leak train rows into
+    validation), equalized train shards (same lockstep collective count
+    per worker), round-robin val shards with a whole-set fallback so
+    every rank enters the val-metric collectives.
+
+    Returns (xs, ys, xv, yv) — per-rank lists; xv/yv entries are None
+    when validation_split == 0."""
+    n_val = int(round(len(x) * validation_split))
+    x_tr, y_tr = x[:len(x) - n_val], y[:len(y) - n_val]
+    if len(x_tr) < num_workers:
+        raise ValueError(
+            f"need at least num_workers={num_workers} TRAINING samples "
+            f"after the validation split, got {len(x_tr)} "
+            f"(n={len(x)}, validation_split={validation_split})")
+    xs = JaxEstimator._equalize(np.array_split(x_tr, num_workers))
+    ys = JaxEstimator._equalize(np.array_split(y_tr, num_workers))
+    if n_val:
+        xv = [x[len(x) - n_val:][r::num_workers] for r in range(num_workers)]
+        yv = [y[len(y) - n_val:][r::num_workers] for r in range(num_workers)]
+        xv = [s if len(s) else x[len(x) - n_val:] for s in xv]
+        yv = [s if len(s) else y[len(y) - n_val:] for s in yv]
+    else:
+        xv = yv = [None] * num_workers
+    return xs, ys, xv, yv
 
 
 def collective_worker_env(env: Optional[Dict[str, str]]) -> Dict[str, str]:

@@ -20,7 +20,6 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from .estimator import JaxEstimator
 from .executor import Executor
 
 __all__ = ["KerasEstimator", "KerasModel"]
@@ -155,30 +154,12 @@ class KerasEstimator:
         self.history_: List[Dict[str, float]] = []
 
     def fit(self, x: np.ndarray, y: np.ndarray) -> KerasModel:
-        from .estimator import collective_worker_env
+        from .estimator import collective_worker_env, split_and_shard
 
         x, y = np.asarray(x), np.asarray(y)
-        if len(x) < self.num_workers:
-            raise ValueError(f"need at least num_workers="
-                             f"{self.num_workers} samples, got {len(x)}")
         model_bytes = _model_to_bytes(self.model)
-        # Same discipline as JaxEstimator.fit: GLOBAL validation tail
-        # split BEFORE sharding/equalization (padded duplicates of train
-        # rows must never land in validation), then wrap-pad shards so
-        # every worker runs the same number of lockstep collective steps.
-        n_val = int(round(len(x) * self._spec["validation_split"]))
-        x_tr, y_tr = x[:len(x) - n_val], y[:len(y) - n_val]
-        xs = JaxEstimator._equalize(np.array_split(x_tr, self.num_workers))
-        ys = JaxEstimator._equalize(np.array_split(y_tr, self.num_workers))
-        if n_val:
-            xv = [x[len(x) - n_val:][r::self.num_workers]
-                  for r in range(self.num_workers)]
-            yv = [y[len(y) - n_val:][r::self.num_workers]
-                  for r in range(self.num_workers)]
-            xv = [s if len(s) else x[len(x) - n_val:] for s in xv]
-            yv = [s if len(s) else y[len(y) - n_val:] for s in yv]
-        else:
-            xv = yv = [None] * self.num_workers
+        xs, ys, xv, yv = split_and_shard(
+            x, y, self._spec["validation_split"], self.num_workers)
         with Executor(self.num_workers,
                       env=collective_worker_env(self._env)) as ex:
             results = ex.run(

@@ -165,13 +165,19 @@ def main():
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    # Single chip: stay meshless so Pallas kernels (flash attention) can
+    # engage — GSPMD cannot auto-partition Mosaic kernels, so any mesh
+    # with auto axes (even size-1) forces the XLA attention fallback.
+    single = need == 1 and not explicit_dp
+
     # Parameter shardings from logical-axis rules (tp/pp/ep placement).
-    param_sh = jax.tree.map(
-        lambda lg: NamedSharding(mesh, logical_to_mesh(lg, rules, mesh)),
-        axes,
-        is_leaf=lambda x: isinstance(x, tuple) and all(
-            isinstance(e, (str, type(None))) for e in x))
-    params = jax.device_put(params, param_sh)
+    if not single:
+        param_sh = jax.tree.map(
+            lambda lg: NamedSharding(mesh, logical_to_mesh(lg, rules, mesh)),
+            axes,
+            is_leaf=lambda x: isinstance(x, tuple) and all(
+                isinstance(e, (str, type(None))) for e in x))
+        params = jax.device_put(params, param_sh)
     if explicit_dp:
         def local_step(params, opt_state, tokens):
             def loss_fn(p):
@@ -193,11 +199,20 @@ def main():
             local_step, mesh=mesh,
             in_specs=(P(), P(), P("dp")),
             out_specs=(P(), P(), P())), donate_argnums=(0, 1))
+    elif single:
+        def plain_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                lambda p: transformer_loss(p, tokens, cfg))(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        step = jax.jit(plain_step, donate_argnums=(0, 1))
     else:
         step = jax.jit(train_step, donate_argnums=(0, 1))
 
     rng = np.random.default_rng(0)
-    tok_sharding = NamedSharding(mesh, P("dp", "sp"))
+    tok_sharding = (None if single
+                    else NamedSharding(mesh, P("dp", "sp")))
 
     # One fixed synthetic batch (the synthetic-benchmark convention, ref:
     # pytorch_synthetic_benchmark.py): loss decrease is then deterministic

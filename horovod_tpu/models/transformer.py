@@ -206,9 +206,23 @@ def _attention(p, x, positions, cfg: TransformerConfig):
 
 def _flash_enabled(seq_len: int, head_dim: int) -> bool:
     """Flash kernel policy: HVDT_FLASH_ATTENTION=auto|on|off.  'auto'
-    (default) uses it on TPU when block shapes divide cleanly."""
+    (default) uses it on TPU when block shapes divide cleanly.
+
+    Regardless of mode, the kernel is OFF when the ambient mesh has
+    GSPMD-auto axes: Mosaic kernels cannot be auto-partitioned ("wrap
+    the call in a shard_map"), so under a partially-manual island (e.g.
+    the hybrid dp x tp x pp example) attention falls back to XLA —
+    engage the kernel from meshless jit (single chip) or fully-manual
+    shard_map contexts."""
     from ..common import config
 
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if not am.empty and any(t == jax.sharding.AxisType.Auto
+                                for t in am.axis_types):
+            return False
+    except Exception:       # pragma: no cover - very old jax
+        pass
     mode = config.get_str("HVDT_FLASH_ATTENTION").lower()
     if mode == "off":
         return False
@@ -402,13 +416,20 @@ def transformer_loss(params: Dict, tokens: jax.Array,
                      cfg: TransformerConfig) -> jax.Array:
     """Causal LM loss (next-token cross entropy) over the local shard.
 
+    The model runs on the FULL sequence and the last position's
+    prediction is dropped — mathematically identical to feeding
+    ``tokens[:, :-1]`` (causal attention means position i never sees
+    i+1), but it keeps the attention length at the caller's power-of-two
+    ``seq`` instead of ``seq - 1``, which is what lets the flash kernel
+    (block-divisibility gate) engage on the training path.
+
     ``cfg.loss_chunk > 0`` switches to the chunked-vocab logsumexp path
     (no [tokens, vocab] logits tensor)."""
     targets = tokens[:, 1:]
     if cfg.loss_chunk:
-        x = transformer_hidden(params, tokens[:, :-1], cfg)
+        x = transformer_hidden(params, tokens, cfg)[:, :-1]
         return _chunked_xent(x, params["embed"], targets, cfg.loss_chunk)
-    logits = transformer_apply(params, tokens[:, :-1], cfg)
+    logits = transformer_apply(params, tokens, cfg)[:, :-1]
     logp = jax.nn.log_softmax(logits, -1)
     ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
     return -ll.mean()

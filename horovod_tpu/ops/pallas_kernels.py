@@ -200,12 +200,26 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     block_q: int = 512, block_k: int = 1024) -> jax.Array:
     """Fused flash attention; layouts/API match
     parallel.ring_attention (q,k,v: [B, L, H, D]; GQA via fewer kv heads).
+
+    Differentiable: the forward runs the Pallas kernel (pallas_call has
+    no autodiff rule of its own); the backward is the standard flash
+    gradient recomputed BLOCKWISE over K in plain XLA — the saved
+    logsumexp makes the recomputation exact, and the [B,H,Lq,block_k]
+    working set keeps backward memory O(L·block) instead of O(L²)
+    (the property that makes long-context training fit in HBM at all).
     """
     b, lq, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
     block_q = _fit_block(lq, block_q, q.dtype)
     block_k = _fit_block(k.shape[1], block_k, k.dtype, v.dtype)
+    return _flash_attn_diff(q, k, v, causal, float(scale), block_q,
+                            block_k)
+
+
+def _flash_fwd_core(q, k, v, causal, scale, block_q, block_k):
+    """Kernel forward returning (out [B,L,H,D], lse [B,H,Lq])."""
+    b, lq, h, d = q.shape
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -214,8 +228,124 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     l = jnp.zeros((b, h, lq, 1), jnp.float32)
     acc, m, l = _flash_call(qt, kt, vt, acc, m, l, 0, 0, causal=causal,
                             scale=scale, block_q=block_q, block_k=block_k)
-    out = acc / jnp.maximum(l, 1e-30)
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    l = jnp.maximum(l, 1e-30)
+    out = (acc / l).transpose(0, 2, 1, 3).astype(q.dtype)
+    lse = (m + jnp.log(l))[..., 0]                       # [B, H, Lq]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attn_diff(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd_core(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_attn_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd_core(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attn_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    b, lq, h, d = q.shape
+    lk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
+    # Backward tiles bounded independently of the forward kernel's
+    # VMEM-tuned blocks (the [B,H,tq,blk] f32 score tile is the
+    # backward's working set).
+    blk = _fit_block(lk, min(block_k, 512), jnp.float32)
+    tq = _fit_block(lq, min(block_q, 512), jnp.float32)
+    nblk, ntq = lk // blk, lq // tq
+
+    f32 = jnp.float32
+    qf = q.astype(f32)
+    dof = do.astype(f32)
+    outf = out.astype(f32)
+    # delta_i = sum_d do_i * o_i  (rowsum term of dS)      [B, Lq, H]
+    delta = jnp.einsum("bqhd,bqhd->bqh", dof, outf)
+
+    # Inside a shard_map island the grads vary over the island's manual
+    # axes; every scan carry must hold the same vma type as the body
+    # outputs.
+    vma = set()
+    for op in (q, k, v, do):
+        vma |= set(getattr(jax.typeof(op), "vma", frozenset()))
+
+    def _v(x):
+        missing = tuple(vma - set(getattr(jax.typeof(x), "vma",
+                                          frozenset())))
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+    qf, dof, delta, lse = _v(qf), _v(dof), _v(delta), _v(lse)
+
+    def tile(i, j, ks, vs):
+        """Grad contributions of (q tile j) x (k block i)."""
+        q_t = jax.lax.dynamic_slice_in_dim(qf, j * tq, tq, 1)
+        do_t = jax.lax.dynamic_slice_in_dim(dof, j * tq, tq, 1)
+        dl_t = jax.lax.dynamic_slice_in_dim(delta, j * tq, tq, 1)
+        lse_t = jax.lax.dynamic_slice_in_dim(lse, j * tq, tq, 2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q_t, ks) * scale
+        if causal:
+            q_pos = j * tq + jnp.arange(tq)
+            k_pos = i * blk + jnp.arange(blk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jnp.exp(s - lse_t[..., None])                # [B,H,tq,blk]
+        dv_b = jnp.einsum("bhqk,bqhd->bkhd", p, do_t)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do_t, vs)
+        ds = p * (dp - dl_t.transpose(0, 2, 1)[..., None]) * scale
+        dq_t = jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+        dk_b = jnp.einsum("bhqk,bqhd->bkhd", ds, q_t)
+        return dq_t, dk_b, dv_b
+
+    def k_block(dq_acc, i):
+        ks = jax.lax.dynamic_slice_in_dim(k, i * blk, blk, 1).astype(f32)
+        vs = jax.lax.dynamic_slice_in_dim(v, i * blk, blk, 1).astype(f32)
+        if group > 1:
+            ks = jnp.repeat(ks, group, axis=2)
+            vs = jnp.repeat(vs, group, axis=2)
+
+        def q_tile(carry, j):
+            dq_acc, dk_b, dv_b = carry
+
+            def compute(args):
+                dq_acc, dk_b, dv_b = args
+                dq_t, dk_t, dv_t = tile(i, j, ks, vs)
+                dq_acc = jax.lax.dynamic_update_slice_in_dim(
+                    dq_acc,
+                    jax.lax.dynamic_slice_in_dim(dq_acc, j * tq, tq, 1)
+                    + dq_t, j * tq, 1)
+                return dq_acc, dk_b + dk_t, dv_b + dv_t
+
+            if causal:
+                # Causal pruning (the forward kernel's flops halving,
+                # mirrored): a q tile strictly above this K block's
+                # first row is fully masked — skip its four einsums.
+                visible = (j + 1) * tq - 1 >= i * blk
+                dq_acc, dk_b, dv_b = jax.lax.cond(
+                    visible, compute, lambda args: args,
+                    (dq_acc, dk_b, dv_b))
+            else:
+                dq_acc, dk_b, dv_b = compute((dq_acc, dk_b, dv_b))
+            return (dq_acc, dk_b, dv_b), None
+
+        zeros_kv = _v(jnp.zeros((b, blk, h, d), f32))
+        (dq_acc, dk_b, dv_b), _ = jax.lax.scan(
+            q_tile, (dq_acc, zeros_kv, zeros_kv), jnp.arange(ntq))
+        if group > 1:
+            dk_b = dk_b.reshape(b, blk, hkv, group, d).sum(3)
+            dv_b = dv_b.reshape(b, blk, hkv, group, d).sum(3)
+        return dq_acc, (dk_b, dv_b)
+
+    dq0 = _v(jnp.zeros((b, lq, h, d), f32))
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(k_block, dq0,
+                                              jnp.arange(nblk))
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, lk, hkv, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, lk, hkv, d)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_flash_attn_diff.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 
 def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,

@@ -74,8 +74,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         side's labels rotate around the ring with K/V.
       use_pallas: run each ring step through the Pallas flash kernel
         (ops/pallas_kernels.flash_block_update) instead of the jnp block
-        update.  Default: on TPU, when segment_ids is None and shapes
-        tile cleanly.
+        update.  Default **False**: the per-step kernel has no autodiff
+        rule (its online-softmax carry chain would need a dedicated ring
+        backward), so differentiating a ``use_pallas=True`` ring raises
+        ``NotImplementedError`` — opt in for FORWARD-ONLY use
+        (inference/scoring) on TPU with cleanly tiling shapes.  The
+        default jnp block update is exact, differentiable, and already
+        streams one K/V block at a time (O(L·block) memory).
 
     Returns ``[batch, local_seq, heads, head_dim]`` in q's dtype.
     """
@@ -90,9 +95,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     lk = k.shape[1]
 
     if use_pallas is None:
-        use_pallas = (jax.devices()[0].platform == "tpu"
-                      and segment_ids is None
-                      and lq % min(128, lq) == 0 and lk % min(128, lk) == 0)
+        use_pallas = False
+    elif use_pallas and (segment_ids is not None
+                         or lq % min(128, lq) or lk % min(128, lk)):
+        import warnings
+
+        warnings.warn(
+            "ring_attention(use_pallas=True) ignored: the kernel needs "
+            "segment_ids=None and 128-tiling shapes "
+            f"(lq={lq}, lk={lk}); running the jnp block update",
+            stacklevel=2)
+        use_pallas = False
 
     q_pos = my * lq + jnp.arange(lq)                      # global q positions
 

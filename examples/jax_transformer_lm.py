@@ -160,10 +160,15 @@ def main():
         in_specs=(manual_spec(axes), P(None, "sp")),
         out_specs=P(), axis_names={"pp", "sp", "ep"})
 
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(island)(params, tokens)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    # Single chip uses the plain loss (no shard_map island) so the
+    # Pallas flash path can engage; the hybrid layout differentiates
+    # through the island.  One step body serves both.
+    def make_step(loss_fn):
+        def train_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+        return train_step
 
     # Single chip: stay meshless so Pallas kernels (flash attention) can
     # engage — GSPMD cannot auto-partition Mosaic kernels, so any mesh
@@ -200,15 +205,10 @@ def main():
             in_specs=(P(), P(), P("dp")),
             out_specs=(P(), P(), P())), donate_argnums=(0, 1))
     elif single:
-        def plain_step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(
-                lambda p: transformer_loss(p, tokens, cfg))(params)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        step = jax.jit(plain_step, donate_argnums=(0, 1))
+        step = jax.jit(make_step(lambda p, t: transformer_loss(p, t, cfg)),
+                       donate_argnums=(0, 1))
     else:
-        step = jax.jit(train_step, donate_argnums=(0, 1))
+        step = jax.jit(make_step(island), donate_argnums=(0, 1))
 
     rng = np.random.default_rng(0)
     tok_sharding = (None if single

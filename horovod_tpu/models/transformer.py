@@ -316,12 +316,12 @@ def transformer_hidden(params: Dict, tokens: jax.Array,
     x = params["embed"].astype(cfg.dtype)[tokens]
     # Manual-island axes make activations varying (e.g. the MoE alltoall);
     # pre-cast so the scan-over-layers carry is type-stable under vma.
+    from ..parallel.sharding import pcast_to_union
+
     manual_axes = [ax for ax, on in (("sp", cfg.sp > 1),
                                      ("ep", cfg.ep > 1 and cfg.num_experts))
                    if on]
-    missing = tuple(set(manual_axes) - set(jax.typeof(x).vma))
-    if missing:
-        x = lax.pcast(x, missing, to="varying")
+    x = pcast_to_union(x, extra=tuple(manual_axes))
     if cfg.pp > 1:
         # Inside a shard_map over {'pp'} the stacked-layers dim of the
         # block params is the sharded "stages" logical axis, so the local
@@ -344,12 +344,9 @@ def transformer_hidden(params: Dict, tokens: jax.Array,
         # doesn't know about (e.g. a stages dim spec'd onto a size-1 pp
         # mesh axis); the scan carry must match, so pcast x up to the
         # union of the params' varying axes.
-        pvma = set()
-        for leaf in jax.tree.leaves(params["block"]):
-            pvma |= set(jax.typeof(leaf).vma)
-        missing = tuple(pvma - set(jax.typeof(x).vma))
-        if missing:
-            x = lax.pcast(x, missing, to="varying")
+        from ..parallel.sharding import pcast_to_union
+
+        x = pcast_to_union(x, *jax.tree.leaves(params["block"]))
         x = _scan_blocks(params["block"], x, positions, cfg)
     return _rmsnorm(x, params["ln_f"])
 

@@ -258,30 +258,24 @@ def _flash_attn_bwd(causal, scale, block_q, block_k, res, do):
     nblk, ntq = lk // blk, lq // tq
 
     f32 = jnp.float32
-    qf = q.astype(f32)
-    dof = do.astype(f32)
-    outf = out.astype(f32)
-    # delta_i = sum_d do_i * o_i  (rowsum term of dS)      [B, Lq, H]
-    delta = jnp.einsum("bqhd,bqhd->bqh", dof, outf)
+    # delta_i = sum_d do_i * o_i (rowsum term of dS), f32-accumulated
+    # without materializing whole-sequence f32 copies of do/out — tiles
+    # are upcast inside tile() instead (the [B,Lq,*,D] f32 copies would
+    # cost ~3x 128 MB at the documented bf16 seq-8192 config).
+    delta = jnp.einsum("bqhd,bqhd->bqh", do, out,
+                       preferred_element_type=f32)
 
-    # Inside a shard_map island the grads vary over the island's manual
-    # axes; every scan carry must hold the same vma type as the body
-    # outputs.
-    vma = set()
-    for op in (q, k, v, do):
-        vma |= set(getattr(jax.typeof(op), "vma", frozenset()))
+    from ..parallel.sharding import pcast_to_union
 
     def _v(x):
-        missing = tuple(vma - set(getattr(jax.typeof(x), "vma",
-                                          frozenset())))
-        return jax.lax.pcast(x, missing, to="varying") if missing else x
+        return pcast_to_union(x, q, k, v, do)
 
-    qf, dof, delta, lse = _v(qf), _v(dof), _v(delta), _v(lse)
+    delta, lse = _v(delta), _v(lse)
 
     def tile(i, j, ks, vs):
         """Grad contributions of (q tile j) x (k block i)."""
-        q_t = jax.lax.dynamic_slice_in_dim(qf, j * tq, tq, 1)
-        do_t = jax.lax.dynamic_slice_in_dim(dof, j * tq, tq, 1)
+        q_t = jax.lax.dynamic_slice_in_dim(q, j * tq, tq, 1).astype(f32)
+        do_t = jax.lax.dynamic_slice_in_dim(do, j * tq, tq, 1).astype(f32)
         dl_t = jax.lax.dynamic_slice_in_dim(delta, j * tq, tq, 1)
         lse_t = jax.lax.dynamic_slice_in_dim(lse, j * tq, tq, 2)
         s = jnp.einsum("bqhd,bkhd->bhqk", q_t, ks) * scale

@@ -36,6 +36,7 @@ from .sharding import (  # noqa: F401
     batch_spec,
     logical_to_mesh,
     named_sharding,
+    pcast_to_union,
     transformer_rules,
 )
 from .ring_attention import ring_attention  # noqa: F401

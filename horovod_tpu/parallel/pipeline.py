@@ -74,13 +74,12 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     # Initial carries must match the body's varying-manual-axes type
     # (inputs' vma plus the pipeline axis) for vma stability under scan.
-    want_vma = (set(jax.typeof(microbatches).vma)
-                | {ax for leaf in jax.tree.leaves(stage_params)
-                   for ax in jax.typeof(leaf).vma} | {axis})
+    from .sharding import pcast_to_union
 
     def _varying(x):
-        missing = tuple(want_vma - set(jax.typeof(x).vma))
-        return lax.pcast(x, missing, to="varying") if missing else x
+        return pcast_to_union(x, microbatches,
+                              *jax.tree.leaves(stage_params),
+                              extra=(axis,))
 
     recv0 = _varying(jnp.zeros_like(microbatches[0]))
     out0 = _varying(jnp.zeros_like(microbatches))

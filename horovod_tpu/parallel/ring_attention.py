@@ -112,12 +112,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # Initial accumulators must carry the same varying-manual-axes type the
     # scan body produces (q/k/v's vma plus the ring axis) so the carry is
     # type-stable — q may additionally vary over dp/tp axes of the mesh.
-    want_vma = (set(jax.typeof(q).vma) | set(jax.typeof(k).vma)
-                | set(jax.typeof(v).vma) | {axis})
+    from .sharding import pcast_to_union
 
     def _varying(x):
-        missing = tuple(want_vma - set(jax.typeof(x).vma))
-        return lax.pcast(x, missing, to="varying") if missing else x
+        return pcast_to_union(x, q, k, v, extra=(axis,))
 
     acc = _varying(jnp.zeros((b, lq, h, d), jnp.float32))
     row_max = _varying(jnp.full((b, h, lq), _NEG_INF, jnp.float32))

@@ -16,6 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP
 
 __all__ = [
+    "pcast_to_union",
     "transformer_rules", "logical_to_mesh", "named_sharding", "batch_spec",
 ]
 
@@ -107,3 +108,22 @@ def batch_spec(mesh: Optional[Mesh] = None, *, seq_sharded: bool = False,
         rules = transformer_rules()
     logical = ("batch", "seq" if seq_sharded else None)
     return logical_to_mesh(logical, rules, mesh)
+
+
+def pcast_to_union(x, *operands, extra=()):
+    """Promote ``x``'s varying-manual-axes (vma) type to the union of the
+    operands' vma sets (plus any ``extra`` axis names).
+
+    Inside a ``shard_map`` island, scan carries / accumulators must hold
+    the same vma type as the values the body produces; this is THE
+    idiom for initializing them (used by ring attention, the pipeline
+    schedule, the transformer layer scan, and the flash-attention
+    backward)."""
+    import jax
+    from jax import lax
+
+    want = set(extra)
+    for op in operands:
+        want |= set(getattr(jax.typeof(op), "vma", frozenset()))
+    missing = tuple(want - set(getattr(jax.typeof(x), "vma", frozenset())))
+    return lax.pcast(x, missing, to="varying") if missing else x

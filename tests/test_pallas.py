@@ -178,6 +178,28 @@ class TestFlashGradients:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, rtol=1e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_multiblock_backward_matches_reference(self, causal):
+        """lq=512 with 128-blocks: nblk=ntq=4 — exercises the blockwise
+        scan, the causal-pruning cond, cross-block dq accumulation, and
+        dk/dv block reassembly (a single-block run covers none of
+        them)."""
+        from horovod_tpu.ops.pallas_kernels import (attention_reference,
+                                                    flash_attention)
+
+        q, k, v = self._qkv(lq=512, seed=6)
+
+        def grads(fn, **kw):
+            return jax.grad(
+                lambda q, k, v: (fn(q, k, v, causal=causal, **kw) ** 2
+                                 ).sum(), argnums=(0, 1, 2))(q, k, v)
+
+        got = grads(flash_attention, block_q=128, block_k=128)
+        ref = grads(attention_reference)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
     def test_gqa_grads_match_reference(self):
         from horovod_tpu.ops.pallas_kernels import (attention_reference,
                                                     flash_attention)
@@ -264,3 +286,37 @@ class TestFlashGradients:
         with pytest.warns(UserWarning, match="use_pallas=True. ignored"):
             jax.shard_map(local, mesh=mesh, in_specs=P(None, "sp"),
                           out_specs=P(None, "sp"))(q)
+
+
+class TestFlashMeshGate:
+    def test_auto_mesh_axes_disable_flash(self, monkeypatch):
+        """Mosaic kernels can't be GSPMD-auto-partitioned: under a
+        partially-manual island (auto dp axis present) the gate must
+        force the XLA fallback even with HVDT_FLASH_ATTENTION=on."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        import horovod_tpu.models.transformer as tr
+
+        monkeypatch.setenv("HVDT_FLASH_ATTENTION", "on")
+        assert tr._flash_enabled(128, 32)          # no mesh: on
+
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                    ("dp", "sp"))
+        seen = {}
+
+        def probe(x):
+            seen["enabled"] = tr._flash_enabled(128, 32)
+            return x
+
+        jax.jit(jax.shard_map(probe, mesh=mesh, in_specs=P(),
+                              out_specs=P(), axis_names={"sp"}))(
+            jnp.ones(4))
+        assert seen["enabled"] is False            # dp is Auto
+
+        def probe2(x):
+            seen["manual"] = tr._flash_enabled(128, 32)
+            return x
+
+        jax.jit(jax.shard_map(probe2, mesh=mesh, in_specs=P(),
+                              out_specs=P()))(jnp.ones(4))
+        assert seen["manual"] is True              # fully manual: on

@@ -251,10 +251,20 @@ def _flash_attn_bwd(causal, scale, block_q, block_k, res, do):
     lk, hkv = k.shape[1], k.shape[2]
     group = h // hkv
     # Backward tiles bounded independently of the forward kernel's
-    # VMEM-tuned blocks (the [B,H,tq,blk] f32 score tile is the
-    # backward's working set).
+    # VMEM-tuned blocks: the [B,H,tq,blk] f32 score tile is the
+    # backward's working set, so cap it ADAPTIVELY by B*H — at large
+    # batch x heads a fixed 512x512 tile is a quarter-GB per
+    # intermediate and XLA starts spilling (measured: BERT-Large
+    # seq 4096 collapsed from 12.3k to 6.5k tok/s when batch doubled
+    # the tile to 256 MB).
     blk = _fit_block(lk, min(block_k, 512), jnp.float32)
     tq = _fit_block(lq, min(block_q, 512), jnp.float32)
+    tile_budget = 96 * 1024 * 1024                       # bytes, f32 tile
+    while b * h * tq * blk * 4 > tile_budget and max(tq, blk) > 128:
+        if blk >= tq and blk > 128:
+            blk = _fit_block(lk, blk // 2, jnp.float32)
+        else:
+            tq = _fit_block(lq, tq // 2, jnp.float32)
     nblk, ntq = lk // blk, lq // tq
 
     f32 = jnp.float32

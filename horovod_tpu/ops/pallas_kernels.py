@@ -256,7 +256,9 @@ def _flash_attn_bwd(causal, scale, block_q, block_k, res, do):
     # batch x heads a fixed 512x512 tile is a quarter-GB per
     # intermediate and XLA starts spilling (measured: BERT-Large
     # seq 4096 collapsed from 12.3k to 6.5k tok/s when batch doubled
-    # the tile to 256 MB).
+    # the tile to 256 MB).  The budget also halves the 134 MB batch-8
+    # config's tiles; measured harmless there (12.9k capped vs 12.3k
+    # uncapped — smaller tiles cost nothing on this workload).
     blk = _fit_block(lk, min(block_k, 512), jnp.float32)
     tq = _fit_block(lq, min(block_q, 512), jnp.float32)
     tile_budget = 96 * 1024 * 1024                       # bytes, f32 tile

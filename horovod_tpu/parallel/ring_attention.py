@@ -62,6 +62,16 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    use_pallas: Optional[bool] = None) -> jax.Array:
     """Exact (optionally causal) attention over a sequence-sharded ring.
 
+    Differentiation: the common path (``segment_ids=None``,
+    ``use_pallas`` unset) carries a ``custom_vjp`` whose backward is a
+    SECOND ring pass that recomputes scores blockwise from the saved
+    logsumexp — O(local_seq x block) memory, like the forward.  Plain
+    autodiff through the forward scan would instead save every visiting
+    block's score matrix (O(local_seq x global_seq) per device), which
+    defeats the point of sequence parallelism at long context.  The
+    ``segment_ids`` path still differentiates that way (exact, memory-
+    heavy); the ``use_pallas`` path is forward-only.
+
     Args:
       q, k, v: local shards ``[batch, local_seq, heads, head_dim]``.  MQA/GQA
         is supported: k/v may have fewer heads as long as q heads divide;
@@ -75,12 +85,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       use_pallas: run each ring step through the Pallas flash kernel
         (ops/pallas_kernels.flash_block_update) instead of the jnp block
         update.  Default **False**: the per-step kernel has no autodiff
-        rule (its online-softmax carry chain would need a dedicated ring
-        backward), so differentiating a ``use_pallas=True`` ring raises
+        rule, so differentiating a ``use_pallas=True`` ring raises
         ``NotImplementedError`` — opt in for FORWARD-ONLY use
         (inference/scoring) on TPU with cleanly tiling shapes.  The
-        default jnp block update is exact, differentiable, and already
-        streams one K/V block at a time (O(L·block) memory).
+        default path is exact and differentiable with flash-style
+        memory in BOTH directions (custom_vjp above).
 
     Returns ``[batch, local_seq, heads, head_dim]`` in q's dtype.
     """
@@ -90,8 +99,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"q heads {h} not divisible by kv heads {k.shape[2]}")
     if scale is None:
         scale = d ** -0.5
-    sp = lax.axis_size(axis)
-    my = lax.axis_index(axis)
     lk = k.shape[1]
 
     if use_pallas is None:
@@ -106,6 +113,27 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"(lq={lq}, lk={lk}); running the jnp block update",
             stacklevel=2)
         use_pallas = False
+
+    # The custom_vjp path needs scale as a static Python float
+    # (nondiff arg); a traced scale (e.g. a learned temperature) keeps
+    # the plain-autodiff path, which handles it fine.
+    try:
+        static_scale = float(scale)
+    except Exception:
+        static_scale = None
+    if segment_ids is None and not use_pallas and static_scale is not None:
+        return _ring_diff(q, k, v, axis, causal, static_scale)
+    out, _ = _ring_forward(q, k, v, axis, causal, scale,
+                           segment_ids, use_pallas)
+    return out
+
+
+def _ring_forward(q, k, v, axis, causal, scale, segment_ids, use_pallas):
+    """Forward ring pass; returns (out, lse [B,H,Lq])."""
+    b, lq, h, d = q.shape
+    sp = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    lk = k.shape[1]
 
     q_pos = my * lq + jnp.arange(lq)                      # global q positions
 
@@ -176,7 +204,91 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    if k_seg is not None else None)
         return (k_nxt, v_nxt, seg_nxt, acc, row_max, row_sum), None
 
-    (_, _, _, acc, _, row_sum), _ = lax.scan(
+    (_, _, _, acc, row_max, row_sum), _ = lax.scan(
         step, (k, v, k_seg0, acc, row_max, row_sum), jnp.arange(sp))
-    out = acc / jnp.maximum(row_sum, 1e-30).transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    row_sum = jnp.maximum(row_sum, 1e-30)
+    out = acc / row_sum.transpose(0, 2, 1)[..., None]
+    lse = row_max + jnp.log(row_sum)                       # [B, H, Lq]
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_diff(q, k, v, axis, causal, scale):
+    out, _ = _ring_forward(q, k, v, axis, causal, scale, None, False)
+    return out
+
+
+def _ring_diff_fwd(q, k, v, axis, causal, scale):
+    out, lse = _ring_forward(q, k, v, axis, causal, scale, None, False)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_diff_bwd(axis, causal, scale, res, do):
+    """Second ring pass: dk/dv accumulators travel WITH their K/V block
+    (ppermute) and arrive home after sp rotations carrying every rank's
+    contribution; dq accumulates locally.  Scores are recomputed per
+    visiting block from the saved logsumexp — O(local_seq x block)
+    memory, mirroring the forward."""
+    q, k, v, out, lse = res
+    b, lq, h, d = q.shape
+    lk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
+    sp = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    fwd = [(i, (i + 1) % sp) for i in range(sp)]
+    f32 = jnp.float32
+
+    qf = q.astype(f32)
+    dof = do.astype(f32)
+    # delta_i = sum_d do_i * o_i (rowsum term of dS)       [B, Lq, H]
+    delta = jnp.einsum("bqhd,bqhd->bqh", do, out,
+                       preferred_element_type=f32)
+    q_pos = my * lq + jnp.arange(lq)
+
+    from .sharding import pcast_to_union
+
+    def _varying(x):
+        return pcast_to_union(x, q, k, v, do, extra=(axis,))
+
+    delta, lse_v = _varying(delta), _varying(lse)
+    qf, dof = _varying(qf), _varying(dof)
+
+    def step(carry, s):
+        k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry
+        src = (my - s) % sp
+        ks = k_blk.astype(f32)
+        vs = v_blk.astype(f32)
+        if group > 1:
+            ks = jnp.repeat(ks, group, axis=2)
+            vs = jnp.repeat(vs, group, axis=2)
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+            p = jnp.where(mask, jnp.exp(s_ - lse_v[..., None]), 0.0)
+        else:
+            p = jnp.exp(s_ - lse_v[..., None])
+        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
+        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        if group > 1:
+            dk_c = dk_c.reshape(b, lk, hkv, group, d).sum(3)
+            dv_c = dv_c.reshape(b, lk, hkv, group, d).sum(3)
+        dk_blk = dk_blk + dk_c
+        dv_blk = dv_blk + dv_c
+        return (lax.ppermute(k_blk, axis, fwd),
+                lax.ppermute(v_blk, axis, fwd),
+                lax.ppermute(dk_blk, axis, fwd),
+                lax.ppermute(dv_blk, axis, fwd),
+                dq_acc), None
+
+    zeros_kv = _varying(jnp.zeros((b, lk, hkv, d), f32))
+    dq0 = _varying(jnp.zeros((b, lq, h, d), f32))
+    (_, _, dk, dv, dq), _ = lax.scan(
+        step, (k, v, zeros_kv, zeros_kv, dq0), jnp.arange(sp))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+_ring_diff.defvjp(_ring_diff_fwd, _ring_diff_bwd)

@@ -13,6 +13,7 @@ CPU simulation of an 8-chip slice:
 """
 
 import argparse
+import os
 import time
 
 import numpy as np
@@ -173,7 +174,12 @@ def main():
     # Single chip: stay meshless so Pallas kernels (flash attention) can
     # engage — GSPMD cannot auto-partition Mosaic kernels, so any mesh
     # with auto axes (even size-1) forces the XLA attention fallback.
-    single = need == 1 and not explicit_dp
+    # HVDT_LM_SINGLE=0/false/off forces the island path on one chip
+    # (A/B measurement of meshless-vs-island compilation; example-local
+    # knob, deliberately not in the framework's config registry).
+    single = (need == 1 and not explicit_dp
+              and os.environ.get("HVDT_LM_SINGLE", "1").lower()
+              not in ("0", "false", "off"))
 
     # Parameter shardings from logical-axis rules (tp/pp/ep placement).
     if not single:

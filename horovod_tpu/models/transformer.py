@@ -184,7 +184,7 @@ def _attention(p, x, positions, cfg: TransformerConfig):
         # Manual island: the sequence dim is the local sp shard here (the
         # caller's shard_map over {'sp'} has already split it).
         o = ring_attention(q, k, v, axis="sp", causal=True)
-    elif _flash_enabled(l, dh):
+    elif _flash_enabled(l, dh, batch=b, heads=h):
         # Pallas fused attention on TPU: O(L·D) HBM traffic instead of a
         # materialized [B,H,L,L] score matrix (ops/pallas_kernels.py).
         from ..ops.pallas_kernels import flash_attention
@@ -204,9 +204,18 @@ def _attention(p, x, positions, cfg: TransformerConfig):
     return o.reshape(b, l, h * dh) @ p["wo"].astype(x.dtype)
 
 
-def _flash_enabled(seq_len: int, head_dim: int) -> bool:
-    """Flash kernel policy: HVDT_FLASH_ATTENTION=auto|on|off.  'auto'
-    (default) uses it on TPU when block shapes divide cleanly.
+def _flash_enabled(seq_len: int, head_dim: int, *, batch: int = 1,
+                   heads: int = 1) -> bool:
+    """Flash kernel policy: HVDT_FLASH_ATTENTION=auto|on|off.
+
+    'auto' (default) engages the kernel on TPU only when the
+    materialized-score path would be memory-heavy: the f32 score tensor
+    ``batch x heads x L x L`` at or past ~4 GB.  The kernel is a
+    CAPACITY play — measured on v5e (BERT-Large, docs/performance.md):
+    at seq 512 bs 128 (2.1 GB scores) XLA's fused attention is ~1.5x
+    faster than kernel-forward + blockwise backward, while at 4+ GB the
+    kernel admits 2x the batch and past ~8 GB XLA attention doesn't fit
+    at all.  'on' forces it whenever shapes tile.
 
     Regardless of mode, the kernel is OFF when the ambient mesh has
     GSPMD-auto axes: Mosaic kernels cannot be auto-partitioned ("wrap
@@ -229,7 +238,9 @@ def _flash_enabled(seq_len: int, head_dim: int) -> bool:
     shapes_ok = seq_len % min(128, seq_len) == 0 and seq_len >= 8
     if mode == "on":
         return shapes_ok
-    return shapes_ok and jax.devices()[0].platform == "tpu"
+    score_bytes = 4 * batch * heads * seq_len * seq_len
+    return (shapes_ok and score_bytes >= 4 * 1024 ** 3
+            and jax.devices()[0].platform == "tpu")
 
 
 def _mlp(p, x):

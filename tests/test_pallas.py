@@ -226,9 +226,9 @@ class TestFlashGradients:
         gate_args = []
         orig = tr._flash_enabled
 
-        def spy(l, dh):
+        def spy(l, dh, **kw):
             gate_args.append(l)
-            return orig(l, dh)
+            return orig(l, dh, **kw)
 
         monkeypatch.setattr(tr, "_flash_enabled", spy)
         cfg = TransformerConfig(vocab=128, layers=1, d_model=32, heads=2,

@@ -290,3 +290,37 @@ class TestParquetEstimator:
         with pytest.raises(ValueError, match="declarative estimator"):
             est.fit(ParquetSource(str(tmp_path / "x.parquet"),
                                   label_col="y"))
+
+
+class TestSplitAndShard:
+    """The shared estimator data discipline (estimator.split_and_shard)."""
+
+    def test_insufficient_train_rows_raises_clearly(self):
+        from horovod_tpu.orchestrate.estimator import split_and_shard
+
+        x = np.ones((8, 2))
+        y = np.ones((8,))
+        with pytest.raises(ValueError, match="TRAINING samples"):
+            split_and_shard(x, y, 0.7, 4)      # 2 train rows < 4 workers
+
+    def test_val_rows_never_contain_padding(self):
+        from horovod_tpu.orchestrate.estimator import split_and_shard
+
+        x = np.arange(10, dtype=np.float64)[:, None]
+        y = np.arange(10, dtype=np.float64)
+        xs, ys, xv, yv = split_and_shard(x, y, 0.2, 3)
+        val_rows = {float(v) for shard in xv for v in np.asarray(shard).ravel()}
+        assert val_rows == {8.0, 9.0}          # the global tail, only
+        # equalized train shards: identical lengths, only train values
+        lens = {len(s) for s in xs}
+        assert len(lens) == 1
+        train_vals = {float(v) for s in xs for v in np.asarray(s).ravel()}
+        assert train_vals <= set(map(float, range(8)))
+
+    def test_no_validation(self):
+        from horovod_tpu.orchestrate.estimator import split_and_shard
+
+        xs, ys, xv, yv = split_and_shard(np.ones((6, 1)), np.ones(6),
+                                         0.0, 2)
+        assert xv == [None, None] and yv == [None, None]
+        assert sum(len(s) for s in xs) == 6

@@ -121,7 +121,24 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
             out_specs=(P(), P(), P())),
             donate_argnums=(0, 1))
 
-    opt, step = build_step()
+    from horovod_tpu.autotune import autotuned_step
+
+    # Env-transparent autotune: `hvdtrun --autotune` exports
+    # HVDT_AUTOTUNE=1 and the wrapper engages by itself (zero-overhead
+    # passthrough otherwise); --autotune here just forces it on.  The
+    # builder records the optimizer each (re-)build so opt always is the
+    # instance the live step closes over.
+    built = {}
+
+    def builder(tb):
+        built["opt"], step_fn = build_step(tb)
+        return step_fn
+
+    step = autotuned_step(builder, tree_example=params,
+                          enabled=(True if args.autotune and use_shard
+                                   else None if use_shard else False),
+                          steps_per_sample=args.num_batches_per_iter)
+    opt = built["opt"]
     opt_state = opt.init(params)
     if use_shard:
         data = jax.device_put(data, NamedSharding(mesh, P("dp")))
@@ -135,13 +152,6 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
         print(f"Batch size: {global_batch} ({args.batch_size}/device, "
               f"{n_dev} devices)")
         print(f"Device: {dev.platform}:{dev.device_kind}")
-
-    autotuner = None
-    if args.autotune and use_shard:
-        from horovod_tpu.autotune import BenchmarkAutotuner
-
-        autotuner = BenchmarkAutotuner(
-            tree_example=params, steps_per_sample=args.num_batches_per_iter)
 
     def run_batches(n):
         nonlocal params, opt_state
@@ -161,20 +171,16 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
         rate = global_batch * args.num_batches_per_iter / dt
         if verbose:
             print(f"Iter #{i}: {rate:.1f} img/sec total")
-        if autotuner is not None and autotuner.record(
-                dt, steps=args.num_batches_per_iter):
-            _, step = build_step(autotuner.bucket_bytes)
-            if verbose:
-                print(f"  autotune -> bucket "
-                      f"{autotuner.bucket_bytes // 2**20} MiB")
+            if step.enabled and step.bucket_bytes:
+                print(f"  autotune bucket {step.bucket_bytes // 2**20} MiB")
         img_secs.append(rate)
 
     if verbose:
         mean, std = np.mean(img_secs), np.std(img_secs)
         print(f"Img/sec total: {mean:.1f} +- {1.96 * std:.1f}")
         print(f"Img/sec/device: {mean / n_dev:.1f}")
-    if autotuner is not None and verbose:
-        print(f"Autotune: {autotuner.summary()}")
+        if step.enabled:
+            print(f"Autotune: {step.summary()}")
     return float(np.mean(img_secs))
 
 

@@ -36,7 +36,7 @@ from .common.logging_util import get_logger
 log = get_logger(__name__)
 
 __all__ = ["GaussianProcess", "BayesianOptimizer", "ParameterManager",
-           "BenchmarkAutotuner"]
+           "BenchmarkAutotuner", "AutotunedStep", "autotuned_step"]
 
 
 class GaussianProcess:
@@ -342,3 +342,121 @@ def _tree_leaves(tree):
     import jax
 
     return jax.tree.leaves(tree)
+
+
+class AutotunedStep:
+    """Transparent env-driven engagement of the closed tuning loop.
+
+    The reference's autotuner engages for ANY training run when
+    ``HOROVOD_AUTOTUNE=1`` is set — no script changes (ref:
+    common/operations.cc:466-475 reads the env; :793-800 applies tuned
+    values inside the background loop).  Under XLA the fusion threshold is
+    a trace-time constant, so "apply" = re-jit: the engagement point is a
+    step *wrapper* owning the (re-)build::
+
+        step = hvd.autotune.autotuned_step(build_step)   # always
+        ...
+        params, opt_state, loss = step(params, opt_state, batch)
+
+    With ``HVDT_AUTOTUNE`` unset this is a zero-overhead passthrough
+    (``builder(None)`` once, direct dispatch).  With ``HVDT_AUTOTUNE=1``
+    (what ``hvdtrun --autotune`` exports) the wrapper times
+    steps_per_sample-step regions (closed by a host fetch of the
+    smallest output leaf — block_until_ready lies on tunnelled PJRT
+    backends), feeds :class:`BenchmarkAutotuner`, rebuilds the step via
+    ``builder(new_threshold_bytes)`` when the knobs move, KV-syncs rank
+    0's choice, and discards the first (compile-polluted) region after
+    every rebuild.
+
+    Args:
+      builder: ``builder(threshold_bytes | None) -> step_callable``.
+      tree_example: gradient-sized pytree for the bytes/sec score; when
+        None, the first positional arg of the first call is used.
+      enabled: force on/off; None (default) reads ``HVDT_AUTOTUNE``.
+    """
+
+    def __init__(self, builder, tree_example=None, *,
+                 enabled: Optional[bool] = None,
+                 steps_per_sample: Optional[int] = None,
+                 control_plane=None):
+        if enabled is None:
+            enabled = config.get_bool("HVDT_AUTOTUNE")
+        self.enabled = bool(enabled)
+        self._builder = builder
+        self._step = builder(None)
+        self._tree_example = tree_example
+        self._steps_per_sample = steps_per_sample
+        self._cp = control_plane
+        self._tuner: Optional[BenchmarkAutotuner] = None
+        self._t0: Optional[float] = None
+        self._pending = 0
+        self._skip_sample = False
+
+    @property
+    def autotuner(self) -> Optional[BenchmarkAutotuner]:
+        return self._tuner
+
+    @property
+    def bucket_bytes(self) -> Optional[int]:
+        return self._tuner.bucket_bytes if self._tuner else None
+
+    def summary(self) -> str:
+        if not self.enabled:
+            return "autotune disabled (HVDT_AUTOTUNE not set)"
+        return self._tuner.summary() if self._tuner else "no samples yet"
+
+    @staticmethod
+    def _fetch(out) -> None:
+        """Close the timed region with a device->host transfer that
+        data-depends on the step output (the smallest leaf).  Multi-host
+        arrays aren't fully addressable — np.asarray would raise — so
+        fetch an addressable shard instead."""
+        leaves = [l for l in _tree_leaves(out) if hasattr(l, "dtype")]
+        if not leaves:
+            return
+        smallest = min(leaves, key=lambda l: int(np.prod(
+            getattr(l, "shape", ()) or (1,))))
+        shards = getattr(smallest, "addressable_shards", None)
+        if shards:
+            np.asarray(shards[0].data)
+        else:
+            np.asarray(smallest)
+
+    def __call__(self, *args, **kwargs):
+        if not self.enabled:
+            return self._step(*args, **kwargs)
+        if self._tuner is None:
+            tree = (self._tree_example if self._tree_example is not None
+                    else (args[0] if args else ()))
+            self._tuner = BenchmarkAutotuner(
+                tree_example=tree, steps_per_sample=self._steps_per_sample,
+                control_plane=self._cp)
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        out = self._step(*args, **kwargs)
+        self._pending += 1
+        if self._pending >= self._tuner.pm.steps_per_sample:
+            self._fetch(out)
+            dt = time.perf_counter() - self._t0
+            if self._skip_sample:
+                # Region included a re-jit: compile time would poison the
+                # new point's score — discard, measure the next region.
+                self._skip_sample = False
+            elif self._tuner.record(dt, steps=self._pending):
+                self._step = self._builder(self._tuner.bucket_bytes)
+                self._skip_sample = True
+                log.info("autotune applied: bucket=%d MiB",
+                         self._tuner.bucket_bytes // 2 ** 20)
+            self._pending = 0
+            self._t0 = None
+        return out
+
+
+def autotuned_step(builder, tree_example=None, *,
+                   enabled: Optional[bool] = None,
+                   steps_per_sample: Optional[int] = None,
+                   control_plane=None) -> AutotunedStep:
+    """See :class:`AutotunedStep` — the ``HVDT_AUTOTUNE`` engagement."""
+    return AutotunedStep(builder, tree_example, enabled=enabled,
+                         steps_per_sample=steps_per_sample,
+                         control_plane=control_plane)

@@ -70,6 +70,12 @@ def _to_np(t) -> np.ndarray:
 def _from_np(a: np.ndarray, like):
     if hasattr(like, "asnumpy"):
         mx = _mx()
+        # Preserve the input's device: without ctx= the result lands on
+        # the default CPU context even for a GPU NDArray input (the torch
+        # binding raises for non-CPU instead; here mxnet can round-trip).
+        ctx = getattr(like, "context", None)
+        if ctx is not None:
+            return mx.nd.array(a, dtype=a.dtype, ctx=ctx)
         return mx.nd.array(a, dtype=a.dtype)
     return a
 

@@ -180,16 +180,35 @@ def _attention(p, x, positions, cfg: TransformerConfig):
     v = (x @ p["wv"].astype(x.dtype)).reshape(b, l, hk, dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
+    flash_plan = None if cfg.sp > 1 else _flash_plan(b, l, h, hk, dh)
     if cfg.sp > 1:
         # Manual island: the sequence dim is the local sp shard here (the
         # caller's shard_map over {'sp'} has already split it).
         o = ring_attention(q, k, v, axis="sp", causal=True)
-    elif _flash_enabled(l, dh, batch=b, heads=h):
+    elif flash_plan == "direct":
         # Pallas fused attention on TPU: O(L·D) HBM traffic instead of a
         # materialized [B,H,L,L] score matrix (ops/pallas_kernels.py).
         from ..ops.pallas_kernels import flash_attention
 
         o = flash_attention(q, k, v, causal=True)
+    elif flash_plan is not None:
+        # GSPMD-auto mesh: Mosaic kernels can't be auto-partitioned, so
+        # open a manual shard_map island over the batch (dp/fsdp) and
+        # heads (tp) axes and run the kernel on the local shard — the
+        # multi-chip engagement the auto gate alone would refuse (the
+        # role of the reference's in-graph custom-call path, ref:
+        # tensorflow/xla_mpi_ops.cc:165-235 "collectives/kernels live
+        # inside the compiled program").
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.pallas_kernels import flash_attention
+
+        dp_axes, tp_ax, names = flash_plan
+        spec = P(dp_axes if dp_axes else None, None, tp_ax, None)
+        o = jax.shard_map(
+            functools.partial(flash_attention, causal=True),
+            in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names=names)(q, k, v)
     else:
         scale = dh ** -0.5
         if h != hk:
@@ -217,21 +236,10 @@ def _flash_enabled(seq_len: int, head_dim: int, *, batch: int = 1,
     kernel admits 2x the batch and past ~8 GB XLA attention doesn't fit
     at all.  'on' forces it whenever shapes tile.
 
-    Regardless of mode, the kernel is OFF when the ambient mesh has
-    GSPMD-auto axes: Mosaic kernels cannot be auto-partitioned ("wrap
-    the call in a shard_map"), so under a partially-manual island (e.g.
-    the hybrid dp x tp x pp example) attention falls back to XLA —
-    engage the kernel from meshless jit (single chip) or fully-manual
-    shard_map contexts."""
+    ``batch``/``heads`` are the sizes the kernel will actually see —
+    pass LOCAL (per-shard) sizes when the call site shards them."""
     from ..common import config
 
-    try:
-        am = jax.sharding.get_abstract_mesh()
-        if not am.empty and any(t == jax.sharding.AxisType.Auto
-                                for t in am.axis_types):
-            return False
-    except Exception:       # pragma: no cover - very old jax
-        pass
     mode = config.get_str("HVDT_FLASH_ATTENTION").lower()
     if mode == "off":
         return False
@@ -241,6 +249,51 @@ def _flash_enabled(seq_len: int, head_dim: int, *, batch: int = 1,
     score_bytes = 4 * batch * heads * seq_len * seq_len
     return (shapes_ok and score_bytes >= 4 * 1024 ** 3
             and jax.devices()[0].platform == "tpu")
+
+
+def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
+    """Decide how the flash kernel can engage under the ambient mesh.
+
+    Returns "direct" (call the kernel as-is: no mesh, or every mesh axis
+    already manual here), a ``(dp_axes, tp_axis)`` island plan (the mesh
+    has GSPMD-auto axes — run the kernel inside a partial-manual
+    shard_map over those axes; Mosaic kernels cannot be auto-partitioned
+    by GSPMD), or None (fall back to XLA attention).  The memory policy
+    (_flash_enabled) is evaluated on the per-shard shapes the kernel
+    would actually see."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        auto = ([n for n, t in zip(am.axis_names, am.axis_types)
+                 if t == jax.sharding.AxisType.Auto]
+                if not am.empty else [])
+    except Exception:       # pragma: no cover - very old jax
+        auto = []
+    if not auto:
+        return "direct" if _flash_enabled(l, dh, batch=b, heads=h) else None
+    # Shard batch over dp-like axes and heads over tp, where divisible.
+    dp_axes: Tuple[str, ...] = tuple(a for a in ("dp", "fsdp")
+                                     if a in auto)
+    while dp_axes and b % int(np.prod([am.shape[a] for a in dp_axes])):
+        dp_axes = dp_axes[:-1]
+    dp_size = int(np.prod([am.shape[a] for a in dp_axes])) if dp_axes else 1
+    tp_ax = "tp" if "tp" in auto else None
+    if tp_ax and (h % am.shape[tp_ax] or hk % am.shape[tp_ax]):
+        tp_ax = None
+    tp_size = am.shape[tp_ax] if tp_ax else 1
+    # Any OTHER size>1 auto axis (e.g. an auto axis sharding the
+    # sequence) means the island's replicated in_specs would force a
+    # full-sequence all-gather per layer — don't engage the kernel there.
+    # Size-1 leftovers are included in the island instead: Mosaic refuses
+    # to lower while ANY auto axis is ambient, even a trivial one.
+    leftover = [a for a in auto if a not in dp_axes and a != tp_ax]
+    if any(am.shape[a] > 1 for a in leftover):
+        return None
+    if not _flash_enabled(l, dh, batch=max(1, b // dp_size),
+                          heads=max(1, h // tp_size)):
+        return None
+    names = frozenset(dp_axes) | ({tp_ax} if tp_ax else set()) | \
+        frozenset(leftover)
+    return (dp_axes, tp_ax, names)
 
 
 def _mlp(p, x):

@@ -57,7 +57,7 @@ class RayExecutor:
                  elastic_timeout: int = 600,
                  override_discovery: bool = True,
                  env: Optional[Dict[str, str]] = None,
-                 coordinator_port: int = 29500):
+                 coordinator_port: Optional[int] = None):
         if num_workers is None:
             if num_hosts and num_workers_per_host:
                 num_workers = num_hosts * num_workers_per_host
@@ -148,10 +148,33 @@ class RayExecutor:
 
                 return _ray.util.get_node_ip_address()
 
+            def reserve_coordinator_port(self):
+                # Ephemeral port on THIS actor's node for the JAX
+                # coordination service — a process-wide fixed default
+                # (29500) collides when two jobs share a node or a stale
+                # coordinator lingers.  The socket is HELD OPEN (with
+                # SO_REUSEADDR so the coordinator can bind it later)
+                # until setup(), shrinking the window in which the OS
+                # could hand the port to another process.
+                import socket as _socket
+
+                s = _socket.socket()
+                s.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+                s.bind(("", 0))
+                self._reserved_port_sock = s
+                return s.getsockname()[1]
+
             def setup(self, env, has_payload):
                 import os
 
                 os.environ.update(env)
+                # Release the reserved coordinator port just before
+                # anything (hvd.init in the payload ctor or in run'd
+                # fns) binds it.
+                sock = getattr(self, "_reserved_port_sock", None)
+                if sock is not None:
+                    sock.close()
+                    self._reserved_port_sock = None
                 if has_payload:
                     self.payload = cls(*args, **kwargs)
                 return True
@@ -208,10 +231,11 @@ class RayExecutor:
                 "HVDT_RENDEZVOUS_ADDR": addr,
                 "HVDT_RENDEZVOUS_PORT": str(port),
                 "HVDT_SECRET": self._ray_kv.secret.hex(),
-                # JAX coordination service: rank 0's node at the
-                # configured port (ref contract: runner/launch.py:216).
+                # JAX coordination service: rank 0's node, at an ephemeral
+                # port reserved by the rank-0 actor unless the caller
+                # pinned one (ref contract: runner/launch.py:216).
                 "HVDT_COORDINATOR_ADDR":
-                    f"{ips[0]}:{self._coordinator_port}",
+                    f"{ips[0]}:{self._resolve_coordinator_port(ray)}",
             }
             ray.get([
                 w.setup.remote(
@@ -225,6 +249,13 @@ class RayExecutor:
             self._ray_kv = None
             self._ray_workers = []
             raise
+
+    def _resolve_coordinator_port(self, ray) -> int:
+        if self._coordinator_port is not None:
+            return self._coordinator_port
+        return ray.get(
+            self._ray_workers[0].reserve_coordinator_port.remote(),
+            timeout=self.settings.start_timeout)
 
     def run(self, fn: Callable, args: Sequence = (),
             kwargs: Optional[Dict] = None) -> List[Any]:

@@ -28,6 +28,7 @@ import os
 import queue
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = ["run"]
@@ -96,6 +97,13 @@ def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
         rank = ctx.partitionId()
         addresses = [i.address for i in ctx.getTaskInfos()]
         os.environ.update(_task_env(rank, addresses, base_env, extra_env))
+        # Tell the driver this rank was actually scheduled: startup is
+        # bounded by start_timeout on the driver side, and a barrier stage
+        # the cluster cannot schedule must fail fast there, not after the
+        # (long) run timeout (ref: spark/runner.py start_timeout rationale).
+        from ..runner.http_kv import KVClient
+
+        KVClient.from_env(os.environ).put(f"/spark/started/{rank}", b"1")
         # All ranks enter together (mirrors the reference's registration
         # barrier before launching the job).
         ctx.barrier()
@@ -117,16 +125,44 @@ def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
     t = threading.Thread(target=_collect, daemon=True)
     t.start()
     try:
-        status, payload = result_q.get(timeout=start_timeout +
-                                       float(os.getenv(
-                                           "HVDT_SPARK_RUN_TIMEOUT", "86400")))
-    except queue.Empty:
-        sc.cancelJobGroup(job_group)
-        raise TimeoutError(
-            f"Spark job made no progress within the timeout; cancelled "
-            f"job group {job_group}. Check that the cluster has "
-            f"{num_proc} simultaneously schedulable tasks (barrier mode "
-            "needs all of them at once).")
+        # Phase 1 — startup, bounded by start_timeout on its own: poll for
+        # either an (early) result or every rank's /spark/started/<r> flag.
+        # A barrier stage the cluster cannot schedule (busy slots, dynamic
+        # allocation) fails HERE with a scheduling message instead of
+        # hanging until the run timeout.
+        deadline = time.monotonic() + start_timeout
+        status = payload = None
+        while True:
+            try:
+                status, payload = result_q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                pass
+            if all(server.get_local(f"/spark/started/{r}") is not None
+                   for r in range(num_proc)):
+                break
+            if time.monotonic() > deadline:
+                sc.cancelJobGroup(job_group)
+                started = [r for r in range(num_proc)
+                           if server.get_local(f"/spark/started/{r}")
+                           is not None]
+                raise TimeoutError(
+                    f"Only {len(started)}/{num_proc} Spark barrier tasks "
+                    f"started within start_timeout={start_timeout}s; "
+                    f"cancelled job group {job_group}. Check that the "
+                    f"cluster has {num_proc} simultaneously schedulable "
+                    "tasks (barrier mode needs all of them at once).")
+        # Phase 2 — the run itself, bounded by the (long) run timeout.
+        if status is None:
+            try:
+                status, payload = result_q.get(timeout=float(
+                    os.getenv("HVDT_SPARK_RUN_TIMEOUT", "86400")))
+            except queue.Empty:
+                sc.cancelJobGroup(job_group)
+                raise TimeoutError(
+                    f"Spark job started but produced no result within "
+                    f"HVDT_SPARK_RUN_TIMEOUT; cancelled job group "
+                    f"{job_group}.")
     finally:
         server.stop()
     if status == "err":

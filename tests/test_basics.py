@@ -107,3 +107,17 @@ def test_reference_example_api_surface():
               "elastic", "run", "is_initialized", "shutdown",
               "sparse_allreduce", "sparse_allreduce_async"):
         assert hasattr(hvd, n), n
+
+
+def test_private_distributed_api_resolves():
+    """The orderly-teardown barrier (common/basics.py
+    _sync_distributed_teardown) leans on jax._src.distributed.global_state
+    — a private API. If a jax upgrade moves it, teardown silently reverts
+    to the racy exit path; fail HERE instead so the pin is visible."""
+    from jax._src import distributed as _jd
+
+    gs = _jd.global_state
+    # `client` is None in a non-distributed process, but the attribute
+    # access path itself must resolve (hasattr on the instance would hide
+    # a renamed slot behind __getattr__-less AttributeError).
+    assert hasattr(gs, "client")

@@ -289,34 +289,38 @@ class TestFlashGradients:
 
 
 class TestFlashMeshGate:
-    def test_auto_mesh_axes_disable_flash(self, monkeypatch):
+    def test_auto_mesh_axes_route_to_island(self, monkeypatch):
         """Mosaic kernels can't be GSPMD-auto-partitioned: under a
-        partially-manual island (auto dp axis present) the gate must
-        force the XLA fallback even with HVDT_FLASH_ATTENTION=on."""
+        partially-manual context (auto dp axis present) the plan must
+        route through a shard_map island — never "direct" — and from a
+        fully-manual context the kernel may run directly."""
         from jax.sharding import Mesh, PartitionSpec as P
 
         import horovod_tpu.models.transformer as tr
 
         monkeypatch.setenv("HVDT_FLASH_ATTENTION", "on")
-        assert tr._flash_enabled(128, 32)          # no mesh: on
+        assert tr._flash_plan(2, 128, 4, 4, 32) == "direct"   # no mesh
 
         mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
                     ("dp", "sp"))
         seen = {}
 
         def probe(x):
-            seen["enabled"] = tr._flash_enabled(128, 32)
+            seen["plan"] = tr._flash_plan(2, 128, 4, 4, 32)
             return x
 
         jax.jit(jax.shard_map(probe, mesh=mesh, in_specs=P(),
                               out_specs=P(), axis_names={"sp"}))(
             jnp.ones(4))
-        assert seen["enabled"] is False            # dp is Auto
+        # dp is Auto: not direct — an island over the auto axis.
+        assert seen["plan"] != "direct" and seen["plan"] is not None
+        dp_axes, tp_ax, names = seen["plan"]
+        assert names == frozenset({"dp"})
 
         def probe2(x):
-            seen["manual"] = tr._flash_enabled(128, 32)
+            seen["manual"] = tr._flash_plan(2, 128, 4, 4, 32)
             return x
 
         jax.jit(jax.shard_map(probe2, mesh=mesh, in_specs=P(),
                               out_specs=P()))(jnp.ones(4))
-        assert seen["manual"] is True              # fully manual: on
+        assert seen["manual"] == "direct"          # fully manual: direct

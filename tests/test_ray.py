@@ -119,12 +119,30 @@ class TestRayBranch:
             assert all(e["HVDT_RENDEZVOUS_PORT"] for e in envs)
             assert all(e["HVDT_SECRET"] for e in envs)
             # JAX coordination service at rank 0's node: without this,
-            # hvd.init() in actors would come up as size-1 islands.
+            # hvd.init() in actors would come up as size-1 islands.  The
+            # port is reserved by the rank-0 actor (ephemeral, not a fixed
+            # default that collides across concurrent jobs on one node).
+            addrs = {e["HVDT_COORDINATOR_ADDR"] for e in envs}
+            assert len(addrs) == 1
+            host, port = addrs.pop().rsplit(":", 1)
+            assert host == "10.0.0.1" and 1024 <= int(port) <= 65535
+            assert any(name == "reserve_coordinator_port"
+                       for name, _, _ in ray_stub.calls)
+        finally:
+            ex.shutdown()
+        assert ex._ray_kv is None
+
+    def test_pinned_coordinator_port(self, ray_stub):
+        from horovod_tpu.orchestrate import RayExecutor
+
+        ex = RayExecutor(num_workers=2, coordinator_port=29500)
+        ex.start()
+        try:
+            envs = _setup_envs(ray_stub)
             assert all(e["HVDT_COORDINATOR_ADDR"] == "10.0.0.1:29500"
                        for e in envs)
         finally:
             ex.shutdown()
-        assert ex._ray_kv is None
 
     def test_run_dispatches_through_actors(self, ray_stub, monkeypatch):
         from horovod_tpu.orchestrate import RayExecutor

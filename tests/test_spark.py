@@ -94,6 +94,17 @@ class _StubContext:
         self.cancelled.append(group)
 
 
+@pytest.fixture(autouse=True)
+def _env_guard():
+    """Stub barrier tasks run in THIS process and os.environ.update a full
+    HVDT_* contract: restore os.environ so no stale rank/rendezvous leaks
+    into later tests (same guard as tests/test_ray.py)."""
+    before = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(before)
+
+
 @pytest.fixture()
 def spark_stub(monkeypatch):
     mod = types.ModuleType("pyspark")

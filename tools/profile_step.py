@@ -1,0 +1,219 @@
+"""XPlane step profiler: where does a jitted TPU step spend its time?
+
+Captures a ``jax.profiler.trace`` of a few training steps and aggregates
+the device plane's XLA-op events into a per-op/per-category table —
+the TPU analog of the reference's NVTX+nvprof workflow (ref:
+horovod/common/nvtx/nvtx_op_range.h + docs/timeline.rst describe the
+same "which op eats the step" question for CUDA).
+
+Usage:
+  python tools/profile_step.py --model resnet --batch-size 128 --steps 3
+  python tools/profile_step.py --model lm --batch-size 8 --steps 3
+
+The parser is generic: ``aggregate(xplane_path)`` works on any capture
+(the proto comes from tensorflow.tsl, present in this image; jax writes
+the .xplane.pb file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                                os.pardir)))
+
+
+def capture(fn, steps: int, trace_dir: str | None = None) -> str:
+    """Run ``fn()`` ``steps`` times under the profiler; return the
+    .xplane.pb path. ``fn`` must end with a host fetch so device work for
+    each step is inside the trace window."""
+    import jax
+
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="hvdt_trace_")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            fn()
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise RuntimeError(f"no .xplane.pb under {trace_dir}")
+    return paths[-1]
+
+
+def _load_planes(xplane_path: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(xplane_path, "rb") as f:
+        space.ParseFromString(f.read())
+    return space.planes
+
+
+def aggregate(xplane_path: str, device_substr: str = "TPU"):
+    """Aggregate device-plane events: returns (per_op, per_category,
+    busy_ps, span_ps) where per_op maps op name ->
+    dict(dur_ps, count, category, bytes_accessed)."""
+    planes = _load_planes(xplane_path)
+    dev = None
+    for p in planes:
+        if device_substr in p.name and "Host" not in p.name:
+            # Prefer the op-level plane (has XLA op events).
+            if dev is None or len(p.lines) > len(dev.lines):
+                dev = p
+    if dev is None:
+        raise RuntimeError(
+            f"no device plane matching {device_substr!r}; planes: "
+            f"{[p.name for p in planes]}")
+
+    stat_names = {m.id: m.name for m in dev.stat_metadata.values()}
+    ev_meta = {m.id: m for m in dev.event_metadata.values()}
+
+    per_op = collections.defaultdict(
+        lambda: {"dur_ps": 0, "count": 0, "category": "",
+                 "bytes_accessed": 0})
+    span_lo, span_hi = None, 0
+    # Only aggregate op-level lines; module-level lines double-count.
+    op_lines = [ln for ln in dev.lines
+                if "XLA Op" in ln.name or "XLA Ops" in ln.name]
+    if not op_lines:
+        op_lines = list(dev.lines)
+    for ln in op_lines:
+        for ev in ln.events:
+            md = ev_meta.get(ev.metadata_id)
+            name = md.name if md else f"op_{ev.metadata_id}"
+            rec = per_op[name]
+            rec["dur_ps"] += ev.duration_ps
+            rec["count"] += 1
+            lo = ev.offset_ps
+            hi = ev.offset_ps + ev.duration_ps
+            span_lo = lo if span_lo is None else min(span_lo, lo)
+            span_hi = max(span_hi, hi)
+            stats = list(ev.stats) + (list(md.stats) if md else [])
+            for st in stats:
+                sname = stat_names.get(st.metadata_id, "")
+                if sname in ("hlo_category", "category"):
+                    rec["category"] = (st.str_value
+                                       or rec["category"])
+                elif sname in ("bytes_accessed", "bytes accessed"):
+                    rec["bytes_accessed"] += (st.uint64_value
+                                              or st.int64_value)
+    per_cat = collections.defaultdict(lambda: {"dur_ps": 0, "count": 0})
+    busy = 0
+    for name, rec in per_op.items():
+        cat = rec["category"] or _guess_category(name)
+        per_cat[cat]["dur_ps"] += rec["dur_ps"]
+        per_cat[cat]["count"] += rec["count"]
+        rec["category"] = cat
+        busy += rec["dur_ps"]
+    span = (span_hi - (span_lo or 0)) if span_hi else 0
+    return dict(per_op), dict(per_cat), busy, span
+
+
+def _guess_category(name: str) -> str:
+    n = name.lower()
+    for key, cat in (("conv", "convolution"), ("fusion", "fusion"),
+                     ("dot", "dot"), ("copy", "copy"),
+                     ("all-reduce", "collective"),
+                     ("reduce", "reduce"), ("transpose", "transpose")):
+        if key in n:
+            return cat
+    return "other"
+
+
+def report(per_op, per_cat, busy_ps, span_ps, steps: int, top: int = 25,
+           out=sys.stdout):
+    def pct(x):
+        return 100.0 * x / busy_ps if busy_ps else 0.0
+
+    print(f"trace span {span_ps / 1e9:.2f} ms, device busy "
+          f"{busy_ps / 1e9:.2f} ms "
+          f"({100.0 * busy_ps / span_ps if span_ps else 0:.1f}% occupancy), "
+          f"{steps} steps -> {busy_ps / 1e9 / steps:.2f} ms busy/step",
+          file=out)
+    print("\nby category:", file=out)
+    for cat, rec in sorted(per_cat.items(), key=lambda kv: -kv[1]["dur_ps"]):
+        print(f"  {cat:<22} {rec['dur_ps'] / 1e9:8.2f} ms "
+              f"{pct(rec['dur_ps']):5.1f}%  n={rec['count']}", file=out)
+    print(f"\ntop {top} ops:", file=out)
+    for name, rec in sorted(per_op.items(),
+                            key=lambda kv: -kv[1]["dur_ps"])[:top]:
+        extra = (f" bytes={rec['bytes_accessed'] / 1e6:.0f}MB"
+                 if rec["bytes_accessed"] else "")
+        print(f"  {rec['dur_ps'] / 1e9:8.2f} ms {pct(rec['dur_ps']):5.1f}% "
+              f"x{rec['count']:<4} [{rec['category']}] {name[:90]}{extra}",
+              file=out)
+
+
+def _build_resnet_step(batch_size: int):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import (ResNetConfig, resnet50_init,
+                                    resnet_loss)
+
+    cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16)
+    params, stats = resnet50_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.sgd(0.01, momentum=0.9)
+    opt_state = opt.init(params)
+    images = jax.random.normal(jax.random.PRNGKey(1),
+                               (batch_size, 224, 224, 3), jnp.bfloat16)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch_size,),
+                                0, 1000)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, stats, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            resnet_loss, has_aux=True)(params, stats, images, labels, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, \
+            loss
+
+    state = [params, stats, opt_state]
+
+    def run_one():
+        p, s, o, loss = step(state[0], state[1], state[2], images, labels)
+        state[0], state[1], state[2] = p, s, o
+        float(loss)   # host fetch: device completion inside the window
+
+    # warmup/compile outside the trace
+    run_one()
+    return run_one
+
+
+def _build_lm_step(batch_size: int):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "examples"))
+    raise SystemExit("lm profiling: use examples/jax_transformer_lm.py "
+                     "--profile instead")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet", choices=["resnet", "lm"])
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--xplane", help="skip capture; parse this file")
+    args = ap.parse_args()
+
+    if args.xplane:
+        path = args.xplane
+    else:
+        fn = (_build_resnet_step(args.batch_size) if args.model == "resnet"
+              else _build_lm_step(args.batch_size))
+        path = capture(fn, args.steps)
+        print(f"xplane: {path}", file=sys.stderr)
+    per_op, per_cat, busy, span = aggregate(path)
+    report(per_op, per_cat, busy, span, args.steps, args.top)
+
+
+if __name__ == "__main__":
+    main()

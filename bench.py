@@ -144,12 +144,36 @@ def _run_child(args) -> None:
     assert mfu is None or mfu <= 1.0, (
         f"measured MFU {mfu:.2f} > 1 is physically impossible — timing did "
         "not actually wait for device completion")
-    # Roofline diagnosis: estimated HBM bandwidth fraction (why MFU stops
-    # where it does — see docs/performance.md).  XLA's "bytes accessed"
-    # counts operand bytes, an UPPER BOUND on physical HBM traffic
-    # (VMEM-resident reuse isn't subtracted), so clamp to 1.0.
-    hbm_util = (min(steps_per_s * bytes_per_step / peak_bw, 1.0)
-                if peak_bw and bytes_per_step else None)
+    # Roofline diagnosis: HBM bandwidth fraction (why MFU stops where it
+    # does — see docs/performance.md).  Two numbers, both labelled by
+    # method:
+    #   * hbm_util — XPlane-profiled: per-op bytes capped at what the
+    #     op's duration could physically move (compute-bound ops
+    #     contribute their real bytes, bandwidth-bound ops at most
+    #     peak*dur), summed over a 3-step trace.  XLA's raw "bytes
+    #     accessed" is an operand-bytes UPPER BOUND (VMEM reuse isn't
+    #     subtracted); the per-op duration cap removes its worst
+    #     overcount instead of clamping the aggregate to 1.0.
+    #   * hbm_util_est_upper — the uncapped cost-analysis aggregate, for
+    #     reference (may exceed 1.0 by construction).
+    hbm_util = hbm_method = None
+    est_upper = (steps_per_s * bytes_per_step / peak_bw
+                 if peak_bw and bytes_per_step else None)
+    if peak_bw and os.environ.get("HVDT_BENCH_PROFILE", "1") not in (
+            "0", "false", "off"):
+        try:
+            # Capped at 1.0: the per-op duration cap makes >1 possible
+            # only when profiler overhead inflates traced durations
+            # relative to the untraced timing loop — unphysical, clamp.
+            hbm_util = min(1.0, _profiled_hbm_util(
+                compiled, params, stats, opt_state, images,
+                labels, steps_per_s, peak_bw))
+            hbm_method = "xplane_per_op_bw_capped"
+        except Exception as e:   # profiling must never sink the bench
+            print(f"hbm profile skipped: {e!r}", file=sys.stderr)
+    if hbm_util is None and est_upper is not None:
+        hbm_util = min(est_upper, 1.0)
+        hbm_method = "xla_cost_analysis_upper_bound_clamped"
     print(f"img/sec per iter: {[round(r, 1) for r in rates]} "
           f"(+-{float(np.std(rates)):.1f}); final loss {float(loss):.3f}; "
           f"flops/step {flops_per_step:.3e}", file=sys.stderr)
@@ -162,8 +186,42 @@ def _run_child(args) -> None:
         "device_kind": dev.device_kind,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
+        "hbm_util_method": hbm_method,
+        "hbm_util_est_upper": (round(est_upper, 4)
+                               if est_upper is not None else None),
         "batch_size": args.batch_size,
     }))
+
+
+def _profiled_hbm_util(compiled, params, stats, opt_state, images,
+                       labels, steps_per_s, peak_bw) -> float:
+    """Capture a 3-step XPlane trace and estimate achieved HBM
+    bandwidth utilization: sum over ops of min(cost-analysis bytes,
+    duration * peak_bw), normalized by measured step time * peak_bw."""
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from profile_step import aggregate, capture
+
+    n = 3
+    state = [params, stats, opt_state]
+
+    def one():
+        p, s, o, loss = compiled(state[0], state[1], state[2], images,
+                                 labels)
+        state[0], state[1], state[2] = p, s, o
+        float(loss)
+
+    path = capture(one, n, tempfile.mkdtemp(prefix="hvdt_bench_prof_"))
+    per_op, _cat, _busy, _span = aggregate(path)
+    moved = 0.0
+    for rec in per_op.values():
+        if rec["bytes_accessed"]:
+            moved += min(float(rec["bytes_accessed"]),
+                         rec["dur_ps"] / 1e12 * peak_bw)
+    bytes_per_step = moved / n
+    return bytes_per_step * steps_per_s / peak_bw
 
 
 def _spawn(child_args, timeout_s, cpu_only=False):

@@ -34,7 +34,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["flash_attention", "flash_block_update", "attention_reference"]
+__all__ = ["flash_attention", "flash_block_update", "flash_grad_block",
+           "attention_reference"]
 
 _NEG_INF = -1e30
 
@@ -380,6 +381,211 @@ def flash_block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
         qt, kt, vt, acc_t, m_t, l_t, q_offset, k_offset, causal=causal,
         scale=scale, block_q=block_q, block_k=block_k)
     return (acc_t.transpose(0, 2, 1, 3), m_t[..., 0], l_t[..., 0])
+
+
+def _dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, dl_ref,
+               lse_ref, dq_ref, dq_s, *, causal: bool, scale: float):
+    """Grid (b, h, iq, ik), ik innermost: dq tile accumulated in VMEM
+    scratch while K/V/dO stream; flushed at the last ik.  Standard flash
+    backward dq pass with the saved logsumexp making the score recompute
+    exact."""
+    import jax.experimental.pallas as pl
+
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[0, 0, :, :])
+        if causal:
+            q_pos = (qo_ref[0] + iq * bq
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+            k_pos = (ko_ref[0] + ik * bk
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0, :, :]) * scale
+        dq_s[...] += jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last_q = qo_ref[0] + iq * bq + (bq - 1)
+        first_k = ko_ref[0] + ik * bk
+        pl.when(last_q >= first_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        dq_ref[0, 0, :, :] = dq_s[...]
+
+
+def _dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, dl_ref,
+                lse_ref, dk_ref, dv_ref, dk_s, dv_s, *, causal: bool,
+                scale: float):
+    """Grid (b, h, ik, iq), iq innermost: dk/dv tiles accumulated in VMEM
+    scratch while Q/dO stream past the resident K/V block."""
+    import jax.experimental.pallas as pl
+
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        kb = k_ref[0, 0, :, :]
+        vb = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse_ref[0, 0, :, :])
+        if causal:
+            q_pos = (qo_ref[0] + iq * bq
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+            k_pos = (ko_ref[0] + ik * bk
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        pb = p.astype(do.dtype)
+        dv_s[...] += jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dl_ref[0, 0, :, :]) * scale
+        dk_s[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last_q = qo_ref[0] + iq * bq + (bq - 1)
+        first_k = ko_ref[0] + ik * bk
+        pl.when(last_q >= first_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _flush():
+        dk_ref[0, 0, :, :] = dk_s[...]
+        dv_ref[0, 0, :, :] = dv_s[...]
+
+
+def flash_grad_block(q, k, v, do, out, lse, *, q_offset=0, k_offset=0,
+                     causal: bool = True, scale: Optional[float] = None,
+                     block_q: int = 512, block_k: int = 512,
+                     delta: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pallas flash backward for one (Q block x K/V block) pair.
+
+    The gradient counterpart of :func:`flash_block_update` — the piece
+    that makes the Pallas ring-attention path trainable (VERDICT r2 #4):
+    ``parallel/ring_attention.py`` calls it once per ring step with the
+    visiting K/V block and its global offset, accumulating dK/dV that
+    travel with the block.  Also usable as a whole-sequence flash
+    backward (q_offset=k_offset=0).
+
+    Layout matches the framework: q/do/out [B, Lq, H, D]; k/v
+    [B, Lk, Hkv, D] (GQA: dk/dv are group-summed here); lse [B, H, Lq].
+    Returns (dq, dk, dv) in f32.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, lq, h, d = q.shape
+    lk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+    block_q = _fit_block(lq, block_q, q.dtype)
+    block_k = _fit_block(lk, block_k, k.dtype, v.dtype)
+
+    if delta is None:
+        delta = jnp.einsum("bqhd,bqhd->bqh", do, out,
+                           preferred_element_type=jnp.float32)  # [B,Lq,H]
+        delta = delta.transpose(0, 2, 1)                        # [B,H,Lq]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = do.transpose(0, 2, 1, 3)
+    dl = delta[..., None]                                       # [B,H,Lq,1]
+    lse_c = lse[..., None]                                      # [B,H,Lq,1]
+
+    vma = frozenset()
+    for op in (q, k, v, do, lse):
+        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
+    kw = {"vma": vma} if vma else {}
+
+    qspec = pl.BlockSpec((1, 1, block_q, d),
+                         lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
+    kvspec = pl.BlockSpec((1, 1, block_k, d),
+                          lambda bb, hh, qq, kk, *_: (bb, hh // group, kk, 0))
+    col_q = pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
+
+    dq, = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=float(scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, lq // block_q, lk // block_k),
+            in_specs=[qspec, kvspec, kvspec, qspec, col_q, col_q],
+            out_specs=[qspec],
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]),
+        out_shape=(jax.ShapeDtypeStruct((b, h, lq, d), jnp.float32, **kw),),
+        interpret=_use_interpret(),
+    )(jnp.atleast_1d(q_offset).astype(jnp.int32),
+      jnp.atleast_1d(k_offset).astype(jnp.int32),
+      qt, kt, vt, dot, dl, lse_c)
+
+    # dkv pass: grid loops K blocks outer, Q blocks inner.  BlockSpec
+    # index maps receive (bb, hh, kk, qq).
+    qspec2 = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bb, hh, kk, qq, *_: (bb, hh, qq, 0))
+    kvspec2 = pl.BlockSpec((1, 1, block_k, d),
+                           lambda bb, hh, kk, qq, *_:
+                           (bb, hh // group, kk, 0))
+    kvout2 = pl.BlockSpec((1, 1, block_k, d),
+                          lambda bb, hh, kk, qq, *_: (bb, hh, kk, 0))
+    col_q2 = pl.BlockSpec((1, 1, block_q, 1),
+                          lambda bb, hh, kk, qq, *_: (bb, hh, qq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=float(scale)),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, lk // block_k, lq // block_q),
+            in_specs=[qspec2, kvspec2, kvspec2, qspec2, col_q2, col_q2],
+            out_specs=[kvout2, kvout2],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)]),
+        out_shape=(jax.ShapeDtypeStruct((b, h, lk, d), jnp.float32, **kw),
+                   jax.ShapeDtypeStruct((b, h, lk, d), jnp.float32, **kw)),
+        interpret=_use_interpret(),
+    )(jnp.atleast_1d(q_offset).astype(jnp.int32),
+      jnp.atleast_1d(k_offset).astype(jnp.int32),
+      qt, kt, vt, dot, dl, lse_c)
+
+    dq = dq.transpose(0, 2, 1, 3)                               # [B,Lq,H,D]
+    dk = dk.transpose(0, 2, 1, 3)                               # [B,Lk,H,D]
+    dv = dv.transpose(0, 2, 1, 3)
+    if group > 1:
+        dk = dk.reshape(b, lk, hkv, group, d).sum(3)
+        dv = dv.reshape(b, lk, hkv, group, d).sum(3)
+    return dq, dk, dv
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None):

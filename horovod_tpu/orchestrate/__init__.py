@@ -11,10 +11,12 @@ wrapper the Spark estimators provided.
 
 from .executor import Executor
 from .ray_adapter import RayExecutor
+from .ray_elastic import ElasticRayExecutor, RayHostDiscovery
 from .estimator import JaxEstimator, ParquetSource
 from . import spark  # noqa: F401  (pyspark itself is imported lazily)
 
-__all__ = ["Executor", "RayExecutor", "JaxEstimator", "ParquetSource",
+__all__ = ["Executor", "RayExecutor", "ElasticRayExecutor",
+           "RayHostDiscovery", "JaxEstimator", "ParquetSource",
            "KerasEstimator", "KerasModel", "TorchEstimator", "TorchModel",
            "spark"]
 

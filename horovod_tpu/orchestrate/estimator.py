@@ -89,6 +89,15 @@ def _load_parquet_shard(hvd, spec: Dict[str, Any], row_groups):
     # too).
     y = np.asarray(table[label].to_numpy(zero_copy_only=False))
 
+    return _split_and_pad_local(hvd, spec, x, y)
+
+
+def _split_and_pad_local(hvd, spec: Dict[str, Any], x, y):
+    """Worker-side lockstep discipline shared by the Parquet and
+    Spark-DataFrame ingestion paths: local validation split (before
+    padding, so no train row can leak in), then wrap-padding of the
+    train rows to the cross-rank MAX length so every rank runs the same
+    number of lockstep collective steps."""
     split = spec["validation_split"]
     n_val = max(1, int(round(len(x) * split))) if split > 0 else 0
     x_train, y_train = x[:len(x) - n_val], y[:len(y) - n_val]
@@ -105,12 +114,44 @@ def _load_parquet_shard(hvd, spec: Dict[str, Any], row_groups):
     target, min_len = int(agg[0]), int(-agg[1])
     if min_len == 0:
         raise ValueError("a worker received only validation rows — "
-                         "use more row groups or a smaller split")
+                         "use more rows per partition or a smaller split")
     if len(x_train) < target:
         reps = [i % len(x_train) for i in range(target - len(x_train))]
         x_train = np.concatenate([x_train, x_train[reps]])
         y_train = np.concatenate([y_train, y_train[reps]])
     return x_train, y_train, x_val, y_val
+
+
+def _rows_to_xy(rows, label_col: str, feature_cols):
+    """Barrier-task row materialization: a partition's Rows (pyspark Row
+    or plain mappings) -> (x float32 [n, d], y native-dtype [n]).
+    Vector-typed columns are flattened via ``np.asarray`` per cell."""
+    if not rows:
+        raise ValueError(
+            "a barrier task received an EMPTY DataFrame partition — "
+            "repartition produced skew; use more rows or fewer workers")
+
+    def get(r, c):
+        try:
+            return r[c]
+        except (TypeError, IndexError):
+            return getattr(r, c)
+
+    first = rows[0]
+    if feature_cols:
+        cols = list(feature_cols)
+    else:
+        try:
+            names = list(first.__fields__)       # pyspark Row
+        except AttributeError:
+            names = list(first.keys())           # mapping (stub/tests)
+        cols = [c for c in names if c != label_col]
+    x = np.asarray([np.concatenate([np.ravel(np.asarray(get(r, c),
+                                                        np.float32))
+                                    for c in cols]) for r in rows],
+                   np.float32)
+    y = np.asarray([get(r, label_col) for r in rows])
+    return x, y
 
 
 def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
@@ -148,6 +189,15 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
         # ref: spark/common/util.py Parquet row-group partitioning).
         x_train, y_train, x_val, y_val = _load_parquet_shard(
             hvd, spec, x_train)
+    elif spec.get("spark_df"):
+        # DataFrame mode: x_train carries this barrier task's partition
+        # rows; materialize + apply the shared local split/pad
+        # discipline (ref: dataframe->Petastorm prep, spark/common/util.py).
+        meta = spec["spark_df"]
+        x, y = _rows_to_xy(x_train, meta["label_col"],
+                           meta["feature_cols"])
+        x_train, y_train, x_val, y_val = _split_and_pad_local(
+            hvd, spec, x, y)
     x_train = np.asarray(x_train)
     y_train = np.asarray(y_train)
 
@@ -244,7 +294,9 @@ class JaxEstimator:
                  batch_size: int = 32,
                  validation_split: float = 0.0,
                  shuffle: bool = True,
-                 store: Optional[str] = None,
+                 store: Optional[Any] = None,
+                 label_col: str = "label",
+                 feature_cols: Optional[Tuple[str, ...]] = None,
                  seed: int = 0):
         if (train_fn is None) == (model_init is None):
             raise ValueError(
@@ -263,6 +315,14 @@ class JaxEstimator:
         self.predict_fn = predict_fn
         self.num_workers = num_workers
         self._env = env
+        self._label_col = label_col
+        self._feature_cols = feature_cols
+        if store is not None and not isinstance(store, str):
+            # Store abstraction (orchestrate/store.py): checkpoints go
+            # under the prefix's run-path discipline.
+            from .store import Store
+
+            store = Store.create(store).get_checkpoint_path()
         self._spec = None if model_init is None else {
             "model_init": model_init, "loss_fn": loss_fn,
             "optimizer": optimizer, "epochs": int(epochs),
@@ -307,6 +367,13 @@ class JaxEstimator:
                 "ParquetSource requires the declarative estimator "
                 "(model_init/loss_fn); a custom train_fn receives numpy "
                 "shards")
+        if _is_spark_dataframe(x):
+            if self._spec is None:
+                raise ValueError(
+                    "DataFrame fit requires the declarative estimator "
+                    "(model_init/loss_fn) — a custom train_fn receives "
+                    "numpy shards")
+            return self._fit_spark_df(x, y, env)
         if self._spec is not None:
             if fit_kwargs:
                 raise TypeError(
@@ -362,6 +429,34 @@ class JaxEstimator:
             spec, [(assign[r], None, None, None)
                    for r in range(self.num_workers)], env)
 
+    def _fit_spark_df(self, df, y, env) -> JaxModel:
+        """fit(df): training runs INSIDE Spark barrier tasks, each on its
+        own partition's rows — the driver never collects the dataset
+        (ref: spark estimators' fit(df) over dataframe->Petastorm,
+        spark/common/util.py; barrier training, spark/keras/remote.py).
+        Rank r's shard is partition r of ``df.repartition(num_workers)``;
+        the worker-side split/pad discipline matches the Parquet path."""
+        if y is not None:
+            raise ValueError(
+                "DataFrame fit carries labels in label_col "
+                f"({self._label_col!r}); pass y=None")
+        from . import spark as spark_mod
+
+        spec = dict(self._spec)
+        spec["spark_df"] = {
+            "label_col": self._label_col,
+            "feature_cols": (list(self._feature_cols)
+                             if self._feature_cols else None)}
+        env = collective_worker_env(env)
+
+        def task(rows):
+            return _declarative_fit(spec, rows, None, None, None)
+
+        results = spark_mod.run_on_dataframe(
+            task, df, num_proc=self.num_workers, env=env)
+        self.history_ = results[0]["history"]
+        return JaxModel(results[0]["params"], self.predict_fn)
+
     def _run_declarative(self, spec, per_rank_args, env) -> JaxModel:
         """Shared dispatch tail for both declarative input modes."""
         env = collective_worker_env(env)
@@ -370,6 +465,13 @@ class JaxEstimator:
                              per_rank_args=per_rank_args)
         self.history_ = results[0]["history"]
         return JaxModel(results[0]["params"], self.predict_fn)
+
+
+def _is_spark_dataframe(x) -> bool:
+    """Duck-typed Spark DataFrame detection (pyspark may not be
+    importable here; barrier tasks see the real class)."""
+    return (hasattr(x, "rdd") and hasattr(x, "columns")
+            and hasattr(x, "repartition"))
 
 
 def _free_port() -> int:

@@ -1,0 +1,267 @@
+"""Elastic Ray execution: actor re-provisioning over the elastic driver.
+
+Re-conception of ref: ray/elastic_v2.py (RayHostDiscovery :40-72,
+ElasticAdapter worker_loop :331-383) — Ray's cluster state is the host
+discovery source and Ray actors are the workers, but the
+membership/blacklist/re-rendezvous machinery is the SAME
+``runner.elastic.ElasticDriver`` the CLI elastic launcher uses: an
+actor death records a FAILURE, the dead actor's node is blacklisted,
+and the surviving generation re-rendezvouses (smaller world) while
+discovery keeps watching ``ray.nodes()`` for replacements.
+
+Worker contract: ``fn`` runs inside each actor with the full HVDT_*
+env, exactly like CLI-launched elastic workers.  The TPU elastic model
+is generation restart (a compiled XLA world cannot resize in place):
+when the driver announces a membership change, in-actor training raises
+``HostsUpdatedInterrupt`` at its next commit point (state committed to
+the shared store first), the actor's generation ends READY, and the
+next generation's actors resume from the commit —
+ref: elastic_v2.py's worker_loop kill/respawn plays the same role.
+
+ray is imported lazily; everything is stub-testable
+(tests/test_ray_elastic.py) with the same actor-surface stub as
+tests/test_ray.py plus scripted node lists / actor deaths.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..common.exceptions import HostsUpdatedInterrupt
+from ..common.logging_util import get_logger
+from ..runner.elastic.discovery import HostManager
+from ..runner.elastic.driver import ElasticDriver, RESTART_EXIT_CODE
+from ..runner.hosts import HostInfo, SlotInfo
+from ..runner.http_kv import RendezvousServer, new_secret
+
+log = get_logger(__name__)
+
+__all__ = ["RayHostDiscovery", "ElasticRayExecutor"]
+
+
+class RayHostDiscovery:
+    """Host discovery from Ray global state (ref: elastic_v2.py:40-72).
+
+    A callable returning ``List[HostInfo]`` — pluggable directly into
+    ``runner.elastic.discovery.HostManager`` in place of a discovery
+    script."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_worker: int = 1,
+                 gpus_per_worker: Optional[int] = None):
+        self.use_gpu = use_gpu
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+
+    def __call__(self) -> List[HostInfo]:
+        import ray
+
+        hosts: List[HostInfo] = []
+        for node in ray.nodes():
+            if not node.get("alive"):
+                continue
+            addr = node["NodeManagerAddress"]
+            res = node.get("Resources", {}) or {}
+            slots = int(res.get("CPU", 0) // self.cpus_per_worker)
+            if self.use_gpu:
+                per = self.gpus_per_worker or 1
+                slots = min(slots, int(res.get("GPU", 0) // per))
+            if slots > 0:
+                hosts.append(HostInfo(addr, slots))
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Elastic analog of :class:`RayExecutor`
+    (ref: elastic_v2.py ElasticAdapter).
+
+    Usage::
+
+        ex = ElasticRayExecutor(min_workers=2, max_workers=4)
+        ex.start()
+        results = ex.run(train_fn)     # survives actor/node loss
+        ex.shutdown()
+    """
+
+    def __init__(self, min_workers: int = 1,
+                 max_workers: Optional[int] = None,
+                 use_gpu: bool = False, cpus_per_worker: int = 1,
+                 gpus_per_worker: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 reset_limit: Optional[int] = None,
+                 discovery_interval: float = 1.0,
+                 ping_timeout_s: float = 10.0,
+                 override_discovery: Optional[Callable[[], List[HostInfo]]]
+                 = None):
+        self.min_workers = min_workers
+        self.max_workers = max_workers or min_workers
+        self._cpus = cpus_per_worker
+        self._use_gpu = use_gpu
+        self._gpus = gpus_per_worker
+        self._env = dict(env or {})
+        self._reset_limit = reset_limit
+        self._interval = discovery_interval
+        self._ping_timeout = ping_timeout_s
+        self._discover = (override_discovery
+                          or RayHostDiscovery(use_gpu, cpus_per_worker,
+                                              gpus_per_worker))
+        self._hm: Optional[HostManager] = None
+        self._started = False
+
+    def start(self) -> None:
+        import ray
+
+        if not ray.is_initialized():
+            raise RuntimeError(
+                "ElasticRayExecutor.start() requires ray.init() first")
+        self._hm = HostManager(self._discover)
+        self._started = True
+
+    # -- internals ---------------------------------------------------------
+
+    def _make_worker(self, ray, slot: SlotInfo):
+        """One actor, pinned to the slot's node when Ray exposes the
+        node resource (stub clusters may not)."""
+
+        @ray.remote
+        class _ElasticWorker:
+            def __init__(self):
+                self._payload = None
+
+            def ping(self):
+                return 1
+
+            def setup(self, env):
+                import os
+
+                os.environ.update(env)
+                return True
+
+            def execute(self, fn, *a, **kw):
+                return fn(*a, **kw)
+
+        opts: Dict[str, Any] = {"num_cpus": self._cpus}
+        if self._use_gpu:
+            opts["num_gpus"] = self._gpus or 1
+        try:
+            nodes = {n["NodeManagerAddress"]: n.get("Resources", {}) or {}
+                     for n in ray.nodes() if n.get("alive")}
+            if f"node:{slot.hostname}" in nodes.get(slot.hostname, {}):
+                opts["resources"] = {f"node:{slot.hostname}": 1e-3}
+        except Exception:   # stub clusters without node resources
+            pass
+        return _ElasticWorker.options(**opts).remote()
+
+    def run(self, fn: Callable, args: Sequence = (),
+            kwargs: Optional[Dict] = None) -> List[Any]:
+        """Run ``fn`` elastically; returns the final generation's results
+        in rank order."""
+        import ray
+
+        if not self._started:
+            self.start()
+        kwargs = kwargs or {}
+
+        server = RendezvousServer(secret=new_secret())
+        port = server.start()
+        try:
+            addr = ray.util.get_node_ip_address()
+        except Exception:
+            try:
+                addr = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                addr = "127.0.0.1"
+
+        results: Dict[int, Dict[int, Any]] = {}
+        results_lock = threading.Lock()
+
+        def rendezvous_cb(slots: List[SlotInfo], gen: int) -> None:
+            spec = "\n".join(
+                f"{s.rank},{s.hostname},{s.local_rank},{s.cross_rank},"
+                f"{s.size},{s.local_size},{s.cross_size}" for s in slots)
+            server.put_local(f"/rendezvous/{gen}/spec", spec.encode())
+            server.put_local("/rendezvous/version", str(gen).encode())
+            server.put_local("/cluster/size", str(len(slots)).encode())
+
+        def hosts_updated_cb(n: int) -> None:
+            server.put_local("/rendezvous/pending", str(n).encode())
+
+        def spawn_fn(slot: SlotInfo, gen: int) -> int:
+            worker = self._make_worker(ray, slot)
+            try:
+                ray.get(worker.ping.remote(), timeout=self._ping_timeout)
+            except Exception as e:
+                # Node vanished between discovery and actor start
+                # (ref: elastic_v2.py ping_worker edge case).
+                log.warning("elastic ray: ping failed on %s: %s",
+                            slot.hostname, e)
+                return 1
+            env = {
+                "HVDT_RENDEZVOUS_ADDR": addr,
+                "HVDT_RENDEZVOUS_PORT": str(port),
+                "HVDT_SECRET": server.secret.hex(),
+                "HVDT_ELASTIC": "1",
+                "HVDT_GENERATION": str(gen),
+                **slot.to_env(),
+                **self._env,
+            }
+            try:
+                ray.get(worker.setup.remote(env),
+                        timeout=self._ping_timeout)
+                out = ray.get(worker.execute.remote(fn, *args, **kwargs))
+            except Exception as e:
+                if _is_hosts_updated(e):
+                    # Worker saw the membership change and committed:
+                    # READY for the next generation, not a failure.
+                    return RESTART_EXIT_CODE
+                log.warning("elastic ray: worker %d (gen %d) died: %s",
+                            slot.rank, gen, e)
+                return 1
+            with results_lock:
+                results.setdefault(gen, {})[slot.rank] = out
+            return 0
+
+        driver = ElasticDriver(
+            self._hm, self.min_workers, self.max_workers, spawn_fn,
+            reset_limit=self._reset_limit,
+            discovery_interval=self._interval,
+            kv_server=server, hosts_updated_cb=hosts_updated_cb)
+        try:
+            driver.start(rendezvous_cb)
+            code = driver.wait()
+        finally:
+            driver.stop()
+            server.stop()
+        if code != 0:
+            raise RuntimeError(
+                f"elastic ray job failed (exit {code}); "
+                f"{len(results)} generations ran")
+        final_gen = max(results) if results else None
+        if final_gen is None:
+            return []
+        by_rank = results[final_gen]
+        return [by_rank[r] for r in sorted(by_rank)]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        return self.run(fn, args=args, kwargs=kwargs)
+
+    def shutdown(self) -> None:
+        self._started = False
+
+
+def _is_hosts_updated(e: BaseException) -> bool:
+    """Detect HostsUpdatedInterrupt raised inside an actor: Ray wraps
+    worker exceptions (RayTaskError carries the cause; stubs re-raise
+    directly)."""
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, HostsUpdatedInterrupt):
+            return True
+        if type(cur).__name__ == "HostsUpdatedInterrupt":
+            return True
+        cur = getattr(cur, "cause", None) or cur.__cause__
+    return "HostsUpdatedInterrupt" in str(e)

@@ -31,7 +31,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["run"]
+__all__ = ["run", "run_on_dataframe"]
 
 
 def _task_env(rank: int, addresses: List[str], base: Dict[str, str],
@@ -91,25 +91,100 @@ def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
     extra_env = dict(env) if env else None
 
     def _task(iterator):
-        from pyspark import BarrierTaskContext
-
-        ctx = BarrierTaskContext.get()
-        rank = ctx.partitionId()
-        addresses = [i.address for i in ctx.getTaskInfos()]
-        os.environ.update(_task_env(rank, addresses, base_env, extra_env))
-        # Tell the driver this rank was actually scheduled: startup is
-        # bounded by start_timeout on the driver side, and a barrier stage
-        # the cluster cannot schedule must fail fast there, not after the
-        # (long) run timeout (ref: spark/runner.py start_timeout rationale).
-        from ..runner.http_kv import KVClient
-
-        KVClient.from_env(os.environ).put(f"/spark/started/{rank}", b"1")
-        # All ranks enter together (mirrors the reference's registration
-        # barrier before launching the job).
-        ctx.barrier()
+        rank = _enter_barrier(base_env, extra_env)
         result = fn(*args, **kwargs)
         yield (rank, result)
 
+    def _make_rdd():
+        return sc.parallelize(range(num_proc), num_proc)
+
+    return _barrier_collect(sc, server, _make_rdd, _task, num_proc,
+                            start_timeout, port)
+
+
+def run_on_dataframe(fn: Callable, df, num_proc: Optional[int] = None,
+                     start_timeout: Optional[int] = None,
+                     env: Optional[Dict[str, str]] = None) -> List[Any]:
+    """Run ``fn(rows)`` on ``num_proc`` barrier tasks, each fed ITS
+    partition of ``df`` (rows materialized as a list) — the
+    DataFrame-in training path of the reference's estimators
+    (ref: spark/common/util.py dataframe->Petastorm prep + barrier-task
+    training in spark/keras/remote.py), without the driver ever
+    collecting the dataset.
+
+    The DataFrame is repartitioned to ``num_proc`` so the barrier stage
+    width equals the worker count; rank r trains on partition r.
+    Returns per-rank results in rank order."""
+    import pyspark
+
+    if start_timeout is None:
+        start_timeout = int(os.getenv("HOROVOD_SPARK_START_TIMEOUT",
+                                      os.getenv("HVDT_SPARK_START_TIMEOUT",
+                                                "600")))
+    sc = pyspark.SparkContext._active_spark_context
+    if sc is None:
+        raise RuntimeError(
+            "Could not find an active SparkContext, are you running in a "
+            "PySpark session?")
+    if num_proc is None:
+        num_proc = sc.defaultParallelism
+
+    from ..runner.http_kv import RendezvousServer, new_secret
+
+    server = RendezvousServer(secret=new_secret())
+    port = server.start()
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+    except OSError:
+        addr = "127.0.0.1"
+    server.put_local("/cluster/size", str(num_proc).encode())
+    base_env = {
+        "HVDT_RENDEZVOUS_ADDR": addr,
+        "HVDT_RENDEZVOUS_PORT": str(port),
+        "HVDT_SECRET": server.secret.hex(),
+    }
+    extra_env = dict(env) if env else None
+
+    def _task(iterator):
+        rank = _enter_barrier(base_env, extra_env)
+        result = fn(list(iterator))
+        yield (rank, result)
+
+    def _make_rdd():
+        return df.repartition(num_proc).rdd
+
+    return _barrier_collect(sc, server, _make_rdd, _task, num_proc,
+                            start_timeout, port)
+
+
+def _enter_barrier(base_env, extra_env) -> int:
+    """Inside a barrier task: set the HVDT_* contract, report startup,
+    enter the registration barrier; returns this task's rank."""
+    from pyspark import BarrierTaskContext
+
+    ctx = BarrierTaskContext.get()
+    rank = ctx.partitionId()
+    addresses = [i.address for i in ctx.getTaskInfos()]
+    os.environ.update(_task_env(rank, addresses, base_env, extra_env))
+    # Tell the driver this rank was actually scheduled: startup is
+    # bounded by start_timeout on the driver side, and a barrier stage
+    # the cluster cannot schedule must fail fast there, not after the
+    # (long) run timeout (ref: spark/runner.py start_timeout rationale).
+    from ..runner.http_kv import KVClient
+
+    KVClient.from_env(os.environ).put(f"/spark/started/{rank}", b"1")
+    # All ranks enter together (mirrors the reference's registration
+    # barrier before launching the job).
+    ctx.barrier()
+    return rank
+
+
+def _barrier_collect(sc, server, make_rdd, task, num_proc, start_timeout,
+                     port) -> List[Any]:
+    """Shared driver tail: launch the barrier stage on a collector
+    thread, bound startup by start_timeout (started-flags on the KV),
+    bound the run by HVDT_SPARK_RUN_TIMEOUT, return rank-ordered
+    results."""
     job_group = f"horovod_tpu.spark.run.{port}"
     result_q: "queue.Queue" = queue.Queue(1)
 
@@ -117,8 +192,8 @@ def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
         try:
             sc.setJobGroup(job_group, "horovod_tpu.orchestrate.spark.run",
                            interruptOnCancel=True)
-            rdd = sc.parallelize(range(num_proc), num_proc)
-            result_q.put(("ok", rdd.barrier().mapPartitions(_task).collect()))
+            rdd = make_rdd()
+            result_q.put(("ok", rdd.barrier().mapPartitions(task).collect()))
         except BaseException as e:  # noqa: BLE001 — re-raised on the caller
             result_q.put(("err", e))
 

@@ -1,0 +1,119 @@
+"""Storage abstraction for estimator artifacts.
+
+Minimal re-conception of ref: spark/common/store.py (Store/LocalStore/
+HDFSStore, 553 LoC): one prefix-disciplined object answering "where do
+train data, checkpoints and logs live, and how do I read/write them",
+so estimators never hard-code filesystem calls.  The reference ships
+HDFS/S3/DBFS backends over pyarrow filesystems; here LocalStore is
+fully functional and remote prefixes (gs://, s3://, hdfs://) resolve
+through fsspec when it is importable (this image carries fsspec+gcsfs,
+so ``Store.create("gs://...")`` constructs a working GCS-backed store —
+IO then needs real credentials); without fsspec the constructor raises
+a clear gating error instead of pretending.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["Store", "LocalStore", "FilesystemStore"]
+
+_REMOTE_SCHEMES = ("gs://", "s3://", "hdfs://", "abfs://", "dbfs:/")
+
+
+class Store:
+    """Prefix + path discipline (ref: store.py Store.get_*_path)."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix.rstrip("/")
+
+    @staticmethod
+    def create(prefix: "str | Store") -> "Store":
+        if isinstance(prefix, Store):
+            return prefix
+        if prefix.startswith(_REMOTE_SCHEMES):
+            return FilesystemStore(prefix)
+        return LocalStore(prefix)
+
+    # -- path discipline ---------------------------------------------------
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        base = f"{self.prefix}/intermediate_train_data"
+        return f"{base}.{idx}" if idx is not None else base
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        base = f"{self.prefix}/intermediate_val_data"
+        return f"{base}.{idx}" if idx is not None else base
+
+    def get_checkpoint_path(self, run_id: str = "default") -> str:
+        return f"{self.prefix}/runs/{run_id}/checkpoints"
+
+    def get_logs_path(self, run_id: str = "default") -> str:
+        return f"{self.prefix}/runs/{run_id}/logs"
+
+    # -- IO (backend-specific) ---------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Plain-filesystem backend (ref: store.py LocalStore)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def mkdirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        self.mkdirs(os.path.dirname(path))
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class FilesystemStore(Store):
+    """Remote prefixes via fsspec (ref: store.py HDFSStore/S3 over
+    pyarrow fs).  Gated: constructing one without an importable fsspec
+    raises immediately with the reason, rather than failing deep inside
+    a worker."""
+
+    def __init__(self, prefix: str):
+        super().__init__(prefix)
+        try:
+            import fsspec
+
+            self._fs = fsspec.open(prefix).fs
+        except ImportError as e:
+            raise ImportError(
+                f"store prefix {prefix!r} needs the fsspec package (with "
+                "the scheme's backend, e.g. gcsfs for gs://) — not "
+                "available in this environment") from e
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def mkdirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with self._fs.open(path, "wb") as f:
+            f.write(data)
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()

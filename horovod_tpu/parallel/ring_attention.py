@@ -62,15 +62,17 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    use_pallas: Optional[bool] = None) -> jax.Array:
     """Exact (optionally causal) attention over a sequence-sharded ring.
 
-    Differentiation: the common path (``segment_ids=None``,
-    ``use_pallas`` unset) carries a ``custom_vjp`` whose backward is a
-    SECOND ring pass that recomputes scores blockwise from the saved
-    logsumexp — O(local_seq x block) memory, like the forward.  Plain
-    autodiff through the forward scan would instead save every visiting
-    block's score matrix (O(local_seq x global_seq) per device), which
-    defeats the point of sequence parallelism at long context.  The
-    ``segment_ids`` path still differentiates that way (exact, memory-
-    heavy); the ``use_pallas`` path is forward-only.
+    Differentiation: the common path (``segment_ids=None``) carries a
+    ``custom_vjp`` whose backward is a SECOND ring pass that recomputes
+    scores blockwise from the saved logsumexp — O(local_seq x block)
+    memory, like the forward.  Plain autodiff through the forward scan
+    would instead save every visiting block's score matrix
+    (O(local_seq x global_seq) per device), which defeats the point of
+    sequence parallelism at long context.  The ``segment_ids`` path
+    still differentiates that way (exact, memory-heavy).  With
+    ``use_pallas=True`` BOTH ring passes run Pallas kernels
+    (ops/pallas_kernels.flash_block_update forward,
+    flash_grad_block backward) — fully trainable.
 
     Args:
       q, k, v: local shards ``[batch, local_seq, heads, head_dim]``.  MQA/GQA
@@ -82,14 +84,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       segment_ids: optional ``[batch, local_seq]`` int segment labels for
         packed sequences; attention is masked to equal segments.  The key
         side's labels rotate around the ring with K/V.
-      use_pallas: run each ring step through the Pallas flash kernel
-        (ops/pallas_kernels.flash_block_update) instead of the jnp block
-        update.  Default **False**: the per-step kernel has no autodiff
-        rule, so differentiating a ``use_pallas=True`` ring raises
-        ``NotImplementedError`` — opt in for FORWARD-ONLY use
-        (inference/scoring) on TPU with cleanly tiling shapes.  The
-        default path is exact and differentiable with flash-style
-        memory in BOTH directions (custom_vjp above).
+      use_pallas: run each ring step through the Pallas flash kernels —
+        ops/pallas_kernels.flash_block_update forward,
+        flash_grad_block backward (dK/dV accumulated blockwise in VMEM
+        scratch and rotated with their block) — instead of the jnp
+        block update.  Trainable: grads match the jnp path and the
+        dense reference (tests/test_parallel.py).  Default **False**
+        (requires segment_ids=None and 128-tiling shapes; the jnp path
+        is the portable default).
 
     Returns ``[batch, local_seq, heads, head_dim]`` in q's dtype.
     """
@@ -121,8 +123,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         static_scale = float(scale)
     except Exception:
         static_scale = None
-    if segment_ids is None and not use_pallas and static_scale is not None:
-        return _ring_diff(q, k, v, axis, causal, static_scale)
+    if segment_ids is None and static_scale is not None:
+        return _ring_diff(q, k, v, axis, causal, static_scale, use_pallas)
     out, _ = _ring_forward(q, k, v, axis, causal, scale,
                            segment_ids, use_pallas)
     return out
@@ -212,18 +214,18 @@ def _ring_forward(q, k, v, axis, causal, scale, segment_ids, use_pallas):
     return out.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_diff(q, k, v, axis, causal, scale):
-    out, _ = _ring_forward(q, k, v, axis, causal, scale, None, False)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_diff(q, k, v, axis, causal, scale, use_pallas):
+    out, _ = _ring_forward(q, k, v, axis, causal, scale, None, use_pallas)
     return out
 
 
-def _ring_diff_fwd(q, k, v, axis, causal, scale):
-    out, lse = _ring_forward(q, k, v, axis, causal, scale, None, False)
+def _ring_diff_fwd(q, k, v, axis, causal, scale, use_pallas):
+    out, lse = _ring_forward(q, k, v, axis, causal, scale, None, use_pallas)
     return out, (q, k, v, out, lse)
 
 
-def _ring_diff_bwd(axis, causal, scale, res, do):
+def _ring_diff_bwd(axis, causal, scale, use_pallas, res, do):
     """Second ring pass: dk/dv accumulators travel WITH their K/V block
     (ppermute) and arrive home after sp rotations carrying every rank's
     contribution; dq accumulates locally.  Scores are recomputed per
@@ -252,6 +254,56 @@ def _ring_diff_bwd(axis, causal, scale, res, do):
 
     delta, lse_v = _varying(delta), _varying(lse)
     qf, dof = _varying(qf), _varying(dof)
+
+    if use_pallas:
+        # Per-step grads through the Pallas backward kernels
+        # (ops/pallas_kernels.flash_grad_block): the VMEM-tiled
+        # recompute of this block pair's (dq, dk, dv) — no [B,H,Lq,Lk]
+        # f32 score tensor in HBM.  Ring blocks need only the three
+        # static mask cases of the forward (full/diagonal/future), so
+        # the kernels see static causal flags and zero offsets.
+        from ..ops.pallas_kernels import flash_grad_block
+
+        qv, dov, outv = _varying(q), _varying(do), _varying(out)
+        delta_bhq = _varying(delta.transpose(0, 2, 1))        # [B,H,Lq]
+
+        def _grads(kb, vb, causal_flag):
+            return flash_grad_block(qv, kb, vb, dov, outv, lse_v,
+                                    causal=causal_flag, scale=scale,
+                                    delta=delta_bhq)
+
+        def pstep(carry, s):
+            k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry
+            src = (my - s) % sp
+
+            def _full(ops):
+                return _grads(ops[0], ops[1], False)
+
+            def _diag(ops):
+                return _grads(ops[0], ops[1], True)
+
+            def _skip(ops):
+                return (_varying(jnp.zeros((b, lq, h, d), f32)),
+                        _varying(jnp.zeros((b, lk, hkv, d), f32)),
+                        _varying(jnp.zeros((b, lk, hkv, d), f32)))
+
+            if causal:
+                case = jnp.where(src < my, 0, jnp.where(src == my, 1, 2))
+                dq_c, dk_c, dv_c = lax.switch(
+                    case, [_full, _diag, _skip], (k_blk, v_blk))
+            else:
+                dq_c, dk_c, dv_c = _full((k_blk, v_blk))
+            return (lax.ppermute(k_blk, axis, fwd),
+                    lax.ppermute(v_blk, axis, fwd),
+                    lax.ppermute(dk_blk + dk_c, axis, fwd),
+                    lax.ppermute(dv_blk + dv_c, axis, fwd),
+                    dq_acc + dq_c), None
+
+        zeros_kv = _varying(jnp.zeros((b, lk, hkv, d), f32))
+        dq0 = _varying(jnp.zeros((b, lq, h, d), f32))
+        (_, _, dk, dv, dq), _ = lax.scan(
+            pstep, (k, v, zeros_kv, zeros_kv, dq0), jnp.arange(sp))
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
     def step(carry, s):
         k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry

@@ -63,7 +63,8 @@ class ElasticDriver:
                  reset_limit: Optional[int] = None,
                  discovery_interval: float = _DISCOVERY_INTERVAL_S,
                  kv_server: Optional[RendezvousServer] = None,
-                 hosts_updated_cb: Optional[Callable[[int], None]] = None):
+                 hosts_updated_cb: Optional[Callable[[int], None]] = None,
+                 elastic_timeout: float = 600.0):
         self._hm = host_manager
         self._kv = kv_server
         self._hosts_updated_cb = hosts_updated_cb
@@ -72,6 +73,7 @@ class ElasticDriver:
         self._max_np = max_np or min_np
         self._spawn_fn = spawn_fn or (lambda slot, gen: 0)
         self._interval = discovery_interval
+        self._elastic_timeout = elastic_timeout
         self.registry = WorkerStateRegistry(self._on_barrier,
                                             reset_limit=reset_limit)
         self._lock = threading.Lock()
@@ -187,7 +189,8 @@ class ElasticDriver:
     # -- rendezvous / spawn ------------------------------------------------
 
     def _rendezvous(self) -> None:
-        self.wait_for_available_slots(self._min_np)
+        self.wait_for_available_slots(self._min_np,
+                                      timeout=self._elastic_timeout)
         with self._lock:
             self._generation += 1
             gen = self._generation
@@ -301,6 +304,10 @@ def run_elastic(args) -> int:
     server = RendezvousServer(secret=new_secret())
     port = server.start()
     addr = socket.gethostbyname(socket.gethostname())
+    if getattr(args, "nics", None):
+        from ..launch import _nic_addr
+
+        addr = _nic_addr(args.nics.split(",")) or addr
     coordinator_port = args.coordinator_port
 
     def rendezvous_cb(slots: List[hosts_mod.SlotInfo], gen: int) -> None:
@@ -332,7 +339,9 @@ def run_elastic(args) -> int:
 
     driver = ElasticDriver(hm, min_np, max_np, spawn_fn,
                            reset_limit=args.reset_limit,
-                           hosts_updated_cb=hosts_updated_cb)
+                           hosts_updated_cb=hosts_updated_cb,
+                           elastic_timeout=getattr(args, "elastic_timeout",
+                                                   600.0))
     try:
         driver.start(rendezvous_cb)
         code = driver.wait()

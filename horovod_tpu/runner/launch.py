@@ -38,8 +38,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="hvdtrun",
         description="Launch distributed training on TPU hosts "
                     "(horovodrun-equivalent).")
+    p.add_argument("-V", "--version", action="store_true", dest="version",
+                   help="Print the horovod_tpu version and exit.")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="Print build capabilities (native core, TCP data "
+                        "plane, TPU visibility) and exit "
+                        "(ref: horovodrun --check-build).")
     p.add_argument("-np", "--num-proc", type=int, default=None,
                    help="Total number of worker processes.")
+    p.add_argument("--network-interface", "--nics", dest="nics",
+                   default=None,
+                   help="Comma-separated NIC allowlist: the launcher "
+                        "advertises its rendezvous/KV address from the "
+                        "first matching interface (static and elastic), "
+                        "and exports HVDT_NICS to workers.")
+    p.add_argument("--disable-cache", action="store_true",
+                   help="Disable the controller response cache "
+                        "(HVDT_CACHE_CAPACITY=0; every collective "
+                        "renegotiates, ref: --disable-cache).")
     p.add_argument("-H", "--hosts", default=None,
                    help='Comma-separated "host:slots" list.')
     p.add_argument("--hostfile", default=None,
@@ -72,14 +88,51 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--slots-per-host", type=int, default=1)
     p.add_argument("--reset-limit", type=int, default=None,
                    help="Max worker resets before aborting the elastic job.")
+    p.add_argument("--elastic-timeout", type=float, default=600.0,
+                   help="Seconds to wait for min-np slots at each elastic "
+                        "rendezvous (ref: --elastic-timeout).")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="Training command, e.g. python train.py")
     args = p.parse_args(argv)
+    if args.version or args.check_build:
+        return args
     if not args.command:
         p.error("no training command given")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     return args
+
+
+def _print_check_build() -> None:
+    """--check-build / --version output (ref: horovodrun --check-build
+    prints the framework/controller/transport capability table)."""
+    import subprocess
+
+    import horovod_tpu as hvd
+
+    print(f"horovod_tpu v{hvd.__version__}")
+    # TPU probe in a TIME-BOUNDED child: jax.devices() on a tunnelled/
+    # remote TPU backend can claim the chip for minutes — --check-build
+    # must stay snappy like the reference's link-time checks.
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax;"
+             "print(any(d.platform=='tpu' for d in jax.devices()))"],
+            capture_output=True, text=True, timeout=30)
+        tpu = "True" in r.stdout
+    except Exception:
+        tpu = False
+    rows = [
+        ("native C++ core", hvd.native_built()),
+        ("TCP host data plane", hvd.tcp_enabled()),
+        ("TPU visible", tpu),
+    ]
+    print("\nAvailable capabilities:")
+    for name, ok in rows:
+        print(f"    [{'X' if ok else ' '}] {name}")
+    print("\nData planes: [X] XLA collectives (jit)  "
+          "[X] host eager (grouped/fused)")
 
 
 def _is_local(hostname: str) -> bool:
@@ -118,7 +171,12 @@ def knob_env_for(args) -> Dict[str, str]:
     """Resolve the runtime-knob env contract for workers (CLI > caller
     env > --config-file > default; ref: config_parser.py precedence)."""
     file_values = apply_config_file(args, getattr(args, "config_file", None))
-    return env_from_args(args, file_values)
+    env = env_from_args(args, file_values)
+    if getattr(args, "disable_cache", False):
+        env["HVDT_CACHE_CAPACITY"] = "0"
+    if getattr(args, "nics", None):
+        env["HVDT_NICS"] = args.nics
+    return env
 
 
 def tcp_addrs_env(args, slots: List[hosts_mod.SlotInfo],
@@ -139,6 +197,28 @@ def tcp_addrs_env(args, slots: List[hosts_mod.SlotInfo],
         host = "127.0.0.1" if _is_local(slot.hostname) else slot.hostname
         addrs.append(f"{host}:{args.tcp_base_port + slot.local_rank}")
     return {"HVDT_TCP_ADDRS": ",".join(addrs)}
+
+
+def _nic_addr(nics: List[str]) -> Optional[str]:
+    """IPv4 address of the first present interface in ``nics`` (the
+    --network-interface allowlist; ref: driver_service NIC selection).
+    Linux SIOCGIFADDR — returns None when none match."""
+    import fcntl
+    import struct
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for nic in nics:
+            try:
+                packed = fcntl.ioctl(
+                    s.fileno(), 0x8915,  # SIOCGIFADDR
+                    struct.pack("256s", nic.strip()[:15].encode()))
+                return socket.inet_ntoa(packed[20:24])
+            except OSError:
+                continue
+    finally:
+        s.close()
+    return None
 
 
 def preflight_reachability(args, slots: List[hosts_mod.SlotInfo],
@@ -206,6 +286,16 @@ def run_static(args) -> int:
     port = server.start()
     my_addr = socket.gethostbyname(socket.gethostname()) \
         if any(not _is_local(s.hostname) for s in slots) else "127.0.0.1"
+    if getattr(args, "nics", None):
+        # --network-interface: advertise the rendezvous on the allowed
+        # NIC's address (workers then reach the coordinator over it).
+        nic_addr = _nic_addr(args.nics.split(","))
+        if nic_addr:
+            my_addr = nic_addr
+        else:
+            print(f"hvdtrun: none of --network-interface {args.nics} "
+                  "present on this host; using default address",
+                  file=sys.stderr)
     coord_host = slots[0].hostname
     if _is_local(coord_host):
         coord_host = "127.0.0.1"
@@ -272,6 +362,9 @@ def run_static(args) -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if args.version or args.check_build:
+        _print_check_build()
+        return 0
     if args.host_discovery_script:
         from .elastic.driver import run_elastic
 

@@ -37,6 +37,10 @@ class StallInspector:
         # tensor name -> (first_seen_ts, ranks that reported)
         self._pending: Dict[str, tuple] = {}
         self._warned: Set[str] = set()
+        # Permanent record of every op that EVER stalled (resolve() clears
+        # _warned so a tensor can warn again, but post-hoc introspection —
+        # tests, timeline annotations — needs the history).
+        self.warned_ever: Set[str] = set()
         self._last_check = 0.0
 
     def record(self, name: str, rank: int) -> None:
@@ -70,6 +74,7 @@ class StallInspector:
                     "Stalled op: %s [ready ranks: %s] [missing ranks: %s]",
                     age, name, sorted(ranks), missing)
                 self._warned.add(name)
+                self.warned_ever.add(name)
                 stalled.append(name)
             if self.shutdown_s and age > self.shutdown_s:
                 msg = (f"Stalled tensor {name} exceeded shutdown threshold "

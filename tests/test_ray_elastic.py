@@ -1,0 +1,200 @@
+"""ElasticRayExecutor tests (ref analogs: test/single/test_ray_elastic.py).
+
+Ray is not in this image: the branch runs against a stub implementing the
+surface the elastic executor touches (ray.nodes() cluster state, remote
+actor classes, ray.get with timeout).  Actors execute synchronously in
+process; what's under test is the elastic control flow — actor death →
+FAILURE → node blacklist → smaller re-rendezvous; HostsUpdatedInterrupt →
+READY → larger re-rendezvous when discovery reports a new node.
+"""
+
+import os
+import sys
+import types
+
+import pytest
+
+
+class _Ref:
+    def __init__(self, value=None, exc=None):
+        self.value, self.exc = value, exc
+
+
+class _FakeActorError(Exception):
+    pass
+
+
+class _ActorHandle:
+    def __init__(self, cls, args, kwargs, stub):
+        self._instance = cls(*args, **kwargs)
+        self._stub = stub
+
+    def __getattr__(self, name):
+        method = getattr(self._instance, name)
+        stub = self._stub
+
+        class _Caller:
+            @staticmethod
+            def remote(*a, **kw):
+                stub.calls.append((name, a, kw))
+                try:
+                    return _Ref(method(*a, **kw))
+                except BaseException as e:  # delivered at ray.get
+                    return _Ref(exc=e)
+
+        return _Caller()
+
+
+class _RemoteClass:
+    def __init__(self, cls, stub, options=None):
+        self._cls, self._stub = cls, stub
+        self.options_used = options or {}
+
+    def options(self, **kw):
+        self._stub.actor_options.append(kw)
+        return _RemoteClass(self._cls, self._stub, kw)
+
+    def remote(self, *a, **kw):
+        h = _ActorHandle(self._cls, a, kw, self._stub)
+        self._stub.actors.append(h)
+        return h
+
+
+@pytest.fixture(autouse=True)
+def _env_guard():
+    before = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(before)
+
+
+@pytest.fixture()
+def ray_stub(monkeypatch):
+    stub = types.ModuleType("ray")
+    stub.actors = []
+    stub.actor_options = []
+    stub.calls = []
+    stub.node_list = [{"NodeManagerAddress": "10.0.0.1", "alive": True,
+                       "Resources": {"CPU": 1}}]
+    stub.is_initialized = lambda: True
+    stub.remote = lambda cls: _RemoteClass(cls, stub)
+    stub.nodes = lambda: [dict(n) for n in stub.node_list]
+
+    def _get(refs, timeout=None):
+        if isinstance(refs, list):
+            return [_get(r) for r in refs]
+        if refs.exc is not None:
+            raise refs.exc
+        return refs.value
+
+    stub.get = _get
+    stub.util = types.SimpleNamespace(
+        get_node_ip_address=lambda: "10.0.0.1")
+    monkeypatch.setitem(sys.modules, "ray", stub)
+    yield stub
+
+
+class TestRayHostDiscovery:
+    def test_slots_from_cluster_state(self, ray_stub):
+        from horovod_tpu.orchestrate import RayHostDiscovery
+
+        ray_stub.node_list = [
+            {"NodeManagerAddress": "a", "alive": True,
+             "Resources": {"CPU": 4}},
+            {"NodeManagerAddress": "b", "alive": True,
+             "Resources": {"CPU": 2, "GPU": 1}},
+            {"NodeManagerAddress": "dead", "alive": False,
+             "Resources": {"CPU": 8}},
+        ]
+        hosts = RayHostDiscovery(cpus_per_worker=2)()
+        assert [(h.hostname, h.slots) for h in hosts] == [("a", 2), ("b", 1)]
+
+        gpu_hosts = RayHostDiscovery(use_gpu=True, cpus_per_worker=1)()
+        assert [(h.hostname, h.slots) for h in gpu_hosts] == [("a", 0),
+                                                              ("b", 1)] or \
+            [(h.hostname, h.slots) for h in gpu_hosts] == [("b", 1)]
+
+
+class TestElasticRayExecutor:
+    def test_actor_death_blacklists_and_rerendezvouses(self, ray_stub):
+        """Generation 1 runs on two nodes; the actor on node B dies.
+        The driver must blacklist B, re-rendezvous the survivor, and the
+        job completes on the smaller world (ref: elastic_v2.py
+        worker_loop failure path)."""
+        from horovod_tpu.orchestrate import ElasticRayExecutor
+
+        ray_stub.node_list = [
+            {"NodeManagerAddress": "10.0.0.1", "alive": True,
+             "Resources": {"CPU": 1}},
+            {"NodeManagerAddress": "10.0.0.2", "alive": True,
+             "Resources": {"CPU": 1}},
+        ]
+        died = []
+
+        def train():
+            rank = os.environ["HVDT_RANK"]
+            gen = os.environ["HVDT_GENERATION"]
+            host = os.environ["HVDT_HOSTNAME"]
+            if host == "10.0.0.2" and not died:
+                died.append(gen)
+                raise _FakeActorError("node 10.0.0.2 lost")
+            return f"ok-gen{gen}-rank{rank}-size{os.environ['HVDT_SIZE']}"
+
+        ex = ElasticRayExecutor(min_workers=1, max_workers=2,
+                                discovery_interval=0.05)
+        ex.start()
+        results = ex.run(train)
+        # Survivor generation: ONE rank, size 1, a later generation.
+        assert len(results) == 1
+        assert results[0].startswith("ok-gen")
+        assert results[0].endswith("size1")
+        assert died == ["1"]
+        # The dead host is blacklisted out of discovery.
+        assert ex._hm.is_blacklisted("10.0.0.2")
+
+    def test_hosts_updated_interrupt_grows_world(self, ray_stub):
+        """A worker observing a membership change raises
+        HostsUpdatedInterrupt (after committing): the driver records
+        READY — not FAILURE — and the next generation includes the new
+        node."""
+        import horovod_tpu as hvd
+        from horovod_tpu.orchestrate import ElasticRayExecutor
+
+        def train():
+            import time
+
+            gen = int(os.environ["HVDT_GENERATION"])
+            size = int(os.environ["HVDT_SIZE"])
+            if size == 1 and gen < 5:
+                if len(ray_stub.node_list) == 1:
+                    ray_stub.node_list.append(
+                        {"NodeManagerAddress": "10.0.0.9", "alive": True,
+                         "Resources": {"CPU": 1}})
+                # Commit point: the worker raises only once the driver's
+                # discovery has actually seen the new node (otherwise the
+                # next rendezvous reuses the stale 1-host snapshot).
+                deadline = time.monotonic() + 5
+                while (ex._hm.current.available_slots < 2
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+                raise hvd.HostsUpdatedInterrupt()
+            return f"gen{gen}-rank{os.environ['HVDT_RANK']}-size{size}"
+
+        ex = ElasticRayExecutor(min_workers=1, max_workers=2,
+                                discovery_interval=0.05)
+        results = ex.run(train)
+        assert len(results) == 2                 # grew to both nodes
+        assert all(r.endswith("size2") for r in results)
+        # No blacklisting on READY restarts.
+        assert not ex._hm.is_blacklisted("10.0.0.1")
+
+    def test_total_failure_raises(self, ray_stub):
+        from horovod_tpu.orchestrate import ElasticRayExecutor
+
+        def train():
+            raise _FakeActorError("boom")
+
+        ex = ElasticRayExecutor(min_workers=1, max_workers=1,
+                                discovery_interval=0.05)
+        with pytest.raises(RuntimeError, match="elastic ray job failed"):
+            ex.run(train)

@@ -177,3 +177,138 @@ class TestSparkRun:
         spark_stub.fail_with = ValueError("executor lost")
         with pytest.raises(ValueError, match="executor lost"):
             hspark.run(lambda: 0, num_proc=2)
+
+
+class _DataBarrierRDD(_BarrierRDD):
+    """Barrier RDD whose partitions carry real rows (DataFrame path)."""
+
+    def __init__(self, sc, partitions):
+        super().__init__(sc, len(partitions))
+        self._partitions = partitions
+
+    def collect(self):
+        if self._sc.fail_with is not None:
+            raise self._sc.fail_with
+        out = []
+        for rank, rows in enumerate(self._partitions):
+            _BarrierTaskContext.current = _BarrierTaskContext(
+                rank, self._sc.addresses(self._n))
+            try:
+                out.extend(self._f(iter(rows)))
+            finally:
+                _BarrierTaskContext.current = None
+        return out
+
+
+class _DataRDD(_DataBarrierRDD):
+    def barrier(self):
+        b = _DataBarrierRDD(self._sc, self._partitions)
+        return b
+
+
+class _StubDataFrame:
+    """Duck-typed pyspark DataFrame: rows + columns + repartition."""
+
+    def __init__(self, rows, columns, sc):
+        self._rows = list(rows)
+        self.columns = list(columns)
+        self._sc = sc
+        self._n = None
+
+    def repartition(self, n):
+        df = _StubDataFrame(self._rows, self.columns, self._sc)
+        df._n = n
+        return df
+
+    @property
+    def rdd(self):
+        n = self._n or self._sc.defaultParallelism
+        parts = [self._rows[r::n] for r in range(n)]
+        return _DataRDD(self._sc, parts)
+
+
+class TestRunOnDataFrame:
+    def test_rows_are_rank_sharded(self, spark_stub):
+        from horovod_tpu.orchestrate import spark as hs
+
+        rows = [{"f1": float(i), "f2": float(10 * i), "label": i % 2,
+                 "id": i} for i in range(7)]
+        df = _StubDataFrame(rows, ["f1", "f2", "label", "id"], spark_stub)
+
+        def fn(rows):
+            import os
+
+            return (os.environ["HVDT_RANK"], sorted(r["id"] for r in rows))
+
+        got = hs.run_on_dataframe(fn, df, num_proc=3)
+        # Per-rank results in rank order; rows partition the dataset.
+        assert [g[0] for g in got] == ["0", "1", "2"]
+        ids = [i for _, part in got for i in part]
+        assert sorted(ids) == list(range(7))
+        # Every rank saw a NON-overlapping, non-empty shard.
+        assert all(part for _, part in got)
+
+    def test_estimator_fit_dataframe_rank_shards(self, spark_stub,
+                                                 monkeypatch):
+        """fit(df) must dispatch the declarative loop inside barrier
+        tasks with each rank's own partition rows (VERDICT r2 #9)."""
+        from horovod_tpu import orchestrate
+        from horovod_tpu.orchestrate import estimator as est_mod
+
+        rows = [{"x": float(i), "label": float(2 * i)} for i in range(9)]
+        df = _StubDataFrame(rows, ["x", "label"], spark_stub)
+
+        shards = {}
+
+        def fake_fit(spec, x_train, y_train, x_val, y_val):
+            import os
+
+            rank = os.environ["HVDT_RANK"]
+            x, y = est_mod._rows_to_xy(x_train, spec["spark_df"]["label_col"],
+                                       spec["spark_df"]["feature_cols"])
+            shards[rank] = (x.tolist(), y.tolist())
+            return {"params": {"rank": rank, "n": len(x)},
+                    "history": [{"epoch": 0, "train_loss": 0.0}]}
+
+        monkeypatch.setattr(est_mod, "_declarative_fit", fake_fit)
+
+        est = orchestrate.JaxEstimator(
+            model_init=lambda key: {"w": np.zeros(1)},
+            loss_fn=lambda p, xb, yb: 0.0,
+            predict_fn=lambda p, x: x,
+            num_workers=3)
+        model = est.fit(df)
+        assert model.params == {"rank": "0", "n": 3}
+        # All 9 rows arrived, disjointly, 3 per rank, features/labels
+        # paired correctly (label = 2 * x).
+        assert sorted(shards) == ["0", "1", "2"]
+        seen = []
+        for x, y in shards.values():
+            assert len(x) == 3
+            for xi, yi in zip(x, y):
+                assert yi == 2 * xi[0]
+                seen.append(xi[0])
+        assert sorted(seen) == [float(i) for i in range(9)]
+
+
+class TestStore:
+    def test_local_store_roundtrip(self, tmp_path):
+        from horovod_tpu.orchestrate.store import LocalStore, Store
+
+        st = Store.create(str(tmp_path / "prefix"))
+        assert isinstance(st, LocalStore)
+        p = st.get_checkpoint_path("run1")
+        assert p.startswith(str(tmp_path)) and "run1" in p
+        st.write_bytes(p + "/ckpt.bin", b"abc")
+        assert st.exists(p + "/ckpt.bin")
+        assert st.read_bytes(p + "/ckpt.bin") == b"abc"
+
+    def test_remote_prefix_resolves_filesystem_store(self):
+        from horovod_tpu.orchestrate.store import FilesystemStore, Store
+
+        # fsspec+gcsfs are importable in this image, so the remote
+        # prefix resolves to a FilesystemStore (IO would need real
+        # credentials; only construction + path discipline here).
+        st = Store.create("gs://bucket/prefix")
+        assert isinstance(st, FilesystemStore)
+        assert st.get_checkpoint_path("r").startswith("gs://bucket/prefix")

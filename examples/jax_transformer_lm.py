@@ -13,6 +13,7 @@ CPU simulation of an 8-chip slice:
 """
 
 import argparse
+import contextlib
 import os
 import time
 
@@ -156,10 +157,20 @@ def main():
         varying = tuple(set(jax.typeof(l).vma) & {"pp", "sp", "ep"})
         return lax.pmean(l, varying) if varying else l
 
-    island = jax.shard_map(
+    # Open the manual island only over axes with degree > 1: a mesh with
+    # pp=sp=ep=1 runs the plain loss under GSPMD-auto sharding, where
+    # the model's own flash shard_map island (over dp/tp) can engage —
+    # nesting it inside a size-1 manual island would force the XLA
+    # attention fallback (models/transformer.py _flash_plan).
+    manual_axes = {ax for ax, d in (("pp", args.pp), ("sp", args.sp),
+                                    ("ep", args.ep)) if d > 1}
+    island = (jax.shard_map(
         _local_loss, mesh=mesh,
-        in_specs=(manual_spec(axes), P(None, "sp")),
-        out_specs=P(), axis_names={"pp", "sp", "ep"})
+        in_specs=(manual_spec(axes),
+                  P(None, "sp") if args.sp > 1 else P()),
+        out_specs=P(), axis_names=manual_axes)
+        if manual_axes else
+        (lambda p, t: transformer_loss(p, t, cfg)))
 
     # Single chip uses the plain loss (no shard_map island) so the
     # Pallas flash path can engage; the hybrid layout differentiates
@@ -171,12 +182,13 @@ def main():
             return optax.apply_updates(params, updates), opt_state, loss
         return train_step
 
-    # Single chip: stay meshless so Pallas kernels (flash attention) can
-    # engage — GSPMD cannot auto-partition Mosaic kernels, so any mesh
-    # with auto axes (even size-1) forces the XLA attention fallback.
-    # HVDT_LM_SINGLE=0/false/off forces the island path on one chip
-    # (A/B measurement of meshless-vs-island compilation; example-local
-    # knob, deliberately not in the framework's config registry).
+    # Single chip defaults to the meshless path (no shard_map island,
+    # measured ~5% faster back-to-back).  Flash engages under meshes too
+    # now — the model opens a partial-manual shard_map island over the
+    # GSPMD-auto axes (models/transformer.py _flash_plan) — so
+    # HVDT_LM_SINGLE=0/false/off remains only as the A/B knob for
+    # meshless-vs-island compilation (example-local, deliberately not in
+    # the framework's config registry).
     single = (need == 1 and not explicit_dp
               and os.environ.get("HVDT_LM_SINGLE", "1").lower()
               not in ("0", "false", "off"))
@@ -227,15 +239,21 @@ def main():
         rng.integers(0, args.vocab, (args.batch, args.seq),
                      dtype=np.int64).astype(np.int32), tok_sharding)
 
-    # Warmup/compile
-    params, opt_state, loss = step(params, opt_state, tokens)
-    first = float(loss)   # host fetch, not block_until_ready: see bench.py
-
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
+    # Non-single auto-sharded runs execute under the ambient mesh so the
+    # model's flash shard_map island sees the auto axes
+    # (jax.sharding.get_abstract_mesh in _flash_plan).
+    mesh_ctx = (jax.set_mesh(mesh) if not single and not explicit_dp
+                else contextlib.nullcontext())
+    with mesh_ctx:
+        # Warmup/compile
         params, opt_state, loss = step(params, opt_state, tokens)
-    last = float(loss)
-    dt = time.perf_counter() - t0
+        first = float(loss)   # host fetch, not block_until_ready: bench.py
+
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        last = float(loss)
+        dt = time.perf_counter() - t0
 
     tokens_sec = args.steps * args.batch * args.seq / dt
     tflops = (3 * transformer_flops_per_token(cfg) * tokens_sec) / 1e12

@@ -89,6 +89,12 @@ KNOBS: Dict[str, Knob] = {
         # --- kernels ---
         _k("HVDT_FLASH_ATTENTION", "auto", str,
            "Pallas flash-attention kernel: auto (TPU only), on, off."),
+        _k("HVDT_FLASH_BWD", "xla", str,
+           "flash_attention backward: xla (blockwise XLA recompute) or "
+           "kernel (Pallas flash_grad_block passes)."),
+        _k("HVDT_RING_PALLAS", False, _parse_bool,
+           "Run ring attention's per-step block update and backward "
+           "through the Pallas kernels (when shapes tile)."),
         # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
         #     LibType selection env_parser.cc) ---
         _k("HVDT_CPU_OPERATIONS", "xla", str,

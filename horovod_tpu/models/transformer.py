@@ -272,10 +272,21 @@ def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
         auto = ([n for n, t in zip(am.axis_names, am.axis_types)
                  if t == jax.sharding.AxisType.Auto]
                 if not am.empty else [])
+        manual = ([n for n, t in zip(am.axis_names, am.axis_types)
+                   if t == jax.sharding.AxisType.Manual]
+                  if not am.empty else [])
     except Exception:       # pragma: no cover - very old jax
-        auto = []
+        auto, manual = [], []
     if not auto:
         return "direct" if _flash_enabled(l, dh, batch=b, heads=h) else None
+    if manual:
+        # Already inside a shard_map (e.g. the pp/sp/ep pipeline island)
+        # with auto axes remaining: nesting another partial-manual island
+        # here fails shardy lowering on the BACKWARD (the residuals'
+        # dimension shardings mix manual-after-free axes — verified on
+        # jax 0.9: "manual axes must come before free axes").  Fall back
+        # to XLA attention; pure-auto meshes (dp/fsdp/tp) still engage.
+        return None
     # Shard batch over dp-like axes and heads over tp, where divisible.
     dp_axes: Tuple[str, ...] = tuple(a for a in ("dp", "fsdp")
                                      if a in auto)

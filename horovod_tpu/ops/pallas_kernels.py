@@ -248,6 +248,15 @@ def _flash_attn_fwd(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_attn_bwd(causal, scale, block_q, block_k, res, do):
     q, k, v, out, lse = res
+    from ..common import config
+
+    if config.get_str("HVDT_FLASH_BWD").lower() in ("kernel", "pallas"):
+        # Pallas backward passes (flash_grad_block) instead of the
+        # blockwise XLA recompute — A/B with HVDT_FLASH_BWD=kernel.
+        dq, dk, dv = flash_grad_block(q, k, v, do, out, lse,
+                                      causal=causal, scale=scale)
+        return (dq.astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
     b, lq, h, d = q.shape
     lk, hkv = k.shape[1], k.shape[2]
     group = h // hkv

@@ -103,10 +103,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         scale = d ** -0.5
     lk = k.shape[1]
 
+    kernel_legal = (segment_ids is None
+                    and not (lq % min(128, lq) or lk % min(128, lk)))
     if use_pallas is None:
-        use_pallas = False
-    elif use_pallas and (segment_ids is not None
-                         or lq % min(128, lq) or lk % min(128, lk)):
+        # Env-driven default (HVDT_RING_PALLAS=1): engage the kernels
+        # where they are legal, silently keep the jnp path elsewhere.
+        from ..common import config
+
+        use_pallas = config.get_bool("HVDT_RING_PALLAS") and kernel_legal
+    elif use_pallas and not kernel_legal:
         import warnings
 
         warnings.warn(

@@ -93,25 +93,34 @@ def _load_parquet_shard(hvd, spec: Dict[str, Any], row_groups):
 
 
 def _split_and_pad_local(hvd, spec: Dict[str, Any], x, y):
-    """Worker-side lockstep discipline shared by the Parquet and
-    Spark-DataFrame ingestion paths: local validation split (before
-    padding, so no train row can leak in), then wrap-padding of the
-    train rows to the cross-rank MAX length so every rank runs the same
-    number of lockstep collective steps."""
-    split = spec["validation_split"]
+    """Worker-side lockstep discipline over the established hvd world
+    (Parquet + declarative DataFrame paths): the length exchange is one
+    MAX allreduce carrying (len, -len) so every rank also learns the MIN
+    — a rank with zero train rows must fail on ALL ranks at once, not
+    strand the others in the next collective until timeout."""
+    def exchange(n_train):
+        agg = np.asarray(hvd.allreduce(
+            np.asarray([n_train, -n_train], np.int64), op=hvd.Max,
+            name="est_parquet/target"))
+        return int(agg[0]), int(-agg[1])
+
+    return _split_pad_discipline(x, y, spec["validation_split"], exchange)
+
+
+def _split_pad_discipline(x, y, validation_split: float, exchange):
+    """Shared worker-side lockstep discipline: local validation split
+    (before padding, so no train row can leak in), then wrap-padding of
+    the train rows to the cross-rank MAX length so every rank runs the
+    same number of lockstep collective steps.  ``exchange(n_train)``
+    returns the cross-rank (max, min) lengths — hvd MAX-allreduce or
+    rendezvous-KV, depending on what the calling path has available."""
+    split = validation_split
     n_val = max(1, int(round(len(x) * split))) if split > 0 else 0
     x_train, y_train = x[:len(x) - n_val], y[:len(y) - n_val]
     x_val = x[len(x) - n_val:] if n_val else None
     y_val = y[len(y) - n_val:] if n_val else None
 
-    # Equal lockstep length across ranks: wrap-pad to the max shard size.
-    # One MAX allreduce carries (len, -len) so every rank also learns the
-    # MIN — a rank with zero train rows must fail on ALL ranks at once,
-    # not strand the others in the next collective until timeout.
-    agg = np.asarray(hvd.allreduce(
-        np.asarray([len(x_train), -len(x_train)], np.int64), op=hvd.Max,
-        name="est_parquet/target"))
-    target, min_len = int(agg[0]), int(-agg[1])
+    target, min_len = exchange(len(x_train))
     if min_len == 0:
         raise ValueError("a worker received only validation rows — "
                          "use more rows per partition or a smaller split")
@@ -120,6 +129,36 @@ def _split_and_pad_local(hvd, spec: Dict[str, Any], x, y):
         x_train = np.concatenate([x_train, x_train[reps]])
         y_train = np.concatenate([y_train, y_train[reps]])
     return x_train, y_train, x_val, y_val
+
+
+def kv_exchange_shard_lengths(n_rows: int, timeout: float = 120.0):
+    """Cross-rank (max, min) of per-rank row counts over the rendezvous
+    KV — the lockstep-padding handshake for barrier-task training paths
+    that have not (yet) formed an hvd world.  Requires the launcher env
+    contract (HVDT_RANK/SIZE + rendezvous address) in os.environ."""
+    import os
+
+    from ..runner.http_kv import KVClient
+
+    rank = int(os.environ["HVDT_RANK"])
+    size = int(os.environ["HVDT_SIZE"])
+    kv = KVClient.from_env(os.environ)
+    kv.put(f"/dfshard/len/{rank}", str(int(n_rows)).encode())
+    # KVClient.wait raises TimeoutError itself when a peer never posts.
+    lens = [int(kv.wait(f"/dfshard/len/{r}", timeout=timeout))
+            for r in range(size)]
+    return max(lens), min(lens)
+
+
+def df_rows_to_shards(rows, label_col: str, feature_cols,
+                      validation_split: float):
+    """Barrier-task DataFrame ingestion shared by the framework
+    estimators: rows -> (x_train, y_train, x_val, y_val) with the shared
+    split/pad discipline, lengths exchanged over the rendezvous KV (no
+    hvd world needed yet)."""
+    x, y = _rows_to_xy(rows, label_col, feature_cols)
+    return _split_pad_discipline(x, y, validation_split,
+                                 kv_exchange_shard_lengths)
 
 
 def _rows_to_xy(rows, label_col: str, feature_cols):

@@ -136,6 +136,8 @@ class KerasEstimator:
                  validation_split: float = 0.0,
                  custom_objects: Optional[Dict] = None,
                  store: Optional[str] = None,
+                 label_col: str = "label",
+                 feature_cols=None,
                  env: Optional[Dict[str, str]] = None):
         if model is None:
             raise ValueError("KerasEstimator requires a compiled model")
@@ -147,15 +149,22 @@ class KerasEstimator:
         self.model = model
         self.num_workers = num_workers
         self._env = env
+        self._label_col = label_col
+        self._feature_cols = feature_cols
         self._spec = {"epochs": int(epochs), "batch_size": int(batch_size),
                       "shuffle": bool(shuffle),
                       "validation_split": float(validation_split),
                       "custom_objects": custom_objects, "store": store}
         self.history_: List[Dict[str, float]] = []
 
-    def fit(self, x: np.ndarray, y: np.ndarray) -> KerasModel:
-        from .estimator import collective_worker_env, split_and_shard
+    def fit(self, x, y: Optional[np.ndarray] = None) -> KerasModel:
+        from .estimator import (_is_spark_dataframe, collective_worker_env,
+                                split_and_shard)
 
+        if _is_spark_dataframe(x):
+            return self._fit_spark_df(x, y)
+        if y is None:
+            raise ValueError("array-mode fit needs y")
         x, y = np.asarray(x), np.asarray(y)
         model_bytes = _model_to_bytes(self.model)
         xs, ys, xv, yv = split_and_shard(
@@ -179,3 +188,56 @@ class KerasEstimator:
                                         "custom_objects"])
         self.history_ = out["history"]
         return KerasModel(trained, out["history"])
+
+    def _fit_spark_df(self, df, y) -> KerasModel:
+        """fit(df): training runs inside Spark barrier tasks on each
+        task's own partition (ref: spark/keras/estimator.py fit over
+        DataFrames; same worker-side split/pad discipline as
+        JaxEstimator's DataFrame path)."""
+        from . import spark as spark_mod
+        from .estimator import collective_worker_env
+
+        if y is not None:
+            raise ValueError(
+                "DataFrame fit carries labels in label_col "
+                f"({self._label_col!r}); pass y=None")
+        model_bytes = _model_to_bytes(self.model)
+        spec = dict(self._spec)
+        meta = {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None)}
+
+        def task(rows):
+            return _keras_df_worker(spec, meta, model_bytes, rows)
+
+        results = spark_mod.run_on_dataframe(
+            task, df, num_proc=self.num_workers,
+            env=collective_worker_env(self._env))
+        out = results[0]
+        if out is None or "model" not in out:
+            raise RuntimeError("rank 0 returned no model")
+        # Same one-world guard as array mode: barrier tasks that fail to
+        # rendezvous (coordinator unreachable from executors) would each
+        # train as a size-1 island on its own partition — that must be an
+        # error, not a silently under-trained model.
+        sizes = {r["size"] for r in results if r}
+        if sizes != {self.num_workers}:
+            raise RuntimeError(
+                f"workers did not form one world of {self.num_workers} "
+                f"(saw sizes {sizes}) — collective training did not run")
+        trained = _model_from_bytes(out["model"], distributed=False,
+                                    custom_objects=spec["custom_objects"])
+        self.history_ = out["history"]
+        return KerasModel(trained, out["history"])
+
+
+def _keras_df_worker(spec, meta, model_bytes, rows):
+    """Barrier-task body for fit(df): materialize this partition's rows,
+    apply the shared split/pad discipline (KV length exchange), then run
+    the standard keras worker."""
+    from .estimator import df_rows_to_shards
+
+    x, y, xv, yv = df_rows_to_shards(rows, meta["label_col"],
+                                     meta["feature_cols"],
+                                     spec["validation_split"])
+    return _keras_worker(spec, model_bytes, x, y, xv, yv)

@@ -135,6 +135,7 @@ class TorchEstimator:
     def __init__(self, model=None, optimizer=None, loss=None,
                  num_workers: int = 1, epochs: int = 1,
                  batch_size: int = 32, shuffle: bool = True, seed: int = 0,
+                 label_col: str = "label", feature_cols=None,
                  env: Optional[Dict[str, str]] = None):
         if model is None or optimizer is None or loss is None:
             raise ValueError("TorchEstimator requires model, optimizer "
@@ -142,6 +143,8 @@ class TorchEstimator:
         self.model = model
         self.num_workers = num_workers
         self._env = env
+        self._label_col = label_col
+        self._feature_cols = feature_cols
         # Serialize the optimizer's full param-group structure by param
         # POSITION in model.parameters() order (ids differ per process).
         pos = {id(p): i for i, p in enumerate(model.parameters())}
@@ -161,9 +164,15 @@ class TorchEstimator:
                       "shuffle": bool(shuffle), "seed": int(seed)}
         self.history_: List[Dict[str, float]] = []
 
-    def fit(self, x: np.ndarray, y: np.ndarray) -> TorchModel:
+    def fit(self, x, y: Optional[np.ndarray] = None) -> TorchModel:
         import torch
 
+        from .estimator import _is_spark_dataframe
+
+        if _is_spark_dataframe(x):
+            return self._fit_spark_df(x, y)
+        if y is None:
+            raise ValueError("array-mode fit needs y")
         x, y = np.asarray(x), np.asarray(y)
         buf = io.BytesIO()
         torch.save(self.model, buf)
@@ -188,3 +197,55 @@ class TorchEstimator:
             torch.load(io.BytesIO(out["state"]), weights_only=False))
         self.history_ = out["history"]
         return TorchModel(trained, out["history"])
+
+    def _fit_spark_df(self, df, y) -> TorchModel:
+        """fit(df): training inside Spark barrier tasks, rank r on
+        partition r (ref: spark/torch/estimator.py fit over DataFrames;
+        same worker-side split/pad discipline as the other estimators)."""
+        import torch
+
+        from . import spark as spark_mod
+
+        if y is not None:
+            raise ValueError(
+                "DataFrame fit carries labels in label_col "
+                f"({self._label_col!r}); pass y=None")
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+        model_bytes = buf.getvalue()
+        spec = dict(self._spec)
+        meta = {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None)}
+
+        def task(rows):
+            return _torch_df_worker(spec, meta, model_bytes, rows)
+
+        results = spark_mod.run_on_dataframe(
+            task, df, num_proc=self.num_workers,
+            env=collective_worker_env(self._env))
+        out = results[0]
+        if out is None or "state" not in out:
+            raise RuntimeError("rank 0 returned no model state")
+        # Same one-world guard as array mode (see keras_estimator).
+        sizes = {r["size"] for r in results if r}
+        if sizes != {self.num_workers}:
+            raise RuntimeError(
+                f"workers did not form one world of {self.num_workers} "
+                f"(saw sizes {sizes}) — collective training did not run")
+        trained = torch.load(io.BytesIO(model_bytes), weights_only=False)
+        trained.load_state_dict(
+            torch.load(io.BytesIO(out["state"]), weights_only=False))
+        self.history_ = out["history"]
+        return TorchModel(trained, out["history"])
+
+
+def _torch_df_worker(spec, meta, model_bytes, rows):
+    """Barrier-task body for fit(df): rows -> padded shard -> the
+    standard torch worker (validation handled by the torch loop's own
+    knobs; torch fit has no val split today, matching array mode)."""
+    from .estimator import df_rows_to_shards
+
+    x, y, _, _ = df_rows_to_shards(rows, meta["label_col"],
+                                   meta["feature_cols"], 0.0)
+    return _torch_worker(spec, model_bytes, x, y)

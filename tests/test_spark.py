@@ -312,3 +312,96 @@ class TestStore:
         st = Store.create("gs://bucket/prefix")
         assert isinstance(st, FilesystemStore)
         assert st.get_checkpoint_path("r").startswith("gs://bucket/prefix")
+
+
+class TestKVShardLengthExchange:
+    def test_max_min_across_ranks(self):
+        """The DataFrame-path padding handshake (no hvd world needed):
+        rank 0 exchanges lengths over a real rendezvous KV against a
+        pre-posted peer value (the peer side is just a KV put — the
+        interesting machinery is the waiting reader)."""
+        from horovod_tpu.orchestrate.estimator import (
+            kv_exchange_shard_lengths)
+        from horovod_tpu.runner.http_kv import RendezvousServer, new_secret
+
+        server = RendezvousServer(secret=new_secret())
+        port = server.start()
+        server.put_local("/dfshard/len/1", b"7")   # the peer's post
+        saved = dict(os.environ)
+        os.environ.update({"HVDT_RENDEZVOUS_ADDR": "127.0.0.1",
+                           "HVDT_RENDEZVOUS_PORT": str(port),
+                           "HVDT_SECRET": server.secret.hex(),
+                           "HVDT_SIZE": "2", "HVDT_RANK": "0"})
+        try:
+            got = kv_exchange_shard_lengths(4, timeout=30)
+        finally:
+            os.environ.clear()
+            os.environ.update(saved)
+            server.stop()
+        assert got == (7, 4)
+
+
+class TestFrameworkEstimatorsDataFrame:
+    def test_keras_fit_df_rank_shards(self, spark_stub, monkeypatch):
+        keras = pytest.importorskip("keras")
+        from horovod_tpu.orchestrate import KerasEstimator
+        from horovod_tpu.orchestrate import keras_estimator as ke
+
+        rows = [{"x": float(i), "label": float(3 * i)} for i in range(6)]
+        df = _StubDataFrame(rows, ["x", "label"], spark_stub)
+        shards = {}
+
+        def fake_worker(spec, meta, model_bytes, rws):
+            rank = os.environ["HVDT_RANK"]
+            shards[rank] = sorted(r["x"] for r in rws)
+            out = {"size": 2}
+            if rank == "0":
+                out["model"] = model_bytes    # untrained round-trip
+                out["history"] = [{"loss": 0.0}]
+            return out
+
+        monkeypatch.setattr(ke, "_keras_df_worker", fake_worker)
+        model = keras.Sequential(
+            [keras.layers.Input((1,)), keras.layers.Dense(1)])
+        model.compile(optimizer="sgd", loss="mse")
+        est = KerasEstimator(model=model, num_workers=2)
+        trained = est.fit(df)
+        assert sorted(shards) == ["0", "1"]
+        all_x = sorted(v for s in shards.values() for v in s)
+        assert all_x == [float(i) for i in range(6)]
+        assert trained is not None
+
+    def test_torch_fit_df_rank_shards(self, spark_stub, monkeypatch):
+        torch = pytest.importorskip("torch")
+        from horovod_tpu.orchestrate import TorchEstimator
+        from horovod_tpu.orchestrate import torch_estimator as te
+
+        rows = [{"x": float(i), "label": float(i)} for i in range(6)]
+        df = _StubDataFrame(rows, ["x", "label"], spark_stub)
+        shards = {}
+
+        def fake_worker(spec, meta, model_bytes, rws):
+            import io
+
+            rank = os.environ["HVDT_RANK"]
+            shards[rank] = sorted(r["x"] for r in rws)
+            out = {"size": 2}
+            if rank == "0":
+                m = torch.load(io.BytesIO(model_bytes), weights_only=False)
+                buf = io.BytesIO()
+                torch.save(m.state_dict(), buf)
+                out["state"] = buf.getvalue()
+                out["history"] = [{"loss": 0.0}]
+            return out
+
+        monkeypatch.setattr(te, "_torch_df_worker", fake_worker)
+        model = torch.nn.Linear(1, 1)
+        est = TorchEstimator(model=model,
+                             optimizer=torch.optim.SGD(model.parameters(),
+                                                       lr=0.1),
+                             loss=torch.nn.MSELoss(), num_workers=2)
+        trained = est.fit(df)
+        assert sorted(shards) == ["0", "1"]
+        all_x = sorted(v for s in shards.values() for v in s)
+        assert all_x == [float(i) for i in range(6)]
+        assert trained is not None

@@ -26,7 +26,8 @@ import numpy as np
 def parse_args(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "mlp", "transformer"])
+                   choices=["resnet50", "resnet101", "vgg16", "mlp",
+                            "transformer"])
     p.add_argument("--batch-size", type=int, default=32,
                    help="per-device batch size")
     p.add_argument("--num-iters", type=int, default=10)
@@ -59,11 +60,12 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
     global_batch = args.batch_size * n_dev
 
     key = jax.random.PRNGKey(0)
-    if args.model == "resnet50":
+    if args.model in ("resnet50", "resnet101"):
         from horovod_tpu.models import (ResNetConfig, resnet50_init,
                                         resnet_loss)
 
-        cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16)
+        cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16,
+                           depth=int(args.model[6:]))
         params, stats = resnet50_init(key, cfg)
         data = jax.random.normal(
             key, (global_batch, args.image_size, args.image_size, 3),
@@ -73,6 +75,19 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
         def loss_fn(p, xb, yb):
             loss, _ = resnet_loss(p, stats, xb, yb, cfg)
             return loss
+    elif args.model == "vgg16":
+        from horovod_tpu.models import VGGConfig, vgg16_init, vgg_loss
+
+        cfg = VGGConfig(num_classes=1000, dtype=jnp.bfloat16,
+                        image_size=args.image_size)
+        params = vgg16_init(key, cfg)
+        data = jax.random.normal(
+            key, (global_batch, args.image_size, args.image_size, 3),
+            jnp.bfloat16)
+        labels = jnp.zeros((global_batch,), jnp.int32)
+
+        def loss_fn(p, xb, yb):
+            return vgg_loss(p, xb, yb, cfg)
     elif args.model == "transformer":
         from horovod_tpu.models import (TransformerConfig, transformer_init,
                                         transformer_loss)

@@ -20,7 +20,14 @@ from .transformer import (  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNetConfig,
     resnet50_init,
+    resnet101_init,
     resnet_apply,
     resnet_loss,
+)
+from .vgg import (  # noqa: F401
+    VGGConfig,
+    vgg16_init,
+    vgg_apply,
+    vgg_loss,
 )
 from .mlp import mlp_init, mlp_apply, mlp_loss  # noqa: F401

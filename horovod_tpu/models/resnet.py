@@ -26,10 +26,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["ResNetConfig", "resnet50_init", "resnet_apply", "resnet_loss"]
+__all__ = ["ResNetConfig", "resnet50_init", "resnet101_init",
+           "resnet_apply", "resnet_loss"]
 
-# Stage layout for ResNet-50: (blocks, mid-channels) per stage.
-_R50_STAGES = ((3, 64), (4, 128), (6, 256), (3, 512))
+# Stage layouts: (blocks, mid-channels) per stage.  ResNet-101 is the
+# reference's published benchmark model (docs/benchmarks.rst:27-43 —
+# 1656.82 img/s over 16 P100s); ResNet-50 is its synthetic-benchmark
+# default (examples/pytorch/pytorch_synthetic_benchmark.py:17-26).
+_STAGES = {
+    50: ((3, 64), (4, 128), (6, 256), (3, 512)),
+    101: ((3, 64), (4, 128), (23, 256), (3, 512)),
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +60,7 @@ class ResNetConfig:
     # a 3-channel conv and shrinking the 224x224 input slicing XLA
     # otherwise does.  "conv" keeps the literal 7x7 conv.
     stem: str = "s2d"
+    depth: int = 50              # 50 or 101 (bottleneck stage layouts)
 
 
 def _conv_init(key, kh, kw, cin, cout, dtype):
@@ -72,15 +80,16 @@ def _bn_stats(c):
 
 def resnet50_init(key: jax.Array, cfg: ResNetConfig
                   ) -> Tuple[Dict, Dict]:
-    """Returns (params, batch_stats)."""
+    """Returns (params, batch_stats) for the cfg's depth (50 default)."""
     pd = cfg.param_dtype
-    n_blocks = sum(b for b, _ in _R50_STAGES)
+    stages = _STAGES[cfg.depth]
+    n_blocks = sum(b for b, _ in stages)
     keys = iter(jax.random.split(key, 4 + n_blocks * 4))
     params: Dict = {"conv_stem": _conv_init(next(keys), 7, 7, 3, 64, pd),
                     "bn_stem": _bn_init(64, pd)}
     stats: Dict = {"bn_stem": _bn_stats(64)}
     cin = 64
-    for si, (blocks, mid) in enumerate(_R50_STAGES):
+    for si, (blocks, mid) in enumerate(stages):
         cout = mid * 4
         for bi in range(blocks):
             name = f"s{si}b{bi}"
@@ -105,6 +114,21 @@ def resnet50_init(key: jax.Array, cfg: ResNetConfig
                       * (cin ** -0.5)).astype(pd)
     params["fc_b"] = jnp.zeros((cfg.num_classes,), pd)
     return params, stats
+
+
+def resnet101_init(key: jax.Array, cfg: ResNetConfig
+                   ) -> Tuple[Dict, Dict]:
+    """ResNet-101 (the reference's published benchmark model,
+    ref: docs/benchmarks.rst:27-43).  Returns (params, batch_stats).
+
+    Requires ``cfg.depth == 101``: ``resnet_apply`` walks the stage
+    layout from the SAME cfg, so silently patching depth here would
+    leave the caller applying a ResNet-50 subgraph over 101's params."""
+    if cfg.depth != 101:
+        raise ValueError(
+            f"resnet101_init needs ResNetConfig(depth=101), got "
+            f"depth={cfg.depth} — resnet_apply uses cfg.depth too")
+    return resnet50_init(key, cfg)
 
 
 def _conv(x, w, stride=1):
@@ -216,7 +240,7 @@ def resnet_apply(params: Dict, batch_stats: Dict, images: jax.Array,
         block = jax.checkpoint(_block, policy=policy, static_argnums=(3,))
     else:
         block = _block
-    for si, (blocks, _) in enumerate(_R50_STAGES):
+    for si, (blocks, _) in enumerate(_STAGES[cfg.depth]):
         for bi in range(blocks):
             name = f"s{si}b{bi}"
             stride = 2 if (bi == 0 and si > 0) else 1

@@ -1,0 +1,90 @@
+"""VGG-16, pure-JAX pytree implementation.
+
+The third model of the reference's published benchmark table
+(ref: docs/benchmarks.rst:8-14 — 68% scaling efficiency at 512 GPUs,
+the hard case: 138M params, most of them in the FC layers, so gradient
+traffic dominates).  Provided for the same role here: the
+communication-heavy end of the synthetic benchmark/scaling harness
+(examples/jax_synthetic_benchmark.py --model vgg16).
+
+TPU-first choices: NHWC, bf16 compute with f32 params, classifier FCs
+as big MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["VGGConfig", "vgg16_init", "vgg_apply", "vgg_loss"]
+
+# Configuration D (VGG-16): conv channel per layer, "M" = 2x2 maxpool.
+_VGG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+
+
+@dataclasses.dataclass(frozen=True)
+class VGGConfig:
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    image_size: int = 224
+
+
+def vgg16_init(key: jax.Array, cfg: VGGConfig) -> Dict:
+    pd = cfg.param_dtype
+    n_conv = sum(1 for c in _VGG16 if c != "M")
+    keys = iter(jax.random.split(key, n_conv + 3))
+    params: Dict = {}
+    cin = 3
+    for i, c in enumerate(_VGG16):
+        if c == "M":
+            continue
+        fan_in = 9 * cin
+        params[f"conv{i}"] = {
+            "w": (jax.random.normal(next(keys), (3, 3, cin, c))
+                  * (2.0 / fan_in) ** 0.5).astype(pd),
+            "b": jnp.zeros((c,), pd)}
+        cin = c
+    spatial = cfg.image_size // 32          # five 2x pools
+    flat = spatial * spatial * 512
+    for name, (fi, fo) in (("fc1", (flat, 4096)), ("fc2", (4096, 4096)),
+                           ("fc3", (4096, cfg.num_classes))):
+        params[name] = {
+            "w": (jax.random.normal(next(keys), (fi, fo)) * fi ** -0.5
+                  ).astype(pd),
+            "b": jnp.zeros((fo,), pd)}
+    return params
+
+
+def vgg_apply(params: Dict, images: jax.Array, cfg: VGGConfig) -> jax.Array:
+    """images: [N, H, W, 3] -> logits [N, classes]."""
+    x = images.astype(cfg.dtype)
+    for i, c in enumerate(_VGG16):
+        if c == "M":
+            x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1),
+                                  (1, 2, 2, 1), "VALID")
+            continue
+        p = params[f"conv{i}"]
+        x = lax.conv_general_dilated(
+            x, p["w"].astype(x.dtype), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + p["b"].astype(x.dtype))
+    x = x.reshape(x.shape[0], -1)
+    for name, act in (("fc1", True), ("fc2", True), ("fc3", False)):
+        p = params[name]
+        x = x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+        if act:
+            x = jax.nn.relu(x)
+    return x.astype(jnp.float32)
+
+
+def vgg_loss(params: Dict, images: jax.Array, labels: jax.Array,
+             cfg: VGGConfig) -> jax.Array:
+    logits = vgg_apply(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, -1)
+    return -jnp.take_along_axis(logp, labels[:, None], -1).mean()

@@ -33,6 +33,34 @@ BASELINE_IMG_S_PER_DEVICE = 1656.82 / 16.0
 METRIC = "resnet50_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
 
+# Last-known-good cache: every successful accelerator measurement is
+# persisted here so a chip outage at snapshot time degrades the round's
+# perf evidence to "cached, timestamped" instead of erasing it (the
+# round-3 failure mode: two timeouts -> the only recorded number was the
+# CPU fallback's 0.4 img/s).
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              ".bench_last_good.json")
+
+
+def _save_last_good(line: str) -> None:
+    try:
+        d = json.loads(line)
+        if d.get("platform") in (None, "cpu"):
+            return
+        d["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        with open(LAST_GOOD_PATH, "w") as f:
+            json.dump(d, f, indent=1)
+    except OSError as e:  # cache write must never sink the bench
+        print(f"last-good cache write failed: {e!r}", file=sys.stderr)
+
+
+def _load_last_good():
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
 # bf16 peak TFLOP/s and HBM GB/s by TPU generation (device_kind substring,
 # lowercase).
 _PEAK = (
@@ -269,19 +297,26 @@ def main() -> None:
     # Measured healthy run: ~100s (17s compile + warmup + 5x12s iters).
     # The margin absorbs tunnel-claim latency and host-core contention
     # (measured: a concurrent pytest run on this 1-core box pushed the
-    # child past 300s).
+    # child past 300s).  Attempts are SPREAD (default worst case:
+    # 420+300+300 + 2x150 s sleep = ~22 min before the CPU fallback):
+    # round 3's two attempts 10 s apart both sampled the same outage
+    # window; a sleep between attempts survives short contention bursts
+    # and costs nothing when the chip is healthy (first attempt wins).
     attempt_timeouts = [
         int(t) for t in os.environ.get(
-            "HVDT_BENCH_ATTEMPT_TIMEOUTS", "420,300").split(",")]
+            "HVDT_BENCH_ATTEMPT_TIMEOUTS", "420,300,300").split(",")]
+    attempt_sleep = int(os.environ.get("HVDT_BENCH_ATTEMPT_SLEEP", "150"))
     notes = []
     for i, to in enumerate(attempt_timeouts):
         ok, line, note = _spawn(base, to)
         if ok and line:
+            _save_last_good(line)
             print(line)
             return
         notes.append(f"attempt{i}: {note}")
         print(f"bench attempt {i} failed: {note}", file=sys.stderr)
-        time.sleep(10)
+        if i + 1 < len(attempt_timeouts):
+            time.sleep(attempt_sleep)
 
     # Phase 2: small CPU fallback so the driver still records a real
     # measurement (clearly marked platform=cpu).
@@ -291,10 +326,13 @@ def main() -> None:
     ok, line, note = _spawn(cpu_args,
                             int(os.environ.get("HVDT_BENCH_CPU_TIMEOUT",
                                                "600")), cpu_only=True)
+    last_good = _load_last_good()
     if ok and line:
         d = json.loads(line)
         d["error"] = "accelerator unavailable; CPU fallback — " + \
             "; ".join(notes)
+        if last_good:
+            d["last_good"] = last_good
         print(json.dumps(d))
         return
 
@@ -305,6 +343,7 @@ def main() -> None:
         "platform": None, "device_kind": None, "mfu": None,
         "hbm_util": None,
         "error": "; ".join(notes)[-1500:],
+        **({"last_good": last_good} if last_good else {}),
     }))
 
 

@@ -91,7 +91,9 @@ KNOBS: Dict[str, Knob] = {
            "Pallas flash-attention kernel: auto (TPU only), on, off."),
         _k("HVDT_FLASH_BWD", "xla", str,
            "flash_attention backward: xla (blockwise XLA recompute) or "
-           "kernel (Pallas flash_grad_block passes)."),
+           "kernel (Pallas flash_grad_block passes). Read at TRACE time "
+           "inside the custom_vjp: a grad function jitted before the env "
+           "changed keeps its old backward until re-traced."),
         _k("HVDT_RING_PALLAS", False, _parse_bool,
            "Run ring attention's per-step block update and backward "
            "through the Pallas kernels (when shapes tile)."),

@@ -253,8 +253,14 @@ def _flash_attn_bwd(causal, scale, block_q, block_k, res, do):
     if config.get_str("HVDT_FLASH_BWD").lower() in ("kernel", "pallas"):
         # Pallas backward passes (flash_grad_block) instead of the
         # blockwise XLA recompute — A/B with HVDT_FLASH_BWD=kernel.
+        # NOTE: this env read happens at TRACE time (custom_vjp bwd is
+        # traced under jit); flipping the env after a grad function is
+        # compiled does not change its backward until re-trace.  The
+        # caller's forward block sizes are forwarded so the A/B against
+        # the XLA path above is like-for-like (both re-fit internally).
         dq, dk, dv = flash_grad_block(q, k, v, do, out, lse,
-                                      causal=causal, scale=scale)
+                                      causal=causal, scale=scale,
+                                      block_q=block_q, block_k=block_k)
         return (dq.astype(q.dtype), dk.astype(k.dtype),
                 dv.astype(v.dtype))
     b, lq, h, d = q.shape

@@ -122,8 +122,10 @@ def _split_pad_discipline(x, y, validation_split: float, exchange):
 
     target, min_len = exchange(len(x_train))
     if min_len == 0:
-        raise ValueError("a worker received only validation rows — "
-                         "use more rows per partition or a smaller split")
+        raise ValueError(
+            "a worker contributed ZERO training rows (empty partition, or "
+            "only validation rows after the split) — use more rows per "
+            "partition, fewer workers, or a smaller validation_split")
     if len(x_train) < target:
         reps = [i % len(x_train) for i in range(target - len(x_train))]
         x_train = np.concatenate([x_train, x_train[reps]])
@@ -131,7 +133,7 @@ def _split_pad_discipline(x, y, validation_split: float, exchange):
     return x_train, y_train, x_val, y_val
 
 
-def kv_exchange_shard_lengths(n_rows: int, timeout: float = 120.0):
+def kv_exchange_shard_lengths(n_rows: int, timeout: Optional[float] = None):
     """Cross-rank (max, min) of per-rank row counts over the rendezvous
     KV — the lockstep-padding handshake for barrier-task training paths
     that have not (yet) formed an hvd world.  Requires the launcher env
@@ -140,6 +142,8 @@ def kv_exchange_shard_lengths(n_rows: int, timeout: float = 120.0):
 
     from ..runner.http_kv import KVClient
 
+    if timeout is None:
+        timeout = float(os.environ.get("HVDT_DFSHARD_TIMEOUT", "120"))
     rank = int(os.environ["HVDT_RANK"])
     size = int(os.environ["HVDT_SIZE"])
     kv = KVClient.from_env(os.environ)
@@ -155,7 +159,17 @@ def df_rows_to_shards(rows, label_col: str, feature_cols,
     """Barrier-task DataFrame ingestion shared by the framework
     estimators: rows -> (x_train, y_train, x_val, y_val) with the shared
     split/pad discipline, lengths exchanged over the rendezvous KV (no
-    hvd world needed yet)."""
+    hvd world needed yet).
+
+    An EMPTY partition must fail on ALL ranks at once: this rank posts
+    its length (0) to the KV *before* raising, so peers' exchange
+    completes immediately and min==0 raises everywhere — instead of
+    stranding them in kv.wait until the full timeout."""
+    if not rows:
+        kv_exchange_shard_lengths(0)
+        raise ValueError(
+            "a barrier task received an EMPTY DataFrame partition — "
+            "repartition produced skew; use more rows or fewer workers")
     x, y = _rows_to_xy(rows, label_col, feature_cols)
     return _split_pad_discipline(x, y, validation_split,
                                  kv_exchange_shard_lengths)
@@ -233,8 +247,15 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
         # rows; materialize + apply the shared local split/pad
         # discipline (ref: dataframe->Petastorm prep, spark/common/util.py).
         meta = spec["spark_df"]
-        x, y = _rows_to_xy(x_train, meta["label_col"],
-                           meta["feature_cols"])
+        if x_train:
+            x, y = _rows_to_xy(x_train, meta["label_col"],
+                               meta["feature_cols"])
+        else:
+            # Empty partition: enter the length exchange with 0 rows so
+            # ALL ranks fail the min==0 check together instead of peers
+            # hanging in the allreduce this rank never reached.
+            x = np.zeros((0, 1), np.float32)
+            y = np.zeros((0,), np.float32)
         x_train, y_train, x_val, y_val = _split_and_pad_local(
             hvd, spec, x, y)
     x_train = np.asarray(x_train)
@@ -306,7 +327,8 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             manager.save(epoch, params, force=True)
         hvd.barrier()
 
-    return {"params": jax.tree.map(np.asarray, params), "history": history}
+    return {"params": jax.tree.map(np.asarray, params), "history": history,
+            "size": hvd.size()}
 
 
 class JaxEstimator:
@@ -356,12 +378,31 @@ class JaxEstimator:
         self._env = env
         self._label_col = label_col
         self._feature_cols = feature_cols
-        if store is not None and not isinstance(store, str):
-            # Store abstraction (orchestrate/store.py): checkpoints go
-            # under the prefix's run-path discipline.
-            from .store import Store
+        if store is not None:
+            from .store import _REMOTE_SCHEMES, Store
 
-            store = Store.create(store).get_checkpoint_path()
+            if isinstance(store, str):
+                # A str store is a LOCAL checkpoint directory, used
+                # verbatim.  Remote prefixes must come in as Store
+                # objects once CheckpointManager writes through the
+                # Store IO backend; today it writes the local
+                # filesystem only, so a raw "gs://..." string would
+                # silently become a literal ./gs: directory.
+                if store.startswith(_REMOTE_SCHEMES):
+                    raise ValueError(
+                        f"store={store!r}: remote store prefixes are not "
+                        "supported as plain strings — CheckpointManager "
+                        "writes the local filesystem; pass a local "
+                        "directory path (or mount the bucket)")
+            else:
+                # Store abstraction (orchestrate/store.py): checkpoints
+                # go under the prefix's run-path discipline.
+                store = Store.create(store).get_checkpoint_path()
+                if store.startswith(_REMOTE_SCHEMES):
+                    raise ValueError(
+                        f"store checkpoint path {store!r}: "
+                        "CheckpointManager writes the local filesystem "
+                        "only; use a LocalStore (or mount the bucket)")
         self._spec = None if model_init is None else {
             "model_init": model_init, "loss_fn": loss_fn,
             "optimizer": optimizer, "epochs": int(epochs),
@@ -486,15 +527,14 @@ class JaxEstimator:
             "label_col": self._label_col,
             "feature_cols": (list(self._feature_cols)
                              if self._feature_cols else None)}
-        env = collective_worker_env(env)
+        env = collective_worker_env(env, local_coordinator=False)
 
         def task(rows):
             return _declarative_fit(spec, rows, None, None, None)
 
         results = spark_mod.run_on_dataframe(
             task, df, num_proc=self.num_workers, env=env)
-        self.history_ = results[0]["history"]
-        return JaxModel(results[0]["params"], self.predict_fn)
+        return self._finish_declarative(results)
 
     def _run_declarative(self, spec, per_rank_args, env) -> JaxModel:
         """Shared dispatch tail for both declarative input modes."""
@@ -502,8 +542,25 @@ class JaxEstimator:
         with Executor(self.num_workers, env=env) as ex:
             results = ex.run(_declarative_fit, args=(spec,),
                              per_rank_args=per_rank_args)
+        return self._finish_declarative(results)
+
+    def _finish_declarative(self, results) -> JaxModel:
+        check_one_world(results, self.num_workers)
         self.history_ = results[0]["history"]
         return JaxModel(results[0]["params"], self.predict_fn)
+
+
+def check_one_world(results, num_workers: int) -> None:
+    """One-world guard shared by every estimator dispatch tail: workers
+    that fail to rendezvous (coordinator unreachable, stale world in a
+    reused process) would each train as a size-1 island on its own shard
+    — that must be an error, not a silently under-trained model.  Each
+    worker reports its ``hvd.size()`` in the result dict's ``size``."""
+    sizes = {r["size"] for r in results if r}
+    if sizes != {num_workers}:
+        raise RuntimeError(
+            f"workers did not form one world of {num_workers} "
+            f"(saw sizes {sizes}) — collective training did not run")
 
 
 def _is_spark_dataframe(x) -> bool:
@@ -548,15 +605,23 @@ def split_and_shard(x: np.ndarray, y: np.ndarray, validation_split: float,
     return xs, ys, xv, yv
 
 
-def collective_worker_env(env: Optional[Dict[str, str]]) -> Dict[str, str]:
+def collective_worker_env(env: Optional[Dict[str, str]],
+                          local_coordinator: bool = True) -> Dict[str, str]:
     """Env for Executor workers that run COLLECTIVE training: pin them to
     the CPU platform (an accelerator-steering outer env would make every
     worker claim the real TPU; the sitecustomize pin rides
     PALLAS_AXON_POOL_IPS) and give them a JAX coordination-service
     address so ``hvd.init()`` forms one distributed world — without it
-    every worker is a silent size-1 island and collectives no-op."""
+    every worker is a silent size-1 island and collectives no-op.
+
+    ``local_coordinator=False`` (the Spark barrier-task paths) skips the
+    ``127.0.0.1:<free_port>`` default: a driver-chosen localhost address
+    is only reachable when every worker is colocated with the driver, so
+    barrier tasks instead derive the coordinator from rank 0's task
+    address over the rendezvous KV (``spark._enter_barrier``)."""
     env = dict(env or {})
     env.setdefault("JAX_PLATFORMS", "cpu")
     env.setdefault("PALLAS_AXON_POOL_IPS", "")
-    env.setdefault("HVDT_COORDINATOR_ADDR", f"127.0.0.1:{_free_port()}")
+    if local_coordinator:
+        env.setdefault("HVDT_COORDINATOR_ADDR", f"127.0.0.1:{_free_port()}")
     return env

@@ -158,8 +158,8 @@ class KerasEstimator:
         self.history_: List[Dict[str, float]] = []
 
     def fit(self, x, y: Optional[np.ndarray] = None) -> KerasModel:
-        from .estimator import (_is_spark_dataframe, collective_worker_env,
-                                split_and_shard)
+        from .estimator import (_is_spark_dataframe, check_one_world,
+                                collective_worker_env, split_and_shard)
 
         if _is_spark_dataframe(x):
             return self._fit_spark_df(x, y)
@@ -178,11 +178,7 @@ class KerasEstimator:
         out = results[0]
         if out is None or "model" not in out:
             raise RuntimeError("rank 0 returned no model")
-        sizes = {r["size"] for r in results if r}
-        if sizes != {self.num_workers}:
-            raise RuntimeError(
-                f"workers did not form one world of {self.num_workers} "
-                f"(saw sizes {sizes}) — collective training did not run")
+        check_one_world(results, self.num_workers)
         trained = _model_from_bytes(out["model"], distributed=False,
                                     custom_objects=self._spec[
                                         "custom_objects"])
@@ -195,7 +191,7 @@ class KerasEstimator:
         DataFrames; same worker-side split/pad discipline as
         JaxEstimator's DataFrame path)."""
         from . import spark as spark_mod
-        from .estimator import collective_worker_env
+        from .estimator import check_one_world, collective_worker_env
 
         if y is not None:
             raise ValueError(
@@ -212,19 +208,11 @@ class KerasEstimator:
 
         results = spark_mod.run_on_dataframe(
             task, df, num_proc=self.num_workers,
-            env=collective_worker_env(self._env))
+            env=collective_worker_env(self._env, local_coordinator=False))
         out = results[0]
         if out is None or "model" not in out:
             raise RuntimeError("rank 0 returned no model")
-        # Same one-world guard as array mode: barrier tasks that fail to
-        # rendezvous (coordinator unreachable from executors) would each
-        # train as a size-1 island on its own partition — that must be an
-        # error, not a silently under-trained model.
-        sizes = {r["size"] for r in results if r}
-        if sizes != {self.num_workers}:
-            raise RuntimeError(
-                f"workers did not form one world of {self.num_workers} "
-                f"(saw sizes {sizes}) — collective training did not run")
+        check_one_world(results, self.num_workers)
         trained = _model_from_bytes(out["model"], distributed=False,
                                     custom_objects=spec["custom_objects"])
         self.history_ = out["history"]

@@ -252,9 +252,13 @@ class ElasticRayExecutor:
 
 
 def _is_hosts_updated(e: BaseException) -> bool:
-    """Detect HostsUpdatedInterrupt raised inside an actor: Ray wraps
-    worker exceptions (RayTaskError carries the cause; stubs re-raise
-    directly)."""
+    """Detect HostsUpdatedInterrupt raised inside an actor via the typed
+    cause chain ONLY: Ray wraps worker exceptions (RayTaskError carries
+    the cause; stubs re-raise directly).  The class-NAME check covers
+    Ray's cloudpickle round trip re-instantiating the exception in a
+    fresh module; there is deliberately no str(e) substring fallback —
+    a crashed worker whose log happens to contain the word must be a
+    failure, not a graceful regrow."""
     seen = set()
     cur: Optional[BaseException] = e
     while cur is not None and id(cur) not in seen:
@@ -264,4 +268,4 @@ def _is_hosts_updated(e: BaseException) -> bool:
         if type(cur).__name__ == "HostsUpdatedInterrupt":
             return True
         cur = getattr(cur, "cause", None) or cur.__cause__
-    return "HostsUpdatedInterrupt" in str(e)
+    return False

@@ -165,14 +165,35 @@ def _enter_barrier(base_env, extra_env) -> int:
     ctx = BarrierTaskContext.get()
     rank = ctx.partitionId()
     addresses = [i.address for i in ctx.getTaskInfos()]
-    os.environ.update(_task_env(rank, addresses, base_env, extra_env))
+    task_env = _task_env(rank, addresses, base_env, extra_env)
+    os.environ.update(task_env)
+    from ..runner.http_kv import KVClient
+
+    kv = KVClient.from_env(os.environ)
+    # Key the decision off THIS run's env contract, not os.environ: with
+    # spark.python.worker.reuse a stale HVDT_COORDINATOR_ADDR from a
+    # previous fit() survives in the process and points at a dead
+    # coordinator — always re-derive unless the caller set one.
+    if not task_env.get("HVDT_COORDINATOR_ADDR"):
+        # Derive the JAX coordination-service address from rank 0's OWN
+        # task address: a driver-chosen 127.0.0.1 default only works when
+        # every task is colocated with the driver.  Rank 0 binds a port
+        # free on ITS host and publishes host:port over the KV.
+        if rank == 0:
+            host0 = addresses[0].rsplit(":", 1)[0]
+            with socket.socket() as s:
+                s.bind(("", 0))
+                coord = f"{host0}:{s.getsockname()[1]}"
+            kv.put("/spark/coord", coord.encode())
+        else:
+            coord = kv.wait("/spark/coord", timeout=float(
+                os.getenv("HVDT_SPARK_COORD_TIMEOUT", "120"))).decode()
+        os.environ["HVDT_COORDINATOR_ADDR"] = coord
     # Tell the driver this rank was actually scheduled: startup is
     # bounded by start_timeout on the driver side, and a barrier stage
     # the cluster cannot schedule must fail fast there, not after the
     # (long) run timeout (ref: spark/runner.py start_timeout rationale).
-    from ..runner.http_kv import KVClient
-
-    KVClient.from_env(os.environ).put(f"/spark/started/{rank}", b"1")
+    kv.put(f"/spark/started/{rank}", b"1")
     # All ranks enter together (mirrors the reference's registration
     # barrier before launching the job).
     ctx.barrier()

@@ -18,7 +18,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from .estimator import collective_worker_env, split_and_shard
+from .estimator import (check_one_world, collective_worker_env,
+                        split_and_shard)
 from .executor import Executor
 
 __all__ = ["TorchEstimator", "TorchModel"]
@@ -186,11 +187,7 @@ class TorchEstimator:
         out = results[0]
         if out is None or "state" not in out:
             raise RuntimeError("rank 0 returned no model state")
-        sizes = {r["size"] for r in results if r}
-        if sizes != {self.num_workers}:
-            raise RuntimeError(
-                f"workers did not form one world of {self.num_workers} "
-                f"(saw sizes {sizes}) — collective training did not run")
+        check_one_world(results, self.num_workers)
         trained = torch.load(io.BytesIO(buf.getvalue()),
                              weights_only=False)
         trained.load_state_dict(
@@ -223,16 +220,11 @@ class TorchEstimator:
 
         results = spark_mod.run_on_dataframe(
             task, df, num_proc=self.num_workers,
-            env=collective_worker_env(self._env))
+            env=collective_worker_env(self._env, local_coordinator=False))
         out = results[0]
         if out is None or "state" not in out:
             raise RuntimeError("rank 0 returned no model state")
-        # Same one-world guard as array mode (see keras_estimator).
-        sizes = {r["size"] for r in results if r}
-        if sizes != {self.num_workers}:
-            raise RuntimeError(
-                f"workers did not form one world of {self.num_workers} "
-                f"(saw sizes {sizes}) — collective training did not run")
+        check_one_world(results, self.num_workers)
         trained = torch.load(io.BytesIO(model_bytes), weights_only=False)
         trained.load_state_dict(
             torch.load(io.BytesIO(out["state"]), weights_only=False))

@@ -198,3 +198,53 @@ class TestElasticRayExecutor:
                                 discovery_interval=0.05)
         with pytest.raises(RuntimeError, match="elastic ray job failed"):
             ex.run(train)
+
+
+class TestInterruptDetection:
+    """_is_hosts_updated walks the typed cause chain only — no substring
+    fallback (a crashed worker whose message mentions the word must NOT
+    be classified as a graceful regrow)."""
+
+    def test_direct_interrupt(self):
+        from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+        from horovod_tpu.orchestrate.ray_elastic import _is_hosts_updated
+
+        assert _is_hosts_updated(HostsUpdatedInterrupt())
+
+    def test_ray_task_error_cause_attr(self):
+        """RayTaskError shape: carries the worker exception on .cause."""
+        from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+        from horovod_tpu.orchestrate.ray_elastic import _is_hosts_updated
+
+        class RayTaskError(Exception):
+            def __init__(self, cause):
+                super().__init__(f"ray::train() {cause!r}")
+                self.cause = cause
+
+        assert _is_hosts_updated(RayTaskError(HostsUpdatedInterrupt()))
+        assert not _is_hosts_updated(RayTaskError(ValueError("died")))
+
+    def test_repickled_class_name_matches(self):
+        """Cloudpickle round trips can re-instantiate the exception in a
+        fresh module; the type-NAME check still classifies it."""
+        from horovod_tpu.orchestrate.ray_elastic import _is_hosts_updated
+
+        HostsUpdatedInterrupt = type("HostsUpdatedInterrupt",
+                                     (Exception,), {})
+        assert _is_hosts_updated(HostsUpdatedInterrupt())
+
+    def test_log_substring_is_not_an_interrupt(self):
+        """The round-3 bug: a crashed worker whose log tail contains the
+        word 'HostsUpdatedInterrupt' was misclassified as a regrow."""
+        from horovod_tpu.orchestrate.ray_elastic import _is_hosts_updated
+
+        e = RuntimeError(
+            "worker crashed; last log line: 'raise HostsUpdatedInterrupt'")
+        assert not _is_hosts_updated(e)
+
+    def test_cycle_in_cause_chain_terminates(self):
+        from horovod_tpu.orchestrate.ray_elastic import _is_hosts_updated
+
+        a, b = ValueError("a"), ValueError("b")
+        a.__cause__, b.__cause__ = b, a
+        assert not _is_hosts_updated(a)

@@ -268,7 +268,8 @@ class TestRunOnDataFrame:
                                        spec["spark_df"]["feature_cols"])
             shards[rank] = (x.tolist(), y.tolist())
             return {"params": {"rank": rank, "n": len(x)},
-                    "history": [{"epoch": 0, "train_loss": 0.0}]}
+                    "history": [{"epoch": 0, "train_loss": 0.0}],
+                    "size": 3}
 
         monkeypatch.setattr(est_mod, "_declarative_fit", fake_fit)
 

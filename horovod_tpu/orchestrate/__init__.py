@@ -12,13 +12,13 @@ wrapper the Spark estimators provided.
 from .executor import Executor
 from .ray_adapter import RayExecutor
 from .ray_elastic import ElasticRayExecutor, RayHostDiscovery
-from .estimator import JaxEstimator, ParquetSource
+from .estimator import JaxEstimator, JaxModel, ParquetSource
 from . import spark  # noqa: F401  (pyspark itself is imported lazily)
 
 __all__ = ["Executor", "RayExecutor", "ElasticRayExecutor",
-           "RayHostDiscovery", "JaxEstimator", "ParquetSource",
+           "RayHostDiscovery", "JaxEstimator", "JaxModel", "ParquetSource",
            "KerasEstimator", "KerasModel", "TorchEstimator", "TorchModel",
-           "spark"]
+           "LightningEstimator", "LightningModel", "spark"]
 
 
 def __getattr__(name):
@@ -31,4 +31,8 @@ def __getattr__(name):
         from . import torch_estimator
 
         return getattr(torch_estimator, name)
+    if name in ("LightningEstimator", "LightningModel"):
+        from . import lightning_estimator
+
+        return getattr(lightning_estimator, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

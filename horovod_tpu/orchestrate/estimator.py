@@ -23,6 +23,7 @@ Two fit paths:
 from __future__ import annotations
 
 import dataclasses
+import os
 import socket
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -54,14 +55,23 @@ class JaxModel:
     transform() runs the predict path)."""
 
     def __init__(self, params: Any, predict_fn: Callable[[Any, np.ndarray],
-                                                         np.ndarray]):
+                                                         np.ndarray],
+                 df_meta: Optional[Dict[str, Any]] = None):
         self.params = params
         self._predict_fn = predict_fn
+        self._df_meta = df_meta or {}
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.asarray(self._predict_fn(self.params, x))
 
-    def transform(self, x: np.ndarray) -> np.ndarray:
+    def transform(self, x):
+        """numpy in -> predictions out; Spark DataFrame in -> DataFrame
+        out with a prediction column appended (ref: the Spark-ML Model
+        _transform contract, spark/torch/estimator.py:413)."""
+        if _is_spark_dataframe(x):
+            params, predict_fn = self.params, self._predict_fn
+            return df_transform(
+                x, lambda xa: predict_fn(params, xa), self._df_meta)
         return self.predict(x)
 
 
@@ -92,19 +102,23 @@ def _load_parquet_shard(hvd, spec: Dict[str, Any], row_groups):
     return _split_and_pad_local(hvd, spec, x, y)
 
 
+def _hvd_exchange_lengths(hvd, n_train: int,
+                          name: str = "est_parquet/target"):
+    """Cross-rank (max, min) of per-rank train lengths over one MAX
+    allreduce carrying (len, -len) — every rank also learns the MIN, so
+    a rank with zero train rows fails on ALL ranks at once instead of
+    stranding peers in the next collective until timeout."""
+    agg = np.asarray(hvd.allreduce(
+        np.asarray([n_train, -n_train], np.int64), op=hvd.Max, name=name))
+    return int(agg[0]), int(-agg[1])
+
+
 def _split_and_pad_local(hvd, spec: Dict[str, Any], x, y):
     """Worker-side lockstep discipline over the established hvd world
-    (Parquet + declarative DataFrame paths): the length exchange is one
-    MAX allreduce carrying (len, -len) so every rank also learns the MIN
-    — a rank with zero train rows must fail on ALL ranks at once, not
-    strand the others in the next collective until timeout."""
-    def exchange(n_train):
-        agg = np.asarray(hvd.allreduce(
-            np.asarray([n_train, -n_train], np.int64), op=hvd.Max,
-            name="est_parquet/target"))
-        return int(agg[0]), int(-agg[1])
-
-    return _split_pad_discipline(x, y, spec["validation_split"], exchange)
+    (Parquet + declarative DataFrame paths)."""
+    return _split_pad_discipline(
+        x, y, spec["validation_split"],
+        lambda n: _hvd_exchange_lengths(hvd, n))
 
 
 def _split_pad_discipline(x, y, validation_split: float, exchange):
@@ -175,36 +189,85 @@ def df_rows_to_shards(rows, label_col: str, feature_cols,
                                  kv_exchange_shard_lengths)
 
 
-def _rows_to_xy(rows, label_col: str, feature_cols):
-    """Barrier-task row materialization: a partition's Rows (pyspark Row
-    or plain mappings) -> (x float32 [n, d], y native-dtype [n]).
+def _row_get(r, c):
+    try:
+        return r[c]
+    except (TypeError, IndexError):
+        return getattr(r, c)
+
+
+def infer_feature_cols(first, feature_cols, exclude=()):
+    """Column discovery shared by every row-materialization path
+    (in-memory fit, spill, transform): explicit ``feature_cols`` wins;
+    otherwise every column of the first Row (pyspark Row or mapping)
+    except ``exclude``."""
+    if feature_cols:
+        return list(feature_cols)
+    try:
+        names = list(first.__fields__)           # pyspark Row
+    except AttributeError:
+        names = list(first.keys())               # mapping (stub/tests)
+    return [c for c in names if c not in exclude]
+
+
+def _rows_to_x(rows, feature_cols, exclude=()):
+    """Row materialization shared by fit(df) and transform(df): a
+    partition's Rows (pyspark Row or plain mappings) -> x float32 [n, d].
     Vector-typed columns are flattened via ``np.asarray`` per cell."""
+    cols = infer_feature_cols(rows[0], feature_cols, exclude)
+    return np.asarray(
+        [np.concatenate([np.ravel(np.asarray(_row_get(r, c), np.float32))
+                         for c in cols]) for r in rows], np.float32)
+
+
+def _rows_to_xy(rows, label_col: str, feature_cols):
+    """Barrier-task row materialization: (x float32 [n, d],
+    y native-dtype [n])."""
     if not rows:
         raise ValueError(
             "a barrier task received an EMPTY DataFrame partition — "
             "repartition produced skew; use more rows or fewer workers")
-
-    def get(r, c):
-        try:
-            return r[c]
-        except (TypeError, IndexError):
-            return getattr(r, c)
-
-    first = rows[0]
-    if feature_cols:
-        cols = list(feature_cols)
-    else:
-        try:
-            names = list(first.__fields__)       # pyspark Row
-        except AttributeError:
-            names = list(first.keys())           # mapping (stub/tests)
-        cols = [c for c in names if c != label_col]
-    x = np.asarray([np.concatenate([np.ravel(np.asarray(get(r, c),
-                                                        np.float32))
-                                    for c in cols]) for r in rows],
-                   np.float32)
-    y = np.asarray([get(r, label_col) for r in rows])
+    x = _rows_to_x(rows, feature_cols, exclude=(label_col,))
+    y = np.asarray([_row_get(r, label_col) for r in rows])
     return x, y
+
+
+def rows_predictor(predict: Callable, label_col: str, feature_cols,
+                   output_col: str):
+    """Build the per-partition ``rows -> [value, ...]`` callable for
+    :func:`spark.transform_dataframe` from an ``x -> preds`` model
+    predict.  Per-row values: scalar predictions become Python floats,
+    vector predictions become float lists (the reference flattens to
+    DenseVector — torch/estimator.py:452-466)."""
+
+    def rows_predict(rows):
+        x = _rows_to_x(rows, feature_cols,
+                       exclude=(label_col, output_col))
+        preds = np.asarray(predict(x))
+        if preds.shape[0] != len(rows):
+            raise ValueError(
+                f"predict returned {preds.shape[0]} predictions for "
+                f"{len(rows)} rows")
+        out = []
+        for p in preds:
+            p = np.ravel(np.asarray(p))
+            out.append(float(p[0]) if p.size == 1
+                       else [float(v) for v in p])
+        return out
+
+    return rows_predict
+
+
+def df_transform(df, predict: Callable, meta: Dict[str, Any]):
+    """DataFrame-out inference dispatch shared by the estimator model
+    handles: append ``meta['output_col']`` predictions to ``df``."""
+    from . import spark as spark_mod
+
+    output_col = meta.get("output_col") or "prediction"
+    return spark_mod.transform_dataframe(
+        rows_predictor(predict, meta.get("label_col") or "label",
+                       meta.get("feature_cols"), output_col),
+        df, output_col)
 
 
 def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
@@ -236,99 +299,172 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
     hvd.init()
     rank = hvd.rank()
 
-    if spec.get("parquet"):
-        # Parquet mode: x_train carries this rank's ROW-GROUP indices; the
-        # worker reads only those groups (the Petastorm-shape contract —
-        # ref: spark/common/util.py Parquet row-group partitioning).
-        x_train, y_train, x_val, y_val = _load_parquet_shard(
-            hvd, spec, x_train)
-    elif spec.get("spark_df"):
-        # DataFrame mode: x_train carries this barrier task's partition
-        # rows; materialize + apply the shared local split/pad
-        # discipline (ref: dataframe->Petastorm prep, spark/common/util.py).
-        meta = spec["spark_df"]
-        if x_train:
-            x, y = _rows_to_xy(x_train, meta["label_col"],
-                               meta["feature_cols"])
-        else:
-            # Empty partition: enter the length exchange with 0 rows so
-            # ALL ranks fail the min==0 check together instead of peers
-            # hanging in the allreduce this rank never reached.
-            x = np.zeros((0, 1), np.float32)
-            y = np.zeros((0,), np.float32)
-        x_train, y_train, x_val, y_val = _split_and_pad_local(
-            hvd, spec, x, y)
-    x_train = np.asarray(x_train)
-    y_train = np.asarray(y_train)
+    spill_cleanup = None     # set by the out-of-core branch
+    try:
+        stream = None        # (train_path, feature_cols, target_rows) or None
+        if spec.get("spark_df_stream"):
+            # Out-of-core DataFrame mode (ref: spark/common/util.py
+            # prepare_data + Petastorm row-group streaming): x_train carries
+            # this barrier task's ROW ITERATOR.  Spill it to Parquet in
+            # bounded chunks, exchange lengths, then stream row groups
+            # batch-wise each epoch — the partition is never materialized.
+            import tempfile
 
-    params = spec["model_init"](jax.random.PRNGKey(spec["seed"]))
-    # Broadcast rank 0's init so all replicas start identical even if
-    # model_init is nondeterministic (ref: broadcast_parameters at start
-    # of training, torch/functions.py:30).
-    params = hvd.broadcast_parameters(params, root_rank=0)
-    opt = spec["optimizer"] or optax.adam(1e-3)
-    opt_state = opt.init(params)
-    loss_fn = spec["loss_fn"]
+            from .spill import read_xy, spill_partition_to_parquet
 
-    grad_step = jax.jit(jax.value_and_grad(loss_fn))
-    eval_loss = jax.jit(loss_fn)
+            meta = spec["spark_df_stream"]
+            spill_dir = meta.get("spill_dir")
+            spill_created = spill_dir is None
+            if spill_created:
+                spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
+            train_path, val_path, n_train, n_val, feat_cols = \
+                spill_partition_to_parquet(
+                    x_train, meta["label_col"], meta["feature_cols"],
+                    spec["validation_split"], spill_dir,
+                    meta.get("rows_per_group", 4096), prefix=f"rank{rank}")
+            spill_cleanup = (spill_dir if spill_created
+                             else [train_path, val_path])
+            target, min_len = _hvd_exchange_lengths(hvd, n_train)
+            if min_len == 0:
+                raise ValueError(
+                    "a worker contributed ZERO training rows (empty "
+                    "partition, or only validation rows after the split) — "
+                    "use more rows per partition, fewer workers, or a "
+                    "smaller validation_split")
+            # Validation must be all-or-none across ranks (the est_metric/val
+            # allreduce below is collective).  The per-chunk split can give a
+            # rank zero val rows (partition an exact multiple of
+            # rows_per_group with a tiny split): if ANY rank got none, all
+            # ranks skip validation rather than mismatch the collective.
+            _, min_val = _hvd_exchange_lengths(hvd, n_val,
+                                               name="est_stream/val")
+            if val_path is not None and min_val > 0:
+                x_val, y_val = read_xy(val_path, meta["label_col"], feat_cols)
+            stream = (train_path, meta["label_col"], feat_cols, target)
+            x_train = np.zeros((0, 1), np.float32)   # loop streams instead
+            y_train = np.zeros((0,), np.float32)
+        elif spec.get("parquet"):
+            # Parquet mode: x_train carries this rank's ROW-GROUP indices; the
+            # worker reads only those groups (the Petastorm-shape contract —
+            # ref: spark/common/util.py Parquet row-group partitioning).
+            x_train, y_train, x_val, y_val = _load_parquet_shard(
+                hvd, spec, x_train)
+        elif spec.get("spark_df"):
+            # DataFrame mode: x_train carries this barrier task's partition
+            # rows; materialize + apply the shared local split/pad
+            # discipline (ref: dataframe->Petastorm prep, spark/common/util.py).
+            meta = spec["spark_df"]
+            if x_train:
+                x, y = _rows_to_xy(x_train, meta["label_col"],
+                                   meta["feature_cols"])
+            else:
+                # Empty partition: enter the length exchange with 0 rows so
+                # ALL ranks fail the min==0 check together instead of peers
+                # hanging in the allreduce this rank never reached.
+                x = np.zeros((0, 1), np.float32)
+                y = np.zeros((0,), np.float32)
+            x_train, y_train, x_val, y_val = _split_and_pad_local(
+                hvd, spec, x, y)
+        x_train = np.asarray(x_train)
+        y_train = np.asarray(y_train)
 
-    bs = spec["batch_size"]
-    rng = np.random.RandomState(spec["seed"] + 101 * rank)
-    manager = None
-    if spec["store"]:
-        # All ranks construct the manager and enter save(): the write is
-        # rank-0-only inside save_checkpoint, but its completion barrier
-        # is collective.
-        from ..checkpoint import CheckpointManager
+        params = spec["model_init"](jax.random.PRNGKey(spec["seed"]))
+        # Broadcast rank 0's init so all replicas start identical even if
+        # model_init is nondeterministic (ref: broadcast_parameters at start
+        # of training, torch/functions.py:30).
+        params = hvd.broadcast_parameters(params, root_rank=0)
+        opt = spec["optimizer"] or optax.adam(1e-3)
+        opt_state = opt.init(params)
+        loss_fn = spec["loss_fn"]
 
-        manager = CheckpointManager(spec["store"])
+        grad_step = jax.jit(jax.value_and_grad(loss_fn))
+        eval_loss = jax.jit(loss_fn)
 
-    history: List[Dict[str, float]] = []
-    for epoch in range(spec["epochs"]):
-        order = (rng.permutation(len(x_train)) if spec["shuffle"]
-                 else np.arange(len(x_train)))
-        losses = []
-        for start in range(0, max(len(order), 1), max(bs, 1)):
-            idx = order[start:start + bs]
-            if idx.size == 0:
-                continue
-            # Pad the tail batch to full size (static shapes: one jit
-            # trace) — wrap-around rows re-weight a few samples slightly,
-            # matching the reference's repartition-to-equal-shards
-            # behavior rather than dropping data.
-            if idx.size < bs:
-                idx = np.concatenate([idx, order[:bs - idx.size]])
-            loss, grads = grad_step(params, x_train[idx], y_train[idx])
-            # One grouped (all-or-nothing fused) eager allreduce per step
-            # (ref: grouped allreduce + GroupTable, common/group_table.cc).
-            leaves, treedef = jax.tree.flatten(grads)
-            reduced = hvd.grouped_allreduce(
-                [np.asarray(g) for g in leaves], name="est_grad")
-            grads = jax.tree.unflatten(
-                treedef, [jnp.asarray(r) for r in reduced])
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            losses.append(float(loss))
-        row = {"epoch": epoch,
-               "train_loss": float(np.mean(losses)) if losses else float("nan")}
-        # Cross-worker metric averaging (ref: MetricAverageCallback,
-        # _keras/callbacks.py:49).
-        row["train_loss"] = float(np.asarray(hvd.allreduce(
-            np.asarray([row["train_loss"]], np.float32),
-            name="est_metric/train"))[0])
-        if x_val is not None:
-            vl = float(eval_loss(params, np.asarray(x_val),
-                                 np.asarray(y_val)))
-            row["val_loss"] = float(np.asarray(hvd.allreduce(
-                np.asarray([vl], np.float32), name="est_metric/val"))[0])
-        history.append(row)
-        if manager is not None:
-            manager.save(epoch, params, force=True)
-        hvd.barrier()
+        bs = spec["batch_size"]
+        rng = np.random.RandomState(spec["seed"] + 101 * rank)
+        manager = None
+        if spec["store"]:
+            # All ranks construct the manager and enter save(): the write is
+            # rank-0-only inside save_checkpoint, but its completion barrier
+            # is collective.
+            from ..checkpoint import CheckpointManager
 
-    return {"params": jax.tree.map(np.asarray, params), "history": history,
-            "size": hvd.size()}
+            manager = CheckpointManager(spec["store"])
+
+        def _epoch_batches(epoch):
+            """Equal-count lockstep batches: stream mode yields full batches
+            from Parquet row groups (wrap-around to the cross-rank max);
+            array mode permutes in memory with tail-batch wrap-padding —
+            both give every rank ceil(target / bs) identical-shape steps."""
+            if stream is not None:
+                from .spill import stream_batches
+
+                train_path, label_c, feat_cols, target = stream
+                yield from stream_batches(
+                    train_path, label_c, feat_cols, bs, target,
+                    seed=spec["seed"] + 7919 * epoch + 101 * rank,
+                    shuffle=spec["shuffle"])
+                return
+            order = (rng.permutation(len(x_train)) if spec["shuffle"]
+                     else np.arange(len(x_train)))
+            for start in range(0, max(len(order), 1), max(bs, 1)):
+                idx = order[start:start + bs]
+                if idx.size == 0:
+                    continue
+                # Pad the tail batch to full size (static shapes: one jit
+                # trace) — wrap-around rows re-weight a few samples slightly,
+                # matching the reference's repartition-to-equal-shards
+                # behavior rather than dropping data.
+                if idx.size < bs:
+                    idx = np.concatenate([idx, order[:bs - idx.size]])
+                yield x_train[idx], y_train[idx]
+
+        history: List[Dict[str, float]] = []
+        for epoch in range(spec["epochs"]):
+            losses = []
+            for xb, yb in _epoch_batches(epoch):
+                loss, grads = grad_step(params, xb, yb)
+                # One grouped (all-or-nothing fused) eager allreduce per step
+                # (ref: grouped allreduce + GroupTable, common/group_table.cc).
+                leaves, treedef = jax.tree.flatten(grads)
+                reduced = hvd.grouped_allreduce(
+                    [np.asarray(g) for g in leaves], name="est_grad")
+                grads = jax.tree.unflatten(
+                    treedef, [jnp.asarray(r) for r in reduced])
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                losses.append(float(loss))
+            row = {"epoch": epoch,
+                   "train_loss": float(np.mean(losses)) if losses else float("nan")}
+            # Cross-worker metric averaging (ref: MetricAverageCallback,
+            # _keras/callbacks.py:49).
+            row["train_loss"] = float(np.asarray(hvd.allreduce(
+                np.asarray([row["train_loss"]], np.float32),
+                name="est_metric/train"))[0])
+            if x_val is not None:
+                vl = float(eval_loss(params, np.asarray(x_val),
+                                     np.asarray(y_val)))
+                row["val_loss"] = float(np.asarray(hvd.allreduce(
+                    np.asarray([vl], np.float32), name="est_metric/val"))[0])
+            history.append(row)
+            if manager is not None:
+                manager.save(epoch, params, force=True)
+            hvd.barrier()
+
+        return {"params": jax.tree.map(np.asarray, params), "history": history,
+                "size": hvd.size()}
+    finally:
+        # Spilled Parquet is per-fit scratch: reused executor
+        # processes must not accumulate dataset-sized files.
+        if spill_cleanup is not None:
+            import shutil
+
+            if isinstance(spill_cleanup, str):
+                shutil.rmtree(spill_cleanup, ignore_errors=True)
+            else:
+                for p in spill_cleanup:
+                    if p and os.path.exists(p):
+                        os.remove(p)
 
 
 class JaxEstimator:
@@ -358,6 +494,10 @@ class JaxEstimator:
                  store: Optional[Any] = None,
                  label_col: str = "label",
                  feature_cols: Optional[Tuple[str, ...]] = None,
+                 output_col: str = "prediction",
+                 cache: str = "memory",
+                 rows_per_group: int = 4096,
+                 spill_dir: Optional[str] = None,
                  seed: int = 0):
         if (train_fn is None) == (model_init is None):
             raise ValueError(
@@ -378,6 +518,13 @@ class JaxEstimator:
         self._env = env
         self._label_col = label_col
         self._feature_cols = feature_cols
+        self._output_col = output_col
+        if cache not in ("memory", "disk"):
+            raise ValueError(
+                f"cache must be 'memory' or 'disk', got {cache!r}")
+        self._cache = cache
+        self._rows_per_group = int(rows_per_group)
+        self._spill_dir = spill_dir
         if store is not None:
             from .store import _REMOTE_SCHEMES, Store
 
@@ -480,7 +627,8 @@ class JaxEstimator:
                              args=(self.train_fn, fit_kwargs),
                              per_rank_args=[(xs[r], ys[r])
                                             for r in range(self.num_workers)])
-        return JaxModel(results[0], self.predict_fn)
+        return JaxModel(results[0], self.predict_fn,
+                        df_meta=self._df_meta())
 
 
     def _fit_parquet(self, source: ParquetSource, y, env) -> JaxModel:
@@ -523,17 +671,27 @@ class JaxEstimator:
         from . import spark as spark_mod
 
         spec = dict(self._spec)
-        spec["spark_df"] = {
-            "label_col": self._label_col,
-            "feature_cols": (list(self._feature_cols)
-                             if self._feature_cols else None)}
+        meta = {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None)}
+        stream = self._cache == "disk"
+        if stream:
+            # Out-of-core feed (ref: spark/common/util.py prepare_data):
+            # the barrier task spills its partition stream to Parquet
+            # row groups and trains by streaming them back — a partition
+            # larger than task memory never materializes.
+            meta["rows_per_group"] = self._rows_per_group
+            meta["spill_dir"] = self._spill_dir
+            spec["spark_df_stream"] = meta
+        else:
+            spec["spark_df"] = meta
         env = collective_worker_env(env, local_coordinator=False)
 
         def task(rows):
             return _declarative_fit(spec, rows, None, None, None)
 
         results = spark_mod.run_on_dataframe(
-            task, df, num_proc=self.num_workers, env=env)
+            task, df, num_proc=self.num_workers, env=env, stream=stream)
         return self._finish_declarative(results)
 
     def _run_declarative(self, spec, per_rank_args, env) -> JaxModel:
@@ -547,7 +705,14 @@ class JaxEstimator:
     def _finish_declarative(self, results) -> JaxModel:
         check_one_world(results, self.num_workers)
         self.history_ = results[0]["history"]
-        return JaxModel(results[0]["params"], self.predict_fn)
+        return JaxModel(results[0]["params"], self.predict_fn,
+                        df_meta=self._df_meta())
+
+    def _df_meta(self) -> Dict[str, Any]:
+        return {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None),
+                "output_col": self._output_col}
 
 
 def check_one_world(results, num_workers: int) -> None:

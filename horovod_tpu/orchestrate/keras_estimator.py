@@ -53,14 +53,34 @@ class KerasModel:
     """Trained model handle (ref: spark/keras KerasModel — transform()
     runs the predict path; the underlying keras model is exposed)."""
 
-    def __init__(self, model, history: Optional[List[Dict]] = None):
+    def __init__(self, model, history: Optional[List[Dict]] = None,
+                 df_meta: Optional[Dict] = None,
+                 custom_objects: Optional[Dict] = None):
         self.model = model
         self.history_ = history or []
+        self._df_meta = df_meta or {}
+        self._custom_objects = custom_objects
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         return np.asarray(self.model.predict(np.asarray(x), verbose=0))
 
-    def transform(self, x: np.ndarray) -> np.ndarray:
+    def transform(self, x):
+        """numpy in -> predictions out; Spark DataFrame in -> DataFrame
+        out with a prediction column (ref: spark/keras KerasModel
+        _transform).  The model ships to executors as serialized bytes
+        and deserializes once per partition, like the reference's UDF."""
+        from .estimator import _is_spark_dataframe, df_transform
+
+        if _is_spark_dataframe(x):
+            model_bytes = _model_to_bytes(self.model)
+            custom = self._custom_objects
+
+            def predict(xa):
+                m = _model_from_bytes(model_bytes, distributed=False,
+                                      custom_objects=custom)
+                return np.asarray(m.predict(np.asarray(xa), verbose=0))
+
+            return df_transform(x, predict, self._df_meta)
         return self.predict(x)
 
     def save(self, path: str) -> None:
@@ -138,6 +158,7 @@ class KerasEstimator:
                  store: Optional[str] = None,
                  label_col: str = "label",
                  feature_cols=None,
+                 output_col: str = "prediction",
                  env: Optional[Dict[str, str]] = None):
         if model is None:
             raise ValueError("KerasEstimator requires a compiled model")
@@ -151,6 +172,7 @@ class KerasEstimator:
         self._env = env
         self._label_col = label_col
         self._feature_cols = feature_cols
+        self._output_col = output_col
         self._spec = {"epochs": int(epochs), "batch_size": int(batch_size),
                       "shuffle": bool(shuffle),
                       "validation_split": float(validation_split),
@@ -183,7 +205,14 @@ class KerasEstimator:
                                     custom_objects=self._spec[
                                         "custom_objects"])
         self.history_ = out["history"]
-        return KerasModel(trained, out["history"])
+        return KerasModel(trained, out["history"], df_meta=self._df_meta(),
+                          custom_objects=self._spec["custom_objects"])
+
+    def _df_meta(self):
+        return {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None),
+                "output_col": self._output_col}
 
     def _fit_spark_df(self, df, y) -> KerasModel:
         """fit(df): training runs inside Spark barrier tasks on each
@@ -216,7 +245,8 @@ class KerasEstimator:
         trained = _model_from_bytes(out["model"], distributed=False,
                                     custom_objects=spec["custom_objects"])
         self.history_ = out["history"]
-        return KerasModel(trained, out["history"])
+        return KerasModel(trained, out["history"], df_meta=self._df_meta(),
+                          custom_objects=spec["custom_objects"])
 
 
 def _keras_df_worker(spec, meta, model_bytes, rows):

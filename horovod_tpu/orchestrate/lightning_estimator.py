@@ -1,0 +1,299 @@
+"""LightningEstimator — distributed fit for LightningModule-style models.
+
+Re-conception of ref: spark/lightning/estimator.py (693 LoC: a Spark ML
+estimator that trains a ``pytorch_lightning.LightningModule`` over
+Horovod workers via remote.py's Trainer harness).  The TPU-native
+re-build drives the *LightningModule protocol* directly — the three
+methods every LightningModule defines::
+
+    training_step(batch, batch_idx) -> loss tensor (or {"loss": ...})
+    configure_optimizers()          -> optimizer (or [opts], or dict)
+    validation_step(batch, batch_idx) -> loss tensor (optional)
+
+under this framework's own distributed loop (broadcast initial state,
+DistributedOptimizer gradient allreduce, epoch metric averaging) instead
+of embedding the Lightning Trainer — the Trainer's accelerator/strategy
+machinery is exactly the part a TPU framework replaces.  Because only
+the protocol is used, ``pytorch_lightning`` itself is OPTIONAL: a real
+``LightningModule`` works unchanged when the package is installed, and
+any plain ``torch.nn.Module`` implementing the three methods works
+without it (how the stub tests run — the same discipline as the
+reference's ``to_lightning_module`` legacy adapter, lightning/legacy.py).
+
+fit() accepts numpy arrays or a Spark DataFrame (barrier tasks, shared
+split/pad lockstep discipline) exactly like TorchEstimator.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .estimator import (check_one_world, collective_worker_env,
+                        df_transform, split_and_shard)
+from .executor import Executor
+
+__all__ = ["LightningEstimator", "LightningModel"]
+
+
+class LightningModel:
+    """Trained model handle (ref: spark/lightning LightningModel —
+    transform() runs the module's forward; the module is exposed)."""
+
+    def __init__(self, model, history: Optional[List[Dict]] = None,
+                 df_meta: Optional[Dict] = None):
+        self.model = model
+        self.history_ = history or []
+        self._df_meta = df_meta or {}
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        import torch
+
+        self.model.eval()
+        dtype = next(self.model.parameters()).dtype
+        with torch.no_grad():
+            out = self.model(torch.as_tensor(np.asarray(x), dtype=dtype))
+        return out.numpy()
+
+    def transform(self, x):
+        """numpy in -> predictions out; Spark DataFrame in -> DataFrame
+        out with a prediction column (ref: lightning/estimator.py
+        _transform)."""
+        from .estimator import _is_spark_dataframe
+        from .torch_estimator import torch_df_predictor
+
+        if _is_spark_dataframe(x):
+            return df_transform(x, torch_df_predictor(self.model),
+                                self._df_meta)
+        return self.predict(x)
+
+    def save(self, path: str) -> None:
+        import torch
+
+        torch.save(self.model, path)
+
+
+def _resolve_optimizer(configured):
+    """configure_optimizers() may return an optimizer, a list/tuple of
+    optimizers (+ optional schedulers list), or a dict with an
+    'optimizer' key (the LightningModule contract).  One optimizer is
+    supported — the reference's remote harness trains opt[0] too."""
+    if isinstance(configured, dict):
+        return configured["optimizer"]
+    if isinstance(configured, (list, tuple)):
+        first = configured[0]
+        if isinstance(first, (list, tuple)):     # ([opts], [scheds])
+            return first[0]
+        return first
+    return configured
+
+
+def _step_loss(module, batch, batch_idx, step_name):
+    """Run training_step/validation_step; unwrap the loss from a tensor
+    or a Lightning-style {'loss': ...} dict."""
+    out = getattr(module, step_name)(batch, batch_idx)
+    if isinstance(out, dict):
+        out = out["loss"]
+    return out
+
+
+def _lightning_worker(spec: Dict[str, Any], model_bytes: bytes,
+                      x, y, xv, yv):
+    """Executor/barrier-task body: rebuild the module, wire the
+    distributed optimizer, drive the LightningModule protocol.
+
+    Returns size + state-checksum on every rank (one-world proof), plus
+    the trained state and history on rank 0 — the same result contract
+    as _torch_worker."""
+    import torch
+
+    import horovod_tpu as hvd
+    from ..interop import torch as htorch
+    from ..interop.torch_optimizer import DistributedOptimizer
+
+    if not hvd.is_initialized():
+        hvd.init()
+
+    module = torch.load(io.BytesIO(model_bytes), weights_only=False)
+    # Rank 0's init wins (ref: broadcast at fit start, remote.py).
+    htorch.broadcast_parameters(module.state_dict(), root_rank=0)
+    opt = _resolve_optimizer(module.configure_optimizers())
+    opt = DistributedOptimizer(opt,
+                               named_parameters=module.named_parameters())
+
+    dtype = next(module.parameters()).dtype
+    xt = torch.as_tensor(np.asarray(x), dtype=dtype)
+    yt = torch.as_tensor(np.asarray(y))
+    has_val = xv is not None and hasattr(module, "validation_step")
+    if has_val:
+        xvt = torch.as_tensor(np.asarray(xv), dtype=dtype)
+        yvt = torch.as_tensor(np.asarray(yv))
+
+    n, bs = len(xt), spec["batch_size"]
+    torch.manual_seed(spec["seed"] + 101 * hvd.rank())
+    history: List[Dict[str, float]] = []
+    for epoch in range(spec["epochs"]):
+        module.train()
+        perm = torch.randperm(n) if spec["shuffle"] else torch.arange(n)
+        losses = []
+        for i, start in enumerate(range(0, n, bs)):
+            idx = perm[start:start + bs]
+            opt.zero_grad()
+            loss = _step_loss(module, (xt[idx], yt[idx]), i,
+                              "training_step")
+            loss.backward()       # grads stream into named allreduces
+            opt.step()
+            losses.append(float(loss.detach()))
+        row = {"epoch": epoch, "train_loss": float(np.asarray(
+            hvd.allreduce(np.float32(np.mean(losses)),
+                          name=f"le_loss.{epoch}")))}
+        if has_val:
+            module.eval()
+            with torch.no_grad():
+                vls = [float(_step_loss(module, (xvt[s:s + bs],
+                                                 yvt[s:s + bs]), j,
+                                        "validation_step"))
+                       for j, s in enumerate(range(0, len(xvt), bs))]
+            row["val_loss"] = float(np.asarray(hvd.allreduce(
+                np.float32(np.mean(vls)), name=f"le_vloss.{epoch}")))
+        history.append(row)
+
+    out = {"size": hvd.size(),
+           "checksum": float(sum(float(v.double().sum())
+                                 for v in module.state_dict().values()))}
+    if hvd.rank() == 0:
+        buf = io.BytesIO()
+        torch.save(module.state_dict(), buf)
+        out["state"] = buf.getvalue()
+        out["history"] = history
+    return out
+
+
+class LightningEstimator:
+    """Fit a LightningModule-protocol model data-parallel over worker
+    processes (ref: spark/lightning/estimator.py LightningEstimator —
+    ``num_workers`` is the reference's ``num_proc``; model/loss/optimizer
+    all live on the module itself, which is the Lightning contract).
+
+    Args:
+      model: a picklable ``torch.nn.Module`` implementing
+        ``training_step`` + ``configure_optimizers`` (and optionally
+        ``validation_step``) — every ``pl.LightningModule`` qualifies.
+      epochs / batch_size / shuffle / seed: loop knobs.
+      validation_split: GLOBAL tail split before sharding (same
+        discipline as the other estimators); used only when the module
+        defines ``validation_step``.
+    """
+
+    def __init__(self, model=None, num_workers: int = 1, epochs: int = 1,
+                 batch_size: int = 32, shuffle: bool = True,
+                 validation_split: float = 0.0, seed: int = 0,
+                 label_col: str = "label", feature_cols=None,
+                 output_col: str = "prediction",
+                 env: Optional[Dict[str, str]] = None):
+        if model is None:
+            raise ValueError("LightningEstimator requires a model")
+        for method in ("training_step", "configure_optimizers"):
+            if not callable(getattr(model, method, None)):
+                raise ValueError(
+                    f"model must implement {method}() — the "
+                    "LightningModule protocol (any pl.LightningModule, "
+                    "or a plain torch module defining it)")
+        if not 0.0 <= validation_split < 1.0:
+            raise ValueError("validation_split must be in [0, 1)")
+        self.model = model
+        self.num_workers = num_workers
+        self._env = env
+        self._label_col = label_col
+        self._feature_cols = feature_cols
+        self._output_col = output_col
+        self._spec = {"epochs": int(epochs), "batch_size": int(batch_size),
+                      "shuffle": bool(shuffle),
+                      "validation_split": float(validation_split),
+                      "seed": int(seed)}
+        self.history_: List[Dict[str, float]] = []
+
+    def _df_meta(self):
+        return {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None),
+                "output_col": self._output_col}
+
+    def fit(self, x, y: Optional[np.ndarray] = None) -> LightningModel:
+        import torch
+
+        from .estimator import _is_spark_dataframe
+
+        if _is_spark_dataframe(x):
+            return self._fit_spark_df(x, y)
+        if y is None:
+            raise ValueError("array-mode fit needs y")
+        x, y = np.asarray(x), np.asarray(y)
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+        split = (self._spec["validation_split"]
+                 if hasattr(self.model, "validation_step") else 0.0)
+        xs, ys, xv, yv = split_and_shard(x, y, split, self.num_workers)
+        with Executor(self.num_workers,
+                      env=collective_worker_env(self._env)) as ex:
+            results = ex.run(
+                _lightning_worker, args=(self._spec, buf.getvalue()),
+                per_rank_args=[(xs[r], ys[r], xv[r], yv[r])
+                               for r in range(self.num_workers)])
+        return self._finish(results, buf.getvalue())
+
+    def _fit_spark_df(self, df, y) -> LightningModel:
+        """fit(df): training inside Spark barrier tasks, rank r on
+        partition r (ref: spark/lightning/estimator.py fit over
+        DataFrames; same worker-side split/pad discipline as the other
+        estimators)."""
+        import torch
+
+        from . import spark as spark_mod
+
+        if y is not None:
+            raise ValueError(
+                "DataFrame fit carries labels in label_col "
+                f"({self._label_col!r}); pass y=None")
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+        model_bytes = buf.getvalue()
+        spec = dict(self._spec)
+        if not hasattr(self.model, "validation_step"):
+            spec["validation_split"] = 0.0
+        meta = self._df_meta()
+
+        def task(rows):
+            return _lightning_df_worker(spec, meta, model_bytes, rows)
+
+        results = spark_mod.run_on_dataframe(
+            task, df, num_proc=self.num_workers,
+            env=collective_worker_env(self._env, local_coordinator=False))
+        return self._finish(results, model_bytes)
+
+    def _finish(self, results, model_bytes) -> LightningModel:
+        import torch
+
+        out = results[0]
+        if out is None or "state" not in out:
+            raise RuntimeError("rank 0 returned no model state")
+        check_one_world(results, self.num_workers)
+        trained = torch.load(io.BytesIO(model_bytes), weights_only=False)
+        trained.load_state_dict(
+            torch.load(io.BytesIO(out["state"]), weights_only=False))
+        self.history_ = out["history"]
+        return LightningModel(trained, out["history"],
+                              df_meta=self._df_meta())
+
+
+def _lightning_df_worker(spec, meta, model_bytes, rows):
+    """Barrier-task body for fit(df): rows -> padded shard -> the
+    standard lightning worker."""
+    from .estimator import df_rows_to_shards
+
+    x, y, xv, yv = df_rows_to_shards(rows, meta["label_col"],
+                                     meta["feature_cols"],
+                                     spec["validation_split"])
+    return _lightning_worker(spec, model_bytes, x, y, xv, yv)

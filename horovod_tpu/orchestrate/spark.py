@@ -31,7 +31,40 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["run", "run_on_dataframe"]
+__all__ = ["run", "run_on_dataframe", "transform_dataframe"]
+
+
+def transform_dataframe(rows_predict: Callable, df, output_col: str
+                        = "prediction", chunk_rows: int = 4096):
+    """DataFrame-out inference (ref: spark/torch/estimator.py:413-470
+    ``_transform`` — the other half of the Spark-ML contract): map each
+    partition's rows through ``rows_predict(rows) -> [value, ...]`` and
+    return a DataFrame with ``output_col`` appended to the schema.
+
+    Plain (non-barrier) ``mapPartitions`` — inference has no collectives,
+    so partitions are independent and Spark's normal scheduling/retry
+    semantics apply.  The iterator is consumed in ``chunk_rows`` chunks,
+    so a partition that needed ``cache='disk'`` to train also predicts
+    in bounded memory (rows_predict runs once per chunk — the model's
+    closure should deserialize lazily or tolerate repeated calls)."""
+    import itertools
+
+    def _part(it):
+        try:
+            from pyspark.sql import Row
+        except ImportError:           # stub path (tests)
+            Row = None
+        while True:
+            rows = list(itertools.islice(it, chunk_rows))
+            if not rows:
+                return
+            preds = rows_predict(rows)
+            for r, p in zip(rows, preds):
+                d = dict(r.asDict()) if hasattr(r, "asDict") else dict(r)
+                d[output_col] = p
+                yield Row(**d) if Row is not None else d
+
+    return df.rdd.mapPartitions(_part).toDF()
 
 
 def _task_env(rank: int, addresses: List[str], base: Dict[str, str],
@@ -104,13 +137,19 @@ def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
 
 def run_on_dataframe(fn: Callable, df, num_proc: Optional[int] = None,
                      start_timeout: Optional[int] = None,
-                     env: Optional[Dict[str, str]] = None) -> List[Any]:
+                     env: Optional[Dict[str, str]] = None,
+                     stream: bool = False) -> List[Any]:
     """Run ``fn(rows)`` on ``num_proc`` barrier tasks, each fed ITS
     partition of ``df`` (rows materialized as a list) — the
     DataFrame-in training path of the reference's estimators
     (ref: spark/common/util.py dataframe->Petastorm prep + barrier-task
     training in spark/keras/remote.py), without the driver ever
     collecting the dataset.
+
+    ``stream=True`` passes ``fn`` the raw row ITERATOR instead of a
+    list — the out-of-core path (estimator ``cache='disk'``) spills it
+    to Parquet in bounded chunks so a partition larger than task memory
+    never materializes.
 
     The DataFrame is repartitioned to ``num_proc`` so the barrier stage
     width equals the worker count; rank r trains on partition r.
@@ -147,7 +186,7 @@ def run_on_dataframe(fn: Callable, df, num_proc: Optional[int] = None,
 
     def _task(iterator):
         rank = _enter_barrier(base_env, extra_env)
-        result = fn(list(iterator))
+        result = fn(iterator if stream else list(iterator))
         yield (rank, result)
 
     def _make_rdd():
@@ -178,15 +217,21 @@ def _enter_barrier(base_env, extra_env) -> int:
         # Derive the JAX coordination-service address from rank 0's OWN
         # task address: a driver-chosen 127.0.0.1 default only works when
         # every task is colocated with the driver.  Rank 0 binds a port
-        # free on ITS host and publishes host:port over the KV.
+        # free on ITS host and publishes host:port over the KV.  The key
+        # is scoped by the barrier-stage attempt: on a stage RETRY the
+        # previous attempt's coordinator is dead, and an unscoped key
+        # would hand its address straight back to the waiting ranks.
+        attempt = getattr(ctx, "stageAttemptNumber",
+                          getattr(ctx, "attemptNumber", lambda: 0))()
+        key = f"/spark/coord/{attempt}"
         if rank == 0:
             host0 = addresses[0].rsplit(":", 1)[0]
             with socket.socket() as s:
                 s.bind(("", 0))
                 coord = f"{host0}:{s.getsockname()[1]}"
-            kv.put("/spark/coord", coord.encode())
+            kv.put(key, coord.encode())
         else:
-            coord = kv.wait("/spark/coord", timeout=float(
+            coord = kv.wait(key, timeout=float(
                 os.getenv("HVDT_SPARK_COORD_TIMEOUT", "120"))).decode()
         os.environ["HVDT_COORDINATOR_ADDR"] = coord
     # Tell the driver this rank was actually scheduled: startup is

@@ -1,0 +1,176 @@
+"""Out-of-core DataFrame feed: partition -> Parquet row groups -> batches.
+
+Re-conception of ref: spark/common/util.py ``prepare_data`` — the
+reference materializes DataFrames to the store as Parquet and streams row
+groups per worker via Petastorm so a partition larger than task memory
+can still train.  Here the barrier task itself spills its partition's
+row stream to a Parquet file in bounded chunks (never holding the whole
+partition as Python objects), then the training loop streams row groups
+back batch-wise each epoch.
+
+Memory contract: at any moment a worker holds at most ``rows_per_group``
+rows being spilled, or one row group plus one partial batch being
+streamed — never the whole partition.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["spill_partition_to_parquet", "stream_batches", "read_xy"]
+
+
+def _rows_chunk_to_table(rows, label_col: str, feature_cols):
+    """A chunk of Rows (pyspark Row or mappings) -> pyarrow Table with
+    one column per feature + the label column (vector cells flattened,
+    like estimator._rows_to_x)."""
+    import pyarrow as pa
+
+    from .estimator import _row_get, infer_feature_cols
+
+    cols = infer_feature_cols(rows[0], feature_cols, exclude=(label_col,))
+    data = {}
+    for c in cols:
+        vals = [np.ravel(np.asarray(_row_get(r, c), np.float32))
+                for r in rows]
+        if vals[0].size == 1:
+            data[c] = pa.array([float(v[0]) for v in vals], pa.float32())
+        else:
+            data[c] = pa.array([[float(x) for x in v] for v in vals],
+                               pa.list_(pa.float32()))
+    data[label_col] = pa.array(
+        [np.asarray(_row_get(r, label_col)).item() for r in rows])
+    return pa.table(data), cols
+
+
+def spill_partition_to_parquet(
+        row_iter: Iterator, label_col: str, feature_cols,
+        validation_split: float, spill_dir: Optional[str] = None,
+        rows_per_group: int = 4096,
+        prefix: str = "part") -> Tuple[str, Optional[str], int, int, list]:
+    """Stream a partition's rows into ``<spill_dir>/<prefix>_train.parquet``
+    (one row group per ``rows_per_group`` chunk) without ever
+    materializing the partition.
+
+    The validation split happens PER CHUNK (each chunk's tail fraction
+    goes to ``<prefix>_val.parquet``) — split-clean like the global tail
+    split (no row lands in both files), statistically equivalent for
+    shuffled data, and streamable because the total length isn't known
+    until the iterator is exhausted.
+
+    Returns (train_path, val_path_or_None, n_train, n_val, feature_cols).
+    """
+    import pyarrow.parquet as pq
+
+    if spill_dir is None:
+        spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
+    os.makedirs(spill_dir, exist_ok=True)
+    train_path = os.path.join(spill_dir, f"{prefix}_train.parquet")
+    val_path = os.path.join(spill_dir, f"{prefix}_val.parquet")
+
+    writers = {"train": None, "val": None}
+    counts = {"train": 0, "val": 0}
+    cols: list = []
+
+    def _write(kind, path, rows):
+        nonlocal cols
+        if not rows:
+            return
+        table, cols = _rows_chunk_to_table(rows, label_col, feature_cols)
+        if writers[kind] is None:
+            writers[kind] = pq.ParquetWriter(path, table.schema)
+        writers[kind].write_table(table)
+        counts[kind] += len(rows)
+
+    chunk: list = []
+    try:
+        for row in row_iter:
+            chunk.append(row)
+            if len(chunk) >= rows_per_group:
+                n_val = (int(round(len(chunk) * validation_split))
+                         if validation_split > 0 else 0)
+                _write("train", train_path, chunk[:len(chunk) - n_val])
+                _write("val", val_path, chunk[len(chunk) - n_val:])
+                chunk = []
+        if chunk:
+            n_val = (int(round(len(chunk) * validation_split))
+                     if validation_split > 0 else 0)
+            if validation_split > 0 and counts["val"] == 0 and n_val == 0:
+                n_val = 1    # validation on => never an empty val set
+            _write("train", train_path, chunk[:len(chunk) - n_val])
+            _write("val", val_path, chunk[len(chunk) - n_val:])
+    finally:
+        for w in writers.values():
+            if w is not None:
+                w.close()
+    return (train_path, val_path if counts["val"] else None,
+            counts["train"], counts["val"], cols)
+
+
+def _table_to_xy(table, label_col: str, feature_cols: Sequence[str]):
+    """One column per feature; list-typed cells become multiple feature
+    dims (the inverse of _rows_chunk_to_table)."""
+    parts = []
+    for c in feature_cols:
+        a = np.asarray(table[c].to_pylist(), np.float32)
+        parts.append(a if a.ndim > 1 else a[:, None])
+    x = np.concatenate(parts, axis=1)
+    y = np.asarray(table[label_col].to_pylist())
+    return x, y
+
+
+def read_xy(path: str, label_col: str, feature_cols: Sequence[str]):
+    """Load an entire spilled Parquet file (used for the — bounded —
+    validation set)."""
+    import pyarrow.parquet as pq
+
+    table = pq.ParquetFile(path).read()
+    return _table_to_xy(table, label_col, feature_cols)
+
+
+def stream_batches(path: str, label_col: str, feature_cols: Sequence[str],
+                   batch_size: int, target_rows: int, seed: int,
+                   shuffle: bool = True):
+    """Yield exactly ``ceil(target_rows / batch_size)`` full batches from
+    the spilled Parquet file, one row group in memory at a time.
+
+    ``target_rows`` is the cross-rank MAX train length: ranks with fewer
+    rows wrap around (re-reading row groups from the start) so every
+    rank issues the same number of lockstep collective steps — the same
+    wrap-padding discipline as the in-memory path, applied lazily.
+    Shuffle is two-level (row-group order + rows within a group), the
+    standard out-of-core approximation of a global permutation (the
+    reference's Petastorm reader shuffles the same way).
+    """
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    n_rg = pf.metadata.num_row_groups
+    rng = np.random.RandomState(seed)
+    n_batches = -(-target_rows // batch_size)
+    emitted = 0
+    bx = by = None
+    while emitted < n_batches:
+        order = rng.permutation(n_rg) if shuffle else np.arange(n_rg)
+        for rg in order:
+            tbl = pf.read_row_group(int(rg))
+            x, y = _table_to_xy(tbl, label_col, feature_cols)
+            if shuffle:
+                p = rng.permutation(len(x))
+                x, y = x[p], y[p]
+            bx = x if bx is None else np.concatenate([bx, x])
+            by = y if by is None else np.concatenate([by, y])
+            while len(bx) >= batch_size and emitted < n_batches:
+                yield bx[:batch_size], by[:batch_size]
+                bx = bx[batch_size:]
+                by = by[batch_size:]
+                emitted += 1
+            if emitted >= n_batches:
+                return
+        # wrapped past the file's end with batches still owed: keep the
+        # partial-batch remainder and continue from a fresh group order
+        # (the lazy analog of wrap-padding).

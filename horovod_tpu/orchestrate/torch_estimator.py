@@ -29,9 +29,11 @@ class TorchModel:
     """Trained model handle (ref: spark/torch TorchModel — transform()
     runs the predict path; the underlying torch module is exposed)."""
 
-    def __init__(self, model, history: Optional[List[Dict]] = None):
+    def __init__(self, model, history: Optional[List[Dict]] = None,
+                 df_meta: Optional[Dict] = None):
         self.model = model
         self.history_ = history or []
+        self._df_meta = df_meta or {}
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         import torch
@@ -42,13 +44,51 @@ class TorchModel:
             out = self.model(torch.as_tensor(np.asarray(x), dtype=dtype))
         return out.numpy()
 
-    def transform(self, x: np.ndarray) -> np.ndarray:
+    def transform(self, x):
+        """numpy in -> predictions out; Spark DataFrame in -> DataFrame
+        out with a prediction column (ref: spark/torch/estimator.py:413
+        _transform)."""
+        from .estimator import _is_spark_dataframe, df_transform
+
+        if _is_spark_dataframe(x):
+            return df_transform(x, torch_df_predictor(self.model),
+                                self._df_meta)
         return self.predict(x)
 
     def save(self, path: str) -> None:
         import torch
 
         torch.save(self.model, path)
+
+
+def torch_df_predictor(model):
+    """Picklable ``x -> preds`` closure over torch.save bytes for
+    DataFrame-out inference (shared by TorchModel and LightningModel):
+    ships the serialized module to executors and deserializes it lazily,
+    once per worker process (the per-chunk calls reuse the cached
+    module — like the reference's UDF deserializing per partition,
+    spark/torch/estimator.py:430)."""
+    import torch
+
+    buf = io.BytesIO()
+    torch.save(model, buf)
+    model_bytes = buf.getvalue()
+    cache: Dict[str, Any] = {}
+
+    def predict(xa):
+        import torch as _t
+
+        if "m" not in cache:
+            m = _t.load(io.BytesIO(model_bytes), weights_only=False)
+            m.eval()
+            cache["m"] = m
+        m = cache["m"]
+        dtype = next(m.parameters()).dtype
+        with _t.no_grad():
+            out = m(_t.as_tensor(np.asarray(xa), dtype=dtype))
+        return out.numpy()
+
+    return predict
 
 
 def _torch_worker(spec: Dict[str, Any], model_bytes: bytes, x, y):
@@ -137,6 +177,7 @@ class TorchEstimator:
                  num_workers: int = 1, epochs: int = 1,
                  batch_size: int = 32, shuffle: bool = True, seed: int = 0,
                  label_col: str = "label", feature_cols=None,
+                 output_col: str = "prediction",
                  env: Optional[Dict[str, str]] = None):
         if model is None or optimizer is None or loss is None:
             raise ValueError("TorchEstimator requires model, optimizer "
@@ -146,6 +187,7 @@ class TorchEstimator:
         self._env = env
         self._label_col = label_col
         self._feature_cols = feature_cols
+        self._output_col = output_col
         # Serialize the optimizer's full param-group structure by param
         # POSITION in model.parameters() order (ids differ per process).
         pos = {id(p): i for i, p in enumerate(model.parameters())}
@@ -193,7 +235,13 @@ class TorchEstimator:
         trained.load_state_dict(
             torch.load(io.BytesIO(out["state"]), weights_only=False))
         self.history_ = out["history"]
-        return TorchModel(trained, out["history"])
+        return TorchModel(trained, out["history"], df_meta=self._df_meta())
+
+    def _df_meta(self):
+        return {"label_col": self._label_col,
+                "feature_cols": (list(self._feature_cols)
+                                 if self._feature_cols else None),
+                "output_col": self._output_col}
 
     def _fit_spark_df(self, df, y) -> TorchModel:
         """fit(df): training inside Spark barrier tasks, rank r on
@@ -229,7 +277,7 @@ class TorchEstimator:
         trained.load_state_dict(
             torch.load(io.BytesIO(out["state"]), weights_only=False))
         self.history_ = out["history"]
-        return TorchModel(trained, out["history"])
+        return TorchModel(trained, out["history"], df_meta=self._df_meta())
 
 
 def _torch_df_worker(spec, meta, model_bytes, rows):

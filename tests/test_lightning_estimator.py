@@ -1,0 +1,137 @@
+"""LightningEstimator tests (ref analog: test_spark_lightning.py fit
+contract).  pytorch_lightning is not in this image: the estimator drives
+the LightningModule PROTOCOL (training_step/configure_optimizers/
+validation_step), so a plain torch module implementing it exercises the
+identical code path a real pl.LightningModule would."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def _toy_regression(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    w = np.asarray([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ w + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return x, y
+
+
+class _ProtocolModule(torch.nn.Module):
+    """A LightningModule-shaped model without pytorch_lightning: the
+    three protocol methods over a plain torch module."""
+
+    def __init__(self, seed=2, lr=0.05, dict_loss=False):
+        super().__init__()
+        torch.manual_seed(seed)
+        self.net = torch.nn.Sequential(torch.nn.Linear(4, 8),
+                                       torch.nn.ReLU(),
+                                       torch.nn.Linear(8, 1))
+        self._lr = lr
+        self._dict_loss = dict_loss
+
+    def forward(self, x):
+        return self.net(x)
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        loss = torch.nn.functional.mse_loss(self(x), y)
+        return {"loss": loss} if self._dict_loss else loss
+
+    def validation_step(self, batch, batch_idx):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)
+
+    def configure_optimizers(self):
+        return torch.optim.Adam(self.parameters(), lr=self._lr)
+
+
+class _TrainOnly(torch.nn.Module):
+    """Protocol module WITHOUT validation_step (module-level: torch.save
+    pickles by qualified name)."""
+
+    def __init__(self):
+        super().__init__()
+        torch.manual_seed(4)
+        self.lin = torch.nn.Linear(4, 1)
+
+    def forward(self, x):
+        return self.lin(x)
+
+    def training_step(self, batch, i):
+        x, y = batch
+        return torch.nn.functional.mse_loss(self(x), y)
+
+    def configure_optimizers(self):
+        return torch.optim.SGD(self.parameters(), lr=0.05)
+
+
+class TestLightningEstimator:
+    def test_validation(self):
+        from horovod_tpu.orchestrate import LightningEstimator
+
+        with pytest.raises(ValueError, match="requires a model"):
+            LightningEstimator()
+        with pytest.raises(ValueError, match="training_step"):
+            LightningEstimator(model=torch.nn.Linear(2, 1))
+
+    def test_optimizer_resolution_shapes(self):
+        from horovod_tpu.orchestrate.lightning_estimator import \
+            _resolve_optimizer
+
+        m = torch.nn.Linear(2, 1)
+        opt = torch.optim.SGD(m.parameters(), lr=0.1)
+        sched = torch.optim.lr_scheduler.StepLR(opt, 1)
+        assert _resolve_optimizer(opt) is opt
+        assert _resolve_optimizer([opt]) is opt
+        assert _resolve_optimizer(([opt], [sched])) is opt
+        assert _resolve_optimizer({"optimizer": opt,
+                                   "lr_scheduler": sched}) is opt
+
+    @pytest.mark.integration
+    def test_fit_two_workers_protocol_module(self, monkeypatch):
+        from horovod_tpu.orchestrate import LightningEstimator
+        from horovod_tpu.orchestrate.executor import Executor
+
+        captured = {}
+        orig_run = Executor.run
+
+        def spy(self, fn, args=(), kwargs=None, per_rank_args=None):
+            res = orig_run(self, fn, args=args, kwargs=kwargs,
+                           per_rank_args=per_rank_args)
+            captured["results"] = res
+            return res
+
+        monkeypatch.setattr(Executor, "run", spy)
+        x, y = _toy_regression(n=64, seed=7)
+        est = LightningEstimator(model=_ProtocolModule(dict_loss=True),
+                                 num_workers=2, epochs=8, batch_size=16,
+                                 validation_split=0.25)
+        out = est.fit(x, y)
+        hist = est.history_
+        assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+        assert "val_loss" in hist[-1]
+        pred = out.transform(x)
+        assert pred.shape == (len(x), 1)
+        assert float(np.mean((pred - y) ** 2)) < 3.0
+        res = captured["results"]
+        # one world of 2, ranks ended in sync
+        assert [r["size"] for r in res] == [2, 2]
+        assert res[0]["checksum"] == pytest.approx(res[1]["checksum"],
+                                                   abs=1e-8)
+
+    @pytest.mark.integration
+    def test_fit_single_worker_no_validation_step(self):
+        """validation_split is ignored when the module defines no
+        validation_step (the Lightning contract: no val loop)."""
+        from horovod_tpu.orchestrate import LightningEstimator
+
+        x, y = _toy_regression(n=32, seed=5)
+        est = LightningEstimator(model=_TrainOnly(), num_workers=1,
+                                 epochs=4, batch_size=8,
+                                 validation_split=0.25)
+        est.fit(x, y)
+        assert "val_loss" not in est.history_[-1]
+        assert est.history_[-1]["train_loss"] < est.history_[0][
+            "train_loss"]

@@ -14,6 +14,7 @@ import os
 import sys
 import types
 
+import numpy as np
 import pytest
 
 
@@ -204,6 +205,13 @@ class _DataRDD(_DataBarrierRDD):
     def barrier(self):
         b = _DataBarrierRDD(self._sc, self._partitions)
         return b
+
+    def toDF(self):
+        """pyspark RDD.toDF surface for the (non-barrier) transform
+        path: collect the mapped rows into a new stub DataFrame."""
+        rows = self.collect()
+        cols = list(rows[0].keys()) if rows else []
+        return _StubDataFrame(rows, cols, self._sc)
 
 
 class _StubDataFrame:
@@ -406,3 +414,213 @@ class TestFrameworkEstimatorsDataFrame:
         all_x = sorted(v for s in shards.values() for v in s)
         assert all_x == [float(i) for i in range(6)]
         assert trained is not None
+
+
+class TestTransformDataFrame:
+    """DataFrame-out inference (ref: spark/torch/estimator.py:413-470
+    _transform): model.transform(df) -> df with a prediction column."""
+
+    def _df(self, stub, n=7):
+        rows = [{"f1": float(i), "f2": float(10 * i), "label": float(i)}
+                for i in range(n)]
+        return _StubDataFrame(rows, ["f1", "f2", "label"], stub)
+
+    def test_jax_model_transform_schema_and_values(self, spark_stub):
+        from horovod_tpu.orchestrate import JaxModel
+
+        model = JaxModel(
+            params={"w": np.asarray([2.0, 0.5])},
+            predict_fn=lambda p, x: x @ p["w"],
+            df_meta={"label_col": "label", "feature_cols": None,
+                     "output_col": "prediction"})
+        out = model.transform(self._df(spark_stub))
+        # Schema: original columns + the prediction column.
+        assert set(out.columns) == {"f1", "f2", "label", "prediction"}
+        rows = sorted(out._rows, key=lambda r: r["f1"])
+        assert len(rows) == 7
+        for r in rows:
+            # label was EXCLUDED from features: pred = 2*f1 + 0.5*f2
+            assert r["prediction"] == pytest.approx(
+                2.0 * r["f1"] + 0.5 * r["f2"])
+
+    def test_predict_runs_once_per_partition(self, spark_stub):
+        from horovod_tpu.orchestrate import JaxModel
+
+        calls = []
+
+        def predict_fn(p, x):
+            calls.append(len(x))
+            return np.zeros(len(x))
+
+        model = JaxModel(params=None, predict_fn=predict_fn,
+                         df_meta={"label_col": "label"})
+        out = model.transform(self._df(spark_stub))
+        # One predict per (non-empty) partition; rows add up.
+        assert len(calls) == spark_stub.defaultParallelism
+        assert sum(calls) == 7
+        assert all(c > 0 for c in calls)
+        assert len(out._rows) == 7
+
+    def test_vector_predictions_become_lists(self, spark_stub):
+        from horovod_tpu.orchestrate import JaxModel
+
+        model = JaxModel(
+            params=None,
+            predict_fn=lambda p, x: np.stack([x[:, 0], -x[:, 0]], axis=1),
+            df_meta={"label_col": "label", "output_col": "probs"})
+        out = model.transform(self._df(spark_stub))
+        for r in out._rows:
+            assert r["probs"] == [r["f1"], -r["f1"]]
+
+    def test_numpy_input_still_predicts(self):
+        from horovod_tpu.orchestrate import JaxModel
+
+        model = JaxModel(params=3.0, predict_fn=lambda p, x: x * p)
+        np.testing.assert_allclose(model.transform(np.ones(4)), 3.0)
+
+    def test_torch_model_transform_df(self, spark_stub):
+        import torch
+
+        from horovod_tpu.orchestrate import TorchModel
+
+        lin = torch.nn.Linear(2, 1, bias=False)
+        with torch.no_grad():
+            lin.weight.copy_(torch.tensor([[1.0, 1.0]]))
+        model = TorchModel(lin, df_meta={"label_col": "label"})
+        out = model.transform(self._df(spark_stub, n=5))
+        assert "prediction" in out.columns
+        for r in out._rows:
+            assert r["prediction"] == pytest.approx(r["f1"] + r["f2"])
+
+    def test_keras_model_transform_df(self, spark_stub):
+        keras = pytest.importorskip("keras")
+
+        from horovod_tpu.orchestrate import KerasModel
+
+        m = keras.Sequential([keras.layers.Input((2,)),
+                              keras.layers.Dense(1, use_bias=False,
+                                                 kernel_initializer="ones")])
+        model = KerasModel(m, df_meta={"label_col": "label"})
+        out = model.transform(self._df(spark_stub, n=5))
+        assert "prediction" in out.columns
+        for r in out._rows:
+            assert r["prediction"] == pytest.approx(r["f1"] + r["f2"],
+                                                    rel=1e-5)
+
+
+class TestOutOfCore:
+    """Out-of-core fit(df) (VERDICT r3 #5; ref: spark/common/util.py
+    prepare_data + Petastorm row-group streaming): partitions spill to
+    Parquet row groups and stream back batch-wise — bounded memory."""
+
+    def _row_gen(self, n):
+        for i in range(n):
+            yield {"f1": float(i), "f2": float(2 * i),
+                   "label": float(3 * i)}
+
+    def test_spill_is_chunk_bounded(self, tmp_path, monkeypatch):
+        """The artificial memory cap: the spiller may never hold more
+        than rows_per_group rows at once, even for a partition 10x
+        that size."""
+        from horovod_tpu.orchestrate import spill as spill_mod
+
+        cap = 8
+        seen = []
+        orig = spill_mod._rows_chunk_to_table
+
+        def capped(rows, label_col, feature_cols):
+            seen.append(len(rows))
+            assert len(rows) <= cap, "memory cap exceeded"
+            return orig(rows, label_col, feature_cols)
+
+        monkeypatch.setattr(spill_mod, "_rows_chunk_to_table", capped)
+        train, val, n_train, n_val, cols = \
+            spill_mod.spill_partition_to_parquet(
+                self._row_gen(80), "label", None, 0.0, str(tmp_path),
+                rows_per_group=cap)
+        assert n_train == 80 and n_val == 0 and val is None
+        assert len(seen) == 10                    # 80 rows / 8-row chunks
+        import pyarrow.parquet as pq
+
+        assert pq.ParquetFile(train).metadata.num_row_groups == 10
+        x, y = spill_mod.read_xy(train, "label", cols)
+        assert x.shape == (80, 2)
+        np.testing.assert_allclose(y, 3 * x[:, 0])
+
+    def test_spill_per_chunk_validation_split(self, tmp_path):
+        from horovod_tpu.orchestrate import spill as spill_mod
+
+        train, val, n_train, n_val, cols = \
+            spill_mod.spill_partition_to_parquet(
+                self._row_gen(40), "label", None, 0.25, str(tmp_path),
+                rows_per_group=8)
+        assert n_train == 30 and n_val == 10
+        xv, yv = spill_mod.read_xy(val, "label", cols)
+        assert len(xv) == 10
+        # split-clean: no row in both files
+        xt, _ = spill_mod.read_xy(train, "label", cols)
+        assert not set(xt[:, 0]) & set(xv[:, 0])
+
+    def test_stream_batches_wrap_to_target(self, tmp_path):
+        """A rank with 10 rows asked for target 16 wraps around: 4 full
+        batches of 4 — the lazy analog of wrap-padding."""
+        from horovod_tpu.orchestrate import spill as spill_mod
+
+        train, _, n, _, cols = spill_mod.spill_partition_to_parquet(
+            self._row_gen(10), "label", None, 0.0, str(tmp_path),
+            rows_per_group=4)
+        assert n == 10
+        batches = list(spill_mod.stream_batches(
+            train, "label", cols, batch_size=4, target_rows=16, seed=0))
+        assert len(batches) == 4
+        assert all(xb.shape == (4, 2) and yb.shape == (4,)
+                   for xb, yb in batches)
+        # every one of the 10 distinct rows appears at least once
+        seen = {v for xb, _ in batches for v in xb[:, 0]}
+        assert seen == {float(i) for i in range(10)}
+
+    def test_estimator_fit_df_disk_cache(self, spark_stub, monkeypatch):
+        """e2e: cache='disk' trains through the spill->stream path with
+        bounded chunks and never materializes the partition row list."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.orchestrate import JaxEstimator
+        from horovod_tpu.orchestrate import estimator as est_mod
+        from horovod_tpu.orchestrate import spill as spill_mod
+
+        cap = 16
+        orig = spill_mod._rows_chunk_to_table
+        chunks = []
+
+        def capped(rows, label_col, feature_cols):
+            chunks.append(len(rows))
+            assert len(rows) <= cap
+            return orig(rows, label_col, feature_cols)
+
+        monkeypatch.setattr(spill_mod, "_rows_chunk_to_table", capped)
+        # the row-list path must never run in disk mode
+        monkeypatch.setattr(
+            est_mod, "_rows_to_xy",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("row-list path used in disk mode")))
+
+        rows = [{"x": float(i % 7), "label": 2.0 * (i % 7)}
+                for i in range(96)]
+        df = _StubDataFrame(rows, ["x", "label"], spark_stub)
+
+        import optax
+
+        est = JaxEstimator(
+            model_init=lambda key: {"w": jnp.zeros((1, 1))},
+            loss_fn=lambda p, xb, yb: jnp.mean(
+                (xb @ p["w"] - yb[:, None]) ** 2),
+            predict_fn=lambda p, x: x @ p["w"],
+            optimizer=optax.sgd(0.02),
+            num_workers=1, epochs=8, batch_size=16, seed=0,
+            cache="disk", rows_per_group=cap)
+        model = est.fit(df.repartition(1))
+        assert len(chunks) >= 96 // cap          # partition streamed
+        assert est.history_[-1]["train_loss"] < est.history_[0][
+            "train_loss"]
+        pred = model.predict(np.asarray([[2.0]], np.float32))
+        assert abs(float(pred[0, 0]) - 4.0) < 1.5

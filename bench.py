@@ -87,6 +87,10 @@ def _parse_args(argv=None):
     ap.add_argument("--num-iters", type=int, default=5)
     ap.add_argument("--num-batches-per-iter", type=int, default=50)
     ap.add_argument("--num-warmup", type=int, default=2)
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help=">1: run N steps inside one jit via lax.fori_loop "
+                         "(removes per-call dispatch gaps; A/B probe for "
+                         "the non-conv overlap question, VERDICT r3 #4)")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -116,12 +120,27 @@ def _run_child(args) -> None:
     labels = jax.random.randint(jax.random.PRNGKey(2), (args.batch_size,),
                                 0, 1000)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-    def step(params, stats, opt_state, images, labels):
+    def one_step(params, stats, opt_state, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
             resnet_loss, has_aux=True)(params, stats, images, labels, cfg)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    if args.steps_per_call > 1:
+        from jax import lax
+
+        def step_fn(params, stats, opt_state, images, labels):
+            def body(_, carry):
+                p, s, o, _loss = carry
+                p, s, o, loss = one_step(p, s, o, images, labels)
+                return p, s, o, loss.astype(jnp.float32)
+
+            init = (params, stats, opt_state,
+                    jnp.zeros((), jnp.float32))
+            return lax.fori_loop(0, args.steps_per_call, body, init)
+    else:
+        step_fn = one_step
+    step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     t0 = time.perf_counter()
     compiled = step.lower(params, stats, opt_state, images, labels).compile()
@@ -131,12 +150,12 @@ def _run_child(args) -> None:
     except Exception:
         cost = {}
     try:
-        flops_per_step = float(cost["flops"])
+        flops_per_step = float(cost["flops"]) / args.steps_per_call
     except (KeyError, TypeError, ValueError):
         # Analytic fallback: ~3x forward FLOPs for training ResNet-50.
         flops_per_step = 3 * 4.1e9 * args.batch_size
     try:
-        bytes_per_step = float(cost["bytes accessed"])
+        bytes_per_step = float(cost["bytes accessed"]) / args.steps_per_call
     except (KeyError, TypeError, ValueError):
         bytes_per_step = None
 
@@ -163,7 +182,8 @@ def _run_child(args) -> None:
                 params, stats, opt_state, images, labels)
         float(loss)
         dt = time.perf_counter() - t0
-        rates.append(args.batch_size * args.num_batches_per_iter / dt)
+        rates.append(args.batch_size * args.num_batches_per_iter
+                     * args.steps_per_call / dt)
 
     value = float(np.mean(rates))
     peak, peak_bw = _peak_for(dev.device_kind)
@@ -187,8 +207,9 @@ def _run_child(args) -> None:
     hbm_util = hbm_method = None
     est_upper = (steps_per_s * bytes_per_step / peak_bw
                  if peak_bw and bytes_per_step else None)
-    if peak_bw and os.environ.get("HVDT_BENCH_PROFILE", "1") not in (
-            "0", "false", "off"):
+    if (peak_bw and args.steps_per_call == 1
+            and os.environ.get("HVDT_BENCH_PROFILE", "1") not in (
+                "0", "false", "off")):
         try:
             # Capped at 1.0: the per-op duration cap makes >1 possible
             # only when profiler overhead inflates traced durations
@@ -290,7 +311,8 @@ def main() -> None:
             "--image-size", str(args.image_size),
             "--num-iters", str(args.num_iters),
             "--num-batches-per-iter", str(args.num_batches_per_iter),
-            "--num-warmup", str(args.num_warmup)]
+            "--num-warmup", str(args.num_warmup),
+            "--steps-per-call", str(args.steps_per_call)]
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

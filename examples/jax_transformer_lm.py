@@ -54,6 +54,10 @@ def main():
                    help="activation/compute dtype (bfloat16 on TPU)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (trade FLOPs for HBM)")
+    p.add_argument("--no-remat", action="store_true",
+                   help="force remat OFF even when a preset enables it "
+                        "(drops the 4/3 recompute; needs the "
+                        "activations to fit in HBM — small batch)")
     p.add_argument("--remat-policy", choices=["full", "dots"],
                    default="full",
                    help="full: recompute the whole block; dots: save "
@@ -75,6 +79,8 @@ def main():
         for k, v in PRESETS[args.preset].items():
             if getattr(args, k) == p.get_default(k):
                 setattr(args, k, v)
+    if args.no_remat:
+        args.remat = False
 
     import jax
     import jax.numpy as jnp

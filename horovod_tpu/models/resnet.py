@@ -34,6 +34,10 @@ __all__ = ["ResNetConfig", "resnet50_init", "resnet101_init",
 # 1656.82 img/s over 16 P100s); ResNet-50 is its synthetic-benchmark
 # default (examples/pytorch/pytorch_synthetic_benchmark.py:17-26).
 _STAGES = {
+    # Minimal bottleneck layout (ResNet-26): one block per stage — same
+    # stem/BN/downsample plumbing as 50/101 at a fraction of the compile
+    # time; used by tests that probe plumbing rather than capacity.
+    26: ((1, 64), (1, 128), (1, 256), (1, 512)),
     50: ((3, 64), (4, 128), (6, 256), (3, 512)),
     101: ((3, 64), (4, 128), (23, 256), (3, 512)),
 }

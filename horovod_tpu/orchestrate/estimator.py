@@ -302,6 +302,7 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
     spill_cleanup = None     # set by the out-of-core branch
     try:
         stream = None        # (train_path, feature_cols, target_rows) or None
+        stream_val = None    # val parquet path (streamed eval) or None
         if spec.get("spark_df_stream"):
             # Out-of-core DataFrame mode (ref: spark/common/util.py
             # prepare_data + Petastorm row-group streaming): x_train carries
@@ -310,20 +311,24 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             # batch-wise each epoch — the partition is never materialized.
             import tempfile
 
-            from .spill import read_xy, spill_partition_to_parquet
+            from .spill import spill_partition_to_parquet
 
             meta = spec["spark_df_stream"]
             spill_dir = meta.get("spill_dir")
             spill_created = spill_dir is None
             if spill_created:
                 spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
+            # Cleanup target is known BEFORE the spill runs (the writer's
+            # path naming is deterministic), so a mid-spill failure still
+            # removes whatever row groups were already written.
+            spill_cleanup = (spill_dir if spill_created else [
+                os.path.join(spill_dir, f"rank{rank}_train.parquet"),
+                os.path.join(spill_dir, f"rank{rank}_val.parquet")])
             train_path, val_path, n_train, n_val, feat_cols = \
                 spill_partition_to_parquet(
                     x_train, meta["label_col"], meta["feature_cols"],
                     spec["validation_split"], spill_dir,
                     meta.get("rows_per_group", 4096), prefix=f"rank{rank}")
-            spill_cleanup = (spill_dir if spill_created
-                             else [train_path, val_path])
             target, min_len = _hvd_exchange_lengths(hvd, n_train)
             if min_len == 0:
                 raise ValueError(
@@ -336,10 +341,13 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             # rank zero val rows (partition an exact multiple of
             # rows_per_group with a tiny split): if ANY rank got none, all
             # ranks skip validation rather than mismatch the collective.
+            # Evaluation STREAMS the val file (stream_val_loss) — the val
+            # set is partition-proportional, so materializing it would
+            # defeat the bounded-memory contract.
             _, min_val = _hvd_exchange_lengths(hvd, n_val,
                                                name="est_stream/val")
             if val_path is not None and min_val > 0:
-                x_val, y_val = read_xy(val_path, meta["label_col"], feat_cols)
+                stream_val = val_path
             stream = (train_path, meta["label_col"], feat_cols, target)
             x_train = np.zeros((0, 1), np.float32)   # loop streams instead
             y_train = np.zeros((0,), np.float32)
@@ -441,9 +449,16 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             row["train_loss"] = float(np.asarray(hvd.allreduce(
                 np.asarray([row["train_loss"]], np.float32),
                 name="est_metric/train"))[0])
+            vl = None
             if x_val is not None:
                 vl = float(eval_loss(params, np.asarray(x_val),
                                      np.asarray(y_val)))
+            elif stream_val is not None:
+                from .spill import stream_val_loss
+
+                vl = stream_val_loss(eval_loss, params, stream_val,
+                                     stream[1], stream[2])
+            if vl is not None:
                 row["val_loss"] = float(np.asarray(hvd.allreduce(
                     np.asarray([vl], np.float32), name="est_metric/val"))[0])
             history.append(row)
@@ -709,10 +724,16 @@ class JaxEstimator:
                         df_meta=self._df_meta())
 
     def _df_meta(self) -> Dict[str, Any]:
-        return {"label_col": self._label_col,
-                "feature_cols": (list(self._feature_cols)
-                                 if self._feature_cols else None),
-                "output_col": self._output_col}
+        return estimator_df_meta(self)
+
+
+def estimator_df_meta(est) -> Dict[str, Any]:
+    """The df_meta dict shared by every estimator's model handle
+    (label/feature/output columns for transform(df) and fit(df))."""
+    return {"label_col": est._label_col,
+            "feature_cols": (list(est._feature_cols)
+                             if est._feature_cols else None),
+            "output_col": est._output_col}
 
 
 def check_one_world(results, num_workers: int) -> None:

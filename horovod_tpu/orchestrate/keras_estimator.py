@@ -68,17 +68,22 @@ class KerasModel:
         """numpy in -> predictions out; Spark DataFrame in -> DataFrame
         out with a prediction column (ref: spark/keras KerasModel
         _transform).  The model ships to executors as serialized bytes
-        and deserializes once per partition, like the reference's UDF."""
+        and deserializes lazily, once per worker process (per-chunk
+        calls reuse the cached model), like the reference's UDF."""
         from .estimator import _is_spark_dataframe, df_transform
 
         if _is_spark_dataframe(x):
             model_bytes = _model_to_bytes(self.model)
             custom = self._custom_objects
+            cache: Dict[str, Any] = {}
 
             def predict(xa):
-                m = _model_from_bytes(model_bytes, distributed=False,
-                                      custom_objects=custom)
-                return np.asarray(m.predict(np.asarray(xa), verbose=0))
+                if "m" not in cache:
+                    cache["m"] = _model_from_bytes(
+                        model_bytes, distributed=False,
+                        custom_objects=custom)
+                return np.asarray(cache["m"].predict(np.asarray(xa),
+                                                     verbose=0))
 
             return df_transform(x, predict, self._df_meta)
         return self.predict(x)
@@ -209,10 +214,9 @@ class KerasEstimator:
                           custom_objects=self._spec["custom_objects"])
 
     def _df_meta(self):
-        return {"label_col": self._label_col,
-                "feature_cols": (list(self._feature_cols)
-                                 if self._feature_cols else None),
-                "output_col": self._output_col}
+        from .estimator import estimator_df_meta
+
+        return estimator_df_meta(self)
 
     def _fit_spark_df(self, df, y) -> KerasModel:
         """fit(df): training runs inside Spark barrier tasks on each

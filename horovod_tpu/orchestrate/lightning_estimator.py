@@ -77,16 +77,15 @@ class LightningModel:
 
 def _resolve_optimizer(configured):
     """configure_optimizers() may return an optimizer, a list/tuple of
-    optimizers (+ optional schedulers list), or a dict with an
-    'optimizer' key (the LightningModule contract).  One optimizer is
-    supported — the reference's remote harness trains opt[0] too."""
+    optimizers (+ optional schedulers list), a dict with an 'optimizer'
+    key, or a list of such dicts (all documented LightningModule
+    contract shapes).  One optimizer is supported — the reference's
+    remote harness trains opt[0] too."""
     if isinstance(configured, dict):
-        return configured["optimizer"]
+        return _resolve_optimizer(configured["optimizer"])
     if isinstance(configured, (list, tuple)):
-        first = configured[0]
-        if isinstance(first, (list, tuple)):     # ([opts], [scheds])
-            return first[0]
-        return first
+        # covers [opt], ([opts], [scheds]), and [{"optimizer": ...}]
+        return _resolve_optimizer(configured[0])
     return configured
 
 
@@ -216,10 +215,9 @@ class LightningEstimator:
         self.history_: List[Dict[str, float]] = []
 
     def _df_meta(self):
-        return {"label_col": self._label_col,
-                "feature_cols": (list(self._feature_cols)
-                                 if self._feature_cols else None),
-                "output_col": self._output_col}
+        from .estimator import estimator_df_meta
+
+        return estimator_df_meta(self)
 
     def fit(self, x, y: Optional[np.ndarray] = None) -> LightningModel:
         import torch

@@ -42,8 +42,16 @@ def _rows_chunk_to_table(rows, label_col: str, feature_cols):
         else:
             data[c] = pa.array([[float(x) for x in v] for v in vals],
                                pa.list_(pa.float32()))
-    data[label_col] = pa.array(
-        [np.asarray(_row_get(r, label_col)).item() for r in rows])
+    labels = [np.asarray(_row_get(r, label_col)) for r in rows]
+    if labels[0].size == 1:
+        # scalar labels keep their native dtype via pyarrow inference
+        data[label_col] = pa.array([lb.item() for lb in labels])
+    else:
+        # vector labels round-trip as float32 lists (the in-memory path
+        # keeps native dtype; Parquet needs a concrete column type)
+        data[label_col] = pa.array(
+            [[float(x) for x in np.ravel(lb)] for lb in labels],
+            pa.list_(pa.float32()))
     return pa.table(data), cols
 
 
@@ -130,6 +138,26 @@ def read_xy(path: str, label_col: str, feature_cols: Sequence[str]):
 
     table = pq.ParquetFile(path).read()
     return _table_to_xy(table, label_col, feature_cols)
+
+
+def stream_val_loss(eval_loss, params, path: str, label_col: str,
+                    feature_cols: Sequence[str]) -> float:
+    """Weighted-mean validation loss streamed one row group at a time —
+    the val set is partition-proportional, so materializing it whole
+    would defeat the bounded-memory contract the disk cache exists
+    for.  (At most two distinct row-group shapes reach ``eval_loss``:
+    full groups and the final partial one, so jit recompiles at most
+    twice.)"""
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    tot = 0.0
+    n = 0
+    for rg in range(pf.metadata.num_row_groups):
+        x, y = _table_to_xy(pf.read_row_group(rg), label_col, feature_cols)
+        tot += float(eval_loss(params, x, y)) * len(x)
+        n += len(x)
+    return tot / max(n, 1)
 
 
 def stream_batches(path: str, label_col: str, feature_cols: Sequence[str],

@@ -238,10 +238,9 @@ class TorchEstimator:
         return TorchModel(trained, out["history"], df_meta=self._df_meta())
 
     def _df_meta(self):
-        return {"label_col": self._label_col,
-                "feature_cols": (list(self._feature_cols)
-                                 if self._feature_cols else None),
-                "output_col": self._output_col}
+        from .estimator import estimator_df_meta
+
+        return estimator_df_meta(self)
 
     def _fit_spark_df(self, df, y) -> TorchModel:
         """fit(df): training inside Spark barrier tasks, rank r on

@@ -624,3 +624,34 @@ class TestOutOfCore:
             "train_loss"]
         pred = model.predict(np.asarray([[2.0]], np.float32))
         assert abs(float(pred[0, 0]) - 4.0) < 1.5
+
+    def test_spill_vector_labels_round_trip(self, tmp_path):
+        """Vector labels must survive the Parquet round trip (the
+        in-memory path supports them; disk mode must not change which
+        schemas train)."""
+        from horovod_tpu.orchestrate import spill as spill_mod
+
+        rows = [{"f": float(i), "label": [float(i), float(-i)]}
+                for i in range(12)]
+        train, _, n, _, cols = spill_mod.spill_partition_to_parquet(
+            iter(rows), "label", None, 0.0, str(tmp_path),
+            rows_per_group=5)
+        x, y = spill_mod.read_xy(train, "label", cols)
+        assert n == 12 and y.shape == (12, 2)
+        np.testing.assert_allclose(y[:, 0], x[:, 0])
+        np.testing.assert_allclose(y[:, 1], -x[:, 0])
+
+    def test_stream_val_loss_weighted_mean(self, tmp_path):
+        from horovod_tpu.orchestrate import spill as spill_mod
+
+        train, _, n, _, cols = spill_mod.spill_partition_to_parquet(
+            self._row_gen(10), "label", None, 0.0, str(tmp_path),
+            rows_per_group=4)
+
+        def eval_loss(params, x, y):
+            return float(np.mean(y))         # mean label
+
+        # weighted mean over row groups == global mean of 3*i, i<10
+        got = spill_mod.stream_val_loss(eval_loss, None, train, "label",
+                                        cols)
+        assert got == pytest.approx(np.mean([3.0 * i for i in range(10)]))

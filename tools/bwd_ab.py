@@ -1,0 +1,89 @@
+"""A/B: Pallas flash backward kernels vs the blockwise-XLA backward.
+
+Measures the backward-only cost of both paths at a given shape and
+prints one JSON line — the evidence VERDICT r3 #3 asks for before the
+HVDT_FLASH_BWD default can be flipped.  Timing follows the repo
+contract: each timed region ends with a host fetch of a scalar that
+data-depends on the result (block_until_ready is a no-op over the
+tunnel — docs/performance.md).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.pallas_kernels import (_flash_fwd_core,
+                                            flash_attention,
+                                            flash_grad_block)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=30)
+    args = ap.parse_args()
+
+    b, L, h, d = args.batch, args.seq, args.heads, args.dim
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, L, h, d), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, L, h, d), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, L, h, d), jnp.bfloat16)
+    do = jax.random.normal(jax.random.PRNGKey(3), (b, L, h, d),
+                           jnp.bfloat16)
+
+    @jax.jit
+    def xla_bwd(q, k, v, do):
+        _, vjp = jax.vjp(
+            lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        return vjp(do)
+
+    @jax.jit
+    def pallas_bwd(q, k, v, do):
+        out, lse = _flash_fwd_core(q, k, v, True, d ** -0.5, 512, 512)
+        return flash_grad_block(q, k, v, do, out, lse, causal=True,
+                                scale=d ** -0.5)
+
+    def fetch(r):
+        return float(jnp.asarray(r[0]).ravel()[0].astype(jnp.float32))
+
+    def bench(f):
+        r = f(q, k, v, do)
+        fetch(r)                              # compile + sync
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            r = f(q, k, v, do)
+        fetch(r)                              # host fetch ends the region
+        return (time.perf_counter() - t0) / args.iters
+
+    # correctness gate before timing
+    r1, r2 = xla_bwd(q, k, v, do), pallas_bwd(q, k, v, do)
+    rel = max(
+        float(np.abs(np.asarray(a, np.float32)
+                     - np.asarray(bb, np.float32)).max()
+              / (np.abs(np.asarray(a, np.float32)).max() or 1.0))
+        for a, bb in zip(r1, r2))
+    t_x = bench(xla_bwd)
+    t_p = bench(pallas_bwd)
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "flash_bwd_ab", "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "shape": {"batch": b, "seq": L, "heads": h, "dim": d},
+        "rel_max_diff": rel,
+        "xla_ms": round(t_x * 1000, 2),
+        "pallas_ms": round(t_p * 1000, 2),
+        "pallas_speedup": round(t_x / t_p, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Quiet-window TPU A/B runner (VERDICT r3 #1-#4 evidence collector).
+
+Runs a fixed sequence of experiment legs as subprocesses on the real
+chip, parses each leg's metric line, and appends everything to
+``tools/ab_results.json``.  Designed to run unattended the moment the
+tunnelled chip comes back: leg 0 is the stock ResNet bench (which also
+refreshes bench.py's last-good cache), then the LM legs, then the
+flash-backward kernel A/Bs.
+
+Sequential by construction — this box has one core and one chip, and
+only within-one-window comparisons are valid (docs/performance.md).
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+LM = [PY, os.path.join(REPO, "examples", "jax_transformer_lm.py"),
+      "--preset", "bert-large", "--dp", "1", "--tp", "1",
+      "--dtype", "bfloat16"]
+TOKS = re.compile(r"(\d+) tokens/sec, ~([\d.]+) model TFLOP/s")
+
+
+def lm_leg(name, extra, steps="30", timeout=900):
+    return {"name": name,
+            "cmd": LM + ["--steps", steps] + extra,
+            "timeout": timeout,
+            "parse": lambda out: (
+                {"tokens_per_sec": int(TOKS.search(out).group(1)),
+                 "model_tflops": float(TOKS.search(out).group(2))}
+                if TOKS.search(out) else None)}
+
+
+def json_leg(name, cmd, timeout=900):
+    def parse(out):
+        for line in reversed(out.strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        return None
+    return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse}
+
+
+LEGS = [
+    # Refresh the headline bench FIRST (also writes .bench_last_good.json).
+    json_leg("resnet_bench_default",
+             [PY, os.path.join(REPO, "bench.py")], timeout=1500),
+    # LM: reproduce the round-2/3 baseline, then the untried no-remat legs.
+    lm_leg("lm_base_bs128_remat", ["--batch", "128"]),
+    lm_leg("lm_noremat_bs32", ["--batch", "32", "--no-remat",
+                               "--steps", "60"]),
+    lm_leg("lm_noremat_bs48", ["--batch", "48", "--no-remat",
+                               "--steps", "45"]),
+    lm_leg("lm_noremat_bs64", ["--batch", "64", "--no-remat",
+                               "--steps", "40"]),
+    # Flash backward kernel vs XLA blockwise (the knob-flip evidence).
+    json_leg("bwd_ab_seq4096",
+             [PY, os.path.join(REPO, "tools", "bwd_ab.py"),
+              "--seq", "4096", "--batch", "8"]),
+    json_leg("bwd_ab_seq8192",
+             [PY, os.path.join(REPO, "tools", "bwd_ab.py"),
+              "--seq", "8192", "--batch", "4"]),
+    # ResNet dispatch-gap probe: N steps per jit call via lax.fori_loop
+    # (larger batches were already measured WORSE in round 2 — activation
+    # traffic scales with batch; docs/performance.md).
+    json_leg("resnet_steps_per_call10",
+             [PY, os.path.join(REPO, "bench.py"), "--steps-per-call", "10",
+              "--num-batches-per-iter", "5"], timeout=1500),
+]
+
+
+def run_leg(leg, env):
+    t0 = time.time()
+    try:
+        proc = subprocess.run(leg["cmd"], env=env, capture_output=True,
+                              text=True, timeout=leg["timeout"], cwd=REPO)
+        out = proc.stdout + "\n" + proc.stderr
+        parsed = leg["parse"](proc.stdout)
+        return {"name": leg["name"], "ok": parsed is not None,
+                "wall_s": round(time.time() - t0, 1),
+                "result": parsed,
+                "tail": None if parsed else out[-800:]}
+    except subprocess.TimeoutExpired:
+        return {"name": leg["name"], "ok": False,
+                "wall_s": round(time.time() - t0, 1),
+                "result": None, "tail": f"timeout {leg['timeout']}s"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", help="comma-separated leg names")
+    ap.add_argument("--out", default=os.path.join(REPO, "tools",
+                                                  "ab_results.json"))
+    args = ap.parse_args()
+    legs = LEGS
+    if args.only:
+        want = set(args.only.split(","))
+        legs = [l for l in LEGS if l["name"] in want]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("HVDT_BENCH_ATTEMPT_TIMEOUTS", "600")
+    results = []
+    fails = 0
+    for leg in legs:
+        print(f"=== {leg['name']} ===", flush=True)
+        r = run_leg(leg, env)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+        fails = fails + 1 if not r["ok"] else 0
+        if fails >= 2:
+            print("two consecutive failures — chip likely down, aborting",
+                  flush=True)
+            break
+    hist = []
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                hist = json.load(f)
+        except ValueError:
+            hist = []
+    hist.append({"at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "results": results})
+    with open(args.out, "w") as f:
+        json.dump(hist, f, indent=1)
+    print(f"saved {len(results)} legs -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,10 @@ def _save_last_good(line: str) -> None:
         d = json.loads(line)
         if d.get("platform") in (None, "cpu"):
             return
+        if d.get("steps_per_call"):
+            # A/B probe variants are not the headline metric — caching
+            # one would contaminate the outage-fallback evidence.
+            return
         d["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with open(LAST_GOOD_PATH, "w") as f:
             json.dump(d, f, indent=1)
@@ -239,6 +243,8 @@ def _run_child(args) -> None:
         "hbm_util_est_upper": (round(est_upper, 4)
                                if est_upper is not None else None),
         "batch_size": args.batch_size,
+        **({"steps_per_call": args.steps_per_call}
+           if args.steps_per_call != 1 else {}),
     }))
 
 

@@ -64,7 +64,7 @@ class ResNetConfig:
     # a 3-channel conv and shrinking the 224x224 input slicing XLA
     # otherwise does.  "conv" keeps the literal 7x7 conv.
     stem: str = "s2d"
-    depth: int = 50              # 50 or 101 (bottleneck stage layouts)
+    depth: int = 50              # 26, 50 or 101 (bottleneck stage layouts)
 
 
 def _conv_init(key, kh, kw, cin, cout, dtype):

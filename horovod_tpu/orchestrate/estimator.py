@@ -311,7 +311,7 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             # batch-wise each epoch — the partition is never materialized.
             import tempfile
 
-            from .spill import spill_partition_to_parquet
+            from .spill import spill_partition_to_parquet, spill_paths
 
             meta = spec["spark_df_stream"]
             spill_dir = meta.get("spill_dir")
@@ -321,9 +321,9 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             # Cleanup target is known BEFORE the spill runs (the writer's
             # path naming is deterministic), so a mid-spill failure still
             # removes whatever row groups were already written.
-            spill_cleanup = (spill_dir if spill_created else [
-                os.path.join(spill_dir, f"rank{rank}_train.parquet"),
-                os.path.join(spill_dir, f"rank{rank}_val.parquet")])
+            spill_cleanup = (spill_dir if spill_created
+                             else list(spill_paths(spill_dir,
+                                                   f"rank{rank}")))
             train_path, val_path, n_train, n_val, feat_cols = \
                 spill_partition_to_parquet(
                     x_train, meta["label_col"], meta["feature_cols"],

@@ -21,7 +21,16 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["spill_partition_to_parquet", "stream_batches", "read_xy"]
+__all__ = ["spill_partition_to_parquet", "spill_paths", "stream_batches",
+           "read_xy"]
+
+
+def spill_paths(spill_dir: str, prefix: str) -> Tuple[str, str]:
+    """The (train, val) Parquet paths a spill writes for ``prefix`` —
+    the ONE place the naming contract lives; cleanup code in the workers
+    computes paths through here, never by hand."""
+    return (os.path.join(spill_dir, f"{prefix}_train.parquet"),
+            os.path.join(spill_dir, f"{prefix}_val.parquet"))
 
 
 def _rows_chunk_to_table(rows, label_col: str, feature_cols):
@@ -43,12 +52,14 @@ def _rows_chunk_to_table(rows, label_col: str, feature_cols):
             data[c] = pa.array([[float(x) for x in v] for v in vals],
                                pa.list_(pa.float32()))
     labels = [np.asarray(_row_get(r, label_col)) for r in rows]
-    if labels[0].size == 1:
+    if labels[0].ndim == 0:
         # scalar labels keep their native dtype via pyarrow inference
         data[label_col] = pa.array([lb.item() for lb in labels])
     else:
-        # vector labels round-trip as float32 lists (the in-memory path
-        # keeps native dtype; Parquet needs a concrete column type)
+        # vector labels — INCLUDING length-1 vectors, whose (n, 1) shape
+        # must survive the round trip or losses silently broadcast —
+        # become float32 lists (the in-memory path keeps native dtype;
+        # Parquet needs a concrete column type)
         data[label_col] = pa.array(
             [[float(x) for x in np.ravel(lb)] for lb in labels],
             pa.list_(pa.float32()))
@@ -77,8 +88,7 @@ def spill_partition_to_parquet(
     if spill_dir is None:
         spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
     os.makedirs(spill_dir, exist_ok=True)
-    train_path = os.path.join(spill_dir, f"{prefix}_train.parquet")
-    val_path = os.path.join(spill_dir, f"{prefix}_val.parquet")
+    train_path, val_path = spill_paths(spill_dir, prefix)
 
     writers = {"train": None, "val": None}
     counts = {"train": 0, "val": 0}

@@ -91,8 +91,14 @@ def torch_df_predictor(model):
     return predict
 
 
-def _torch_worker(spec: Dict[str, Any], model_bytes: bytes, x, y):
-    """Executor worker: rebuild model, wrap optimizer, train.
+def _torch_train(spec: Dict[str, Any], model_bytes: bytes, epoch_batches):
+    """Shared torch training core: rebuild model, wrap optimizer, train
+    over ``epoch_batches(epoch) -> iterable[(x_np, y_np)]``.
+
+    Lockstep invariant: the DistributedOptimizer's grad-hook allreduces
+    fire once per backward, so every rank MUST see the same batch count
+    per epoch — array mode guarantees it via equalized shards, stream
+    mode via the exchanged ceil(target/bs) wrap discipline.
 
     Every rank returns its final-weights checksum and world size (proof
     the ranks formed one world and ended in sync); rank 0 additionally
@@ -123,25 +129,27 @@ def _torch_worker(spec: Dict[str, Any], model_bytes: bytes, x, y):
     ht.broadcast_optimizer_state(opt, root_rank=0)
 
     dtype = next(model.parameters()).dtype
-    xt = torch.as_tensor(np.asarray(x), dtype=dtype)
-    yt = torch.as_tensor(np.asarray(y))
-    if yt.is_floating_point():
-        # match the model's compute dtype (float64 numpy targets vs
-        # float32 models crash regression losses otherwise)
-        yt = yt.to(dtype)
-    n, bs = len(xt), spec["batch_size"]
+
+    def to_tensors(xb, yb):
+        xt = torch.as_tensor(np.asarray(xb), dtype=dtype)
+        yt = torch.as_tensor(np.asarray(yb))
+        if yt.is_floating_point():
+            # match the model's compute dtype (float64 numpy targets vs
+            # float32 models crash regression losses otherwise)
+            yt = yt.to(dtype)
+        return xt, yt
+
     history = []
     for epoch in range(spec["epochs"]):
         model.train()
-        perm = torch.randperm(n) if spec["shuffle"] else torch.arange(n)
         losses = []
-        for i in range(0, n, bs):
-            idx = perm[i:i + bs]
+        for xb, yb in epoch_batches(epoch):
+            xt, yt = to_tensors(xb, yb)
             opt.zero_grad()
-            loss = loss_fn(model(xt[idx]), yt[idx])
+            loss = loss_fn(model(xt), yt)
             loss.backward()
             opt.step()
-            losses.append(float(loss))
+            losses.append(float(loss.detach()))
         # epoch metric averaged across ranks (ref: MetricAverage)
         mean = float(np.asarray(hvd.allreduce(
             np.float32(np.mean(losses)), name=f"te_loss.{epoch}")))
@@ -156,6 +164,71 @@ def _torch_worker(spec: Dict[str, Any], model_bytes: bytes, x, y):
         out["state"] = buf.getvalue()
         out["history"] = history
     return out
+
+
+def _torch_worker(spec: Dict[str, Any], model_bytes: bytes, x, y):
+    """Executor worker (in-memory): train over permuted index batches."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    n, bs = len(x), spec["batch_size"]
+
+    def epoch_batches(epoch):
+        import torch
+
+        perm = (torch.randperm(n).numpy() if spec["shuffle"]
+                else np.arange(n))
+        for i in range(0, n, bs):
+            idx = perm[i:i + bs]
+            yield x[idx], y[idx]
+
+    return _torch_train(spec, model_bytes, epoch_batches)
+
+
+def _torch_stream_worker(spec: Dict[str, Any], meta: Dict[str, Any],
+                         model_bytes: bytes, row_iter):
+    """Barrier-task body for fit(df, cache='disk'): spill the partition
+    stream to Parquet row groups, exchange lengths over the rendezvous
+    KV, then train by streaming batches (same out-of-core discipline as
+    JaxEstimator's disk cache — orchestrate/spill.py)."""
+    import os
+    import shutil
+    import tempfile
+
+    from .estimator import kv_exchange_shard_lengths
+    from .spill import (spill_partition_to_parquet, spill_paths,
+                        stream_batches)
+
+    rank = int(os.environ.get("HVDT_RANK", "0"))
+    spill_dir = meta.get("spill_dir")
+    created = spill_dir is None
+    if created:
+        spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
+    prefix = f"rank{rank}"
+    try:
+        train_path, _val, n_train, _nv, cols = spill_partition_to_parquet(
+            row_iter, meta["label_col"], meta["feature_cols"], 0.0,
+            spill_dir, meta.get("rows_per_group", 4096), prefix=prefix)
+        target, min_len = kv_exchange_shard_lengths(n_train)
+        if min_len == 0:
+            raise ValueError(
+                "a worker contributed ZERO training rows (empty "
+                "partition) — use more rows or fewer workers")
+        bs = spec["batch_size"]
+
+        def epoch_batches(epoch):
+            return stream_batches(
+                train_path, meta["label_col"], cols, bs, target,
+                seed=spec["seed"] + 7919 * epoch + 101 * rank,
+                shuffle=spec["shuffle"])
+
+        return _torch_train(spec, model_bytes, epoch_batches)
+    finally:
+        if created:
+            shutil.rmtree(spill_dir, ignore_errors=True)
+        else:
+            for p in spill_paths(spill_dir, prefix):
+                if os.path.exists(p):
+                    os.remove(p)
 
 
 class TorchEstimator:
@@ -178,6 +251,9 @@ class TorchEstimator:
                  batch_size: int = 32, shuffle: bool = True, seed: int = 0,
                  label_col: str = "label", feature_cols=None,
                  output_col: str = "prediction",
+                 cache: str = "memory",
+                 rows_per_group: int = 4096,
+                 spill_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None):
         if model is None or optimizer is None or loss is None:
             raise ValueError("TorchEstimator requires model, optimizer "
@@ -188,6 +264,12 @@ class TorchEstimator:
         self._label_col = label_col
         self._feature_cols = feature_cols
         self._output_col = output_col
+        if cache not in ("memory", "disk"):
+            raise ValueError(
+                f"cache must be 'memory' or 'disk', got {cache!r}")
+        self._cache = cache
+        self._rows_per_group = int(rows_per_group)
+        self._spill_dir = spill_dir
         # Serialize the optimizer's full param-group structure by param
         # POSITION in model.parameters() order (ids differ per process).
         pos = {id(p): i for i, p in enumerate(model.parameters())}
@@ -261,13 +343,23 @@ class TorchEstimator:
         meta = {"label_col": self._label_col,
                 "feature_cols": (list(self._feature_cols)
                                  if self._feature_cols else None)}
+        stream = self._cache == "disk"
+        if stream:
+            # Out-of-core feed: spill the partition stream to Parquet row
+            # groups and train by streaming them back (orchestrate/spill).
+            meta["rows_per_group"] = self._rows_per_group
+            meta["spill_dir"] = self._spill_dir
 
-        def task(rows):
-            return _torch_df_worker(spec, meta, model_bytes, rows)
+            def task(rows):
+                return _torch_stream_worker(spec, meta, model_bytes, rows)
+        else:
+            def task(rows):
+                return _torch_df_worker(spec, meta, model_bytes, rows)
 
         results = spark_mod.run_on_dataframe(
             task, df, num_proc=self.num_workers,
-            env=collective_worker_env(self._env, local_coordinator=False))
+            env=collective_worker_env(self._env, local_coordinator=False),
+            stream=stream)
         out = results[0]
         if out is None or "state" not in out:
             raise RuntimeError("rank 0 returned no model state")

@@ -88,3 +88,53 @@ class TestTorchEstimator:
         # lr=0 group must not move; lr=0.05 group must train
         assert torch.allclose(out.model[0].weight, w0_frozen)
         assert not torch.allclose(out.model[1].weight, w1_before)
+
+
+@pytest.mark.integration
+def test_torch_fit_df_disk_cache(monkeypatch):
+    """cache='disk' trains through the spill->stream path with bounded
+    chunks (torch twin of JaxEstimator's out-of-core e2e).  Uses the
+    shared spark stub from tests/test_spark.py."""
+    import sys
+
+    import test_spark as stubmod
+
+    ctx = stubmod._StubContext(default_parallelism=1)
+    mod = __import__("types").ModuleType("pyspark")
+    mod.SparkContext = __import__("types").SimpleNamespace(
+        _active_spark_context=ctx)
+    mod.BarrierTaskContext = stubmod._BarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+
+    from horovod_tpu.orchestrate import TorchEstimator
+    from horovod_tpu.orchestrate import spill as spill_mod
+
+    cap = 16
+    orig = spill_mod._rows_chunk_to_table
+    chunks = []
+
+    def capped(rows, label_col, feature_cols):
+        chunks.append(len(rows))
+        assert len(rows) <= cap
+        return orig(rows, label_col, feature_cols)
+
+    monkeypatch.setattr(spill_mod, "_rows_chunk_to_table", capped)
+
+    rows = [{"x": float(i % 7), "label": 2.0 * (i % 7)} for i in range(96)]
+    df = stubmod._StubDataFrame(rows, ["x", "label"], ctx)
+
+    torch.manual_seed(5)
+    model = torch.nn.Linear(1, 1, bias=False)
+    opt = torch.optim.SGD(model.parameters(), lr=0.02)
+
+    def loss(pred, y):
+        return torch.nn.functional.mse_loss(pred[:, 0], y)
+
+    est = TorchEstimator(model=model, optimizer=opt, loss=loss,
+                         num_workers=1, epochs=8, batch_size=16,
+                         cache="disk", rows_per_group=cap)
+    out = est.fit(df.repartition(1))
+    assert len(chunks) >= 96 // cap
+    assert est.history_[-1]["loss"] < est.history_[0]["loss"]
+    pred = out.predict(np.asarray([[2.0]], np.float32))
+    assert abs(float(pred[0, 0]) - 4.0) < 1.0

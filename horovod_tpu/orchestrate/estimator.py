@@ -147,11 +147,14 @@ def _split_pad_discipline(x, y, validation_split: float, exchange):
     return x_train, y_train, x_val, y_val
 
 
-def kv_exchange_shard_lengths(n_rows: int, timeout: Optional[float] = None):
+def kv_exchange_shard_lengths(n_rows: int, timeout: Optional[float] = None,
+                              key: str = "/dfshard/len"):
     """Cross-rank (max, min) of per-rank row counts over the rendezvous
     KV — the lockstep-padding handshake for barrier-task training paths
     that have not (yet) formed an hvd world.  Requires the launcher env
-    contract (HVDT_RANK/SIZE + rendezvous address) in os.environ."""
+    contract (HVDT_RANK/SIZE + rendezvous address) in os.environ.
+    Callers exchanging MORE than one quantity per run must use distinct
+    ``key`` namespaces (per-rank keys are overwritten, not versioned)."""
     import os
 
     from ..runner.http_kv import KVClient
@@ -161,9 +164,9 @@ def kv_exchange_shard_lengths(n_rows: int, timeout: Optional[float] = None):
     rank = int(os.environ["HVDT_RANK"])
     size = int(os.environ["HVDT_SIZE"])
     kv = KVClient.from_env(os.environ)
-    kv.put(f"/dfshard/len/{rank}", str(int(n_rows)).encode())
+    kv.put(f"{key}/{rank}", str(int(n_rows)).encode())
     # KVClient.wait raises TimeoutError itself when a peer never posts.
-    lens = [int(kv.wait(f"/dfshard/len/{r}", timeout=timeout))
+    lens = [int(kv.wait(f"{key}/{r}", timeout=timeout))
             for r in range(size)]
     return max(lens), min(lens)
 
@@ -309,33 +312,23 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             # this barrier task's ROW ITERATOR.  Spill it to Parquet in
             # bounded chunks, exchange lengths, then stream row groups
             # batch-wise each epoch — the partition is never materialized.
-            import tempfile
-
-            from .spill import spill_partition_to_parquet, spill_paths
+            from .spill import (ZERO_TRAIN_ROWS_MSG,
+                                spill_partition_to_parquet, spill_scratch)
 
             meta = spec["spark_df_stream"]
-            spill_dir = meta.get("spill_dir")
-            spill_created = spill_dir is None
-            if spill_created:
-                spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
-            # Cleanup target is known BEFORE the spill runs (the writer's
-            # path naming is deterministic), so a mid-spill failure still
-            # removes whatever row groups were already written.
-            spill_cleanup = (spill_dir if spill_created
-                             else list(spill_paths(spill_dir,
-                                                   f"rank{rank}")))
+            # Cleanup callable is armed BEFORE the spill runs, so a
+            # mid-spill failure still removes whatever row groups were
+            # already written.
+            spill_dir, sp_prefix, spill_cleanup = spill_scratch(
+                meta.get("spill_dir"), rank)
             train_path, val_path, n_train, n_val, feat_cols = \
                 spill_partition_to_parquet(
                     x_train, meta["label_col"], meta["feature_cols"],
                     spec["validation_split"], spill_dir,
-                    meta.get("rows_per_group", 4096), prefix=f"rank{rank}")
+                    meta.get("rows_per_group", 4096), prefix=sp_prefix)
             target, min_len = _hvd_exchange_lengths(hvd, n_train)
             if min_len == 0:
-                raise ValueError(
-                    "a worker contributed ZERO training rows (empty "
-                    "partition, or only validation rows after the split) — "
-                    "use more rows per partition, fewer workers, or a "
-                    "smaller validation_split")
+                raise ValueError(ZERO_TRAIN_ROWS_MSG)
             # Validation must be all-or-none across ranks (the est_metric/val
             # allreduce below is collective).  The per-chunk split can give a
             # rank zero val rows (partition an exact multiple of
@@ -472,14 +465,7 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
         # Spilled Parquet is per-fit scratch: reused executor
         # processes must not accumulate dataset-sized files.
         if spill_cleanup is not None:
-            import shutil
-
-            if isinstance(spill_cleanup, str):
-                shutil.rmtree(spill_cleanup, ignore_errors=True)
-            else:
-                for p in spill_cleanup:
-                    if p and os.path.exists(p):
-                        os.remove(p)
+            spill_cleanup()
 
 
 class JaxEstimator:

@@ -164,6 +164,9 @@ class KerasEstimator:
                  label_col: str = "label",
                  feature_cols=None,
                  output_col: str = "prediction",
+                 cache: str = "memory",
+                 rows_per_group: int = 4096,
+                 spill_dir: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None):
         if model is None:
             raise ValueError("KerasEstimator requires a compiled model")
@@ -178,6 +181,12 @@ class KerasEstimator:
         self._label_col = label_col
         self._feature_cols = feature_cols
         self._output_col = output_col
+        if cache not in ("memory", "disk"):
+            raise ValueError(
+                f"cache must be 'memory' or 'disk', got {cache!r}")
+        self._cache = cache
+        self._rows_per_group = int(rows_per_group)
+        self._spill_dir = spill_dir
         self._spec = {"epochs": int(epochs), "batch_size": int(batch_size),
                       "shuffle": bool(shuffle),
                       "validation_split": float(validation_split),
@@ -236,12 +245,24 @@ class KerasEstimator:
                 "feature_cols": (list(self._feature_cols)
                                  if self._feature_cols else None)}
 
-        def task(rows):
-            return _keras_df_worker(spec, meta, model_bytes, rows)
+        stream = self._cache == "disk"
+        if stream:
+            # Out-of-core feed: spill the partition stream to Parquet row
+            # groups and train model.fit over a streamed generator
+            # (orchestrate/spill.py).
+            meta["rows_per_group"] = self._rows_per_group
+            meta["spill_dir"] = self._spill_dir
+
+            def task(rows):
+                return _keras_stream_worker(spec, meta, model_bytes, rows)
+        else:
+            def task(rows):
+                return _keras_df_worker(spec, meta, model_bytes, rows)
 
         results = spark_mod.run_on_dataframe(
             task, df, num_proc=self.num_workers,
-            env=collective_worker_env(self._env, local_coordinator=False))
+            env=collective_worker_env(self._env, local_coordinator=False),
+            stream=stream)
         out = results[0]
         if out is None or "model" not in out:
             raise RuntimeError("rank 0 returned no model")
@@ -263,3 +284,83 @@ def _keras_df_worker(spec, meta, model_bytes, rows):
                                      meta["feature_cols"],
                                      spec["validation_split"])
     return _keras_worker(spec, model_bytes, x, y, xv, yv)
+
+
+def _keras_stream_worker(spec, meta, model_bytes, row_iter):
+    """Barrier-task body for fit(df, cache='disk'): spill the partition
+    stream to Parquet row groups (honoring validation_split per chunk),
+    exchange lengths over the rendezvous KV, then drive ``model.fit``
+    with streamed batch generators (``steps_per_epoch`` fixed by the
+    exchanged cross-rank max so every rank runs the same lockstep batch
+    count — the keras twin of the Jax/Torch disk caches).  Validation is
+    all-or-none across ranks (a rank with zero val rows would desync
+    MetricAverageCallback's val-metric allreduce); its last streamed
+    batch wrap-pads, biasing val metrics by at most (bs-1)/n_val."""
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from ..interop import tf as htf
+    from .estimator import kv_exchange_shard_lengths
+    from .spill import (ZERO_TRAIN_ROWS_MSG, spill_partition_to_parquet,
+                        spill_scratch, stream_batches)
+
+    rank = int(os.environ.get("HVDT_RANK", "0"))
+    spill_dir, prefix, cleanup = spill_scratch(meta.get("spill_dir"), rank)
+    try:
+        train_path, val_path, n_train, n_val, cols = \
+            spill_partition_to_parquet(
+                row_iter, meta["label_col"], meta["feature_cols"],
+                spec["validation_split"], spill_dir,
+                meta.get("rows_per_group", 4096), prefix=prefix)
+        target, min_len = kv_exchange_shard_lengths(n_train)
+        if min_len == 0:
+            raise ValueError(ZERO_TRAIN_ROWS_MSG)
+        _, min_val = kv_exchange_shard_lengths(n_val, key="/dfshard/val")
+
+        if not hvd.is_initialized():
+            hvd.init()
+        model = _model_from_bytes(model_bytes, distributed=True,
+                                  custom_objects=spec["custom_objects"])
+        callbacks = [htf.BroadcastGlobalVariablesCallback(0),
+                     htf.MetricAverageCallback()]
+        if spec["store"] and hvd.rank() == 0:
+            import keras
+
+            os.makedirs(spec["store"], exist_ok=True)
+            callbacks.append(keras.callbacks.ModelCheckpoint(
+                os.path.join(spec["store"], "checkpoint.keras")))
+        bs = spec["batch_size"]
+        steps = -(-target // bs)
+
+        def endless(path, tgt, shuffle):
+            epoch = 0
+            while True:            # keras draws a fixed count per epoch
+                for xb, yb in stream_batches(
+                        path, meta["label_col"], cols, bs, tgt,
+                        seed=7919 * epoch + 101 * rank, shuffle=shuffle):
+                    yield np.asarray(xb), np.asarray(yb)
+                epoch += 1
+
+        val_kwargs = {}
+        if val_path is not None and min_val > 0:
+            val_kwargs = {
+                "validation_data": endless(val_path, n_val, False),
+                "validation_steps": -(-n_val // bs)}
+        hist = model.fit(endless(train_path, target, spec["shuffle"]),
+                         epochs=spec["epochs"], steps_per_epoch=steps,
+                         verbose=0, callbacks=callbacks, **val_kwargs)
+        out = {"size": hvd.size(),
+               "checksum": float(sum(
+                   float(np.sum(np.asarray(v, np.float64)))
+                   for v in model.weights))}
+        if hvd.rank() == 0:
+            out["model"] = _model_to_bytes(model)
+            out["history"] = [
+                dict(zip(hist.history, [float(v[i]) for v in
+                                        hist.history.values()]))
+                for i in range(len(next(iter(hist.history.values()), [])))]
+        return out
+    finally:
+        cleanup()

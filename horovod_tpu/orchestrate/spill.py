@@ -21,8 +21,15 @@ from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["spill_partition_to_parquet", "spill_paths", "stream_batches",
-           "read_xy"]
+__all__ = ["spill_partition_to_parquet", "spill_paths", "spill_scratch",
+           "stream_batches", "read_xy", "ZERO_TRAIN_ROWS_MSG"]
+
+# Shared by every disk-cache worker's min-length check (the exchange
+# mechanism differs — KV pre-init vs hvd allreduce — the contract not).
+ZERO_TRAIN_ROWS_MSG = (
+    "a worker contributed ZERO training rows (empty partition, or only "
+    "validation rows after the split) — use more rows per partition, "
+    "fewer workers, or a smaller validation_split")
 
 
 def spill_paths(spill_dir: str, prefix: str) -> Tuple[str, str]:
@@ -31,6 +38,30 @@ def spill_paths(spill_dir: str, prefix: str) -> Tuple[str, str]:
     computes paths through here, never by hand."""
     return (os.path.join(spill_dir, f"{prefix}_train.parquet"),
             os.path.join(spill_dir, f"{prefix}_val.parquet"))
+
+
+def spill_scratch(spill_dir: Optional[str], rank: int):
+    """Scratch-dir scaffold shared by every disk-cache worker: resolve
+    the directory (mkdtemp when the caller gave none), the per-rank file
+    prefix, and a cleanup callable that removes exactly what this rank's
+    spill created (whole tempdir when we made it; just this rank's files
+    in a user-provided dir).  Returns (spill_dir, prefix, cleanup)."""
+    import shutil
+
+    created = spill_dir is None
+    if created:
+        spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
+    prefix = f"rank{rank}"
+
+    def cleanup():
+        if created:
+            shutil.rmtree(spill_dir, ignore_errors=True)
+        else:
+            for p in spill_paths(spill_dir, prefix):
+                if os.path.exists(p):
+                    os.remove(p)
+
+    return spill_dir, prefix, cleanup
 
 
 def _rows_chunk_to_table(rows, label_col: str, feature_cols):

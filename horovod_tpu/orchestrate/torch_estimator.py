@@ -191,28 +191,20 @@ def _torch_stream_worker(spec: Dict[str, Any], meta: Dict[str, Any],
     KV, then train by streaming batches (same out-of-core discipline as
     JaxEstimator's disk cache — orchestrate/spill.py)."""
     import os
-    import shutil
-    import tempfile
 
     from .estimator import kv_exchange_shard_lengths
-    from .spill import (spill_partition_to_parquet, spill_paths,
-                        stream_batches)
+    from .spill import (ZERO_TRAIN_ROWS_MSG, spill_partition_to_parquet,
+                        spill_scratch, stream_batches)
 
     rank = int(os.environ.get("HVDT_RANK", "0"))
-    spill_dir = meta.get("spill_dir")
-    created = spill_dir is None
-    if created:
-        spill_dir = tempfile.mkdtemp(prefix="hvdt_spill_")
-    prefix = f"rank{rank}"
+    spill_dir, prefix, cleanup = spill_scratch(meta.get("spill_dir"), rank)
     try:
         train_path, _val, n_train, _nv, cols = spill_partition_to_parquet(
             row_iter, meta["label_col"], meta["feature_cols"], 0.0,
             spill_dir, meta.get("rows_per_group", 4096), prefix=prefix)
         target, min_len = kv_exchange_shard_lengths(n_train)
         if min_len == 0:
-            raise ValueError(
-                "a worker contributed ZERO training rows (empty "
-                "partition) — use more rows or fewer workers")
+            raise ValueError(ZERO_TRAIN_ROWS_MSG)
         bs = spec["batch_size"]
 
         def epoch_batches(epoch):
@@ -223,12 +215,7 @@ def _torch_stream_worker(spec: Dict[str, Any], meta: Dict[str, Any],
 
         return _torch_train(spec, model_bytes, epoch_batches)
     finally:
-        if created:
-            shutil.rmtree(spill_dir, ignore_errors=True)
-        else:
-            for p in spill_paths(spill_dir, prefix):
-                if os.path.exists(p):
-                    os.remove(p)
+        cleanup()
 
 
 class TorchEstimator:

@@ -100,3 +100,86 @@ class TestKerasEstimator:
         assert [r["size"] for r in res] == [2, 2]
         assert res[0]["checksum"] == pytest.approx(res[1]["checksum"],
                                                    abs=1e-8)
+
+
+@pytest.mark.integration
+def test_keras_fit_df_disk_cache(monkeypatch):
+    """cache='disk' trains model.fit over the spill->stream generator
+    with bounded chunks (keras twin of the Jax/Torch out-of-core e2e)."""
+    import sys
+    import types
+
+    import test_spark as stubmod
+
+    ctx = stubmod._StubContext(default_parallelism=1)
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = types.SimpleNamespace(_active_spark_context=ctx)
+    mod.BarrierTaskContext = stubmod._BarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+
+    from horovod_tpu.orchestrate import KerasEstimator
+    from horovod_tpu.orchestrate import spill as spill_mod
+
+    cap = 16
+    orig = spill_mod._rows_chunk_to_table
+    chunks = []
+
+    def capped(rows, label_col, feature_cols):
+        chunks.append(len(rows))
+        assert len(rows) <= cap
+        return orig(rows, label_col, feature_cols)
+
+    monkeypatch.setattr(spill_mod, "_rows_chunk_to_table", capped)
+
+    rows = [{"x": float(i % 7) / 7.0, "label": 2.0 * (i % 7) / 7.0}
+            for i in range(96)]
+    df = stubmod._StubDataFrame(rows, ["x", "label"], ctx)
+
+    keras.utils.set_random_seed(3)
+    m = keras.Sequential([keras.layers.Input((1,)),
+                          keras.layers.Dense(1, use_bias=False)])
+    m.compile(optimizer=keras.optimizers.SGD(learning_rate=0.5),
+              loss="mse")
+    est = KerasEstimator(model=m, num_workers=1, epochs=6, batch_size=16,
+                         cache="disk", rows_per_group=cap)
+    out = est.fit(df.repartition(1))
+    assert len(chunks) >= 96 // cap
+    assert est.history_[-1]["loss"] < est.history_[0]["loss"]
+    pred = out.predict(np.asarray([[0.5]], np.float32))
+    assert abs(float(pred[0, 0]) - 1.0) < 0.4
+
+
+@pytest.mark.integration
+def test_keras_disk_cache_validation_and_store(monkeypatch, tmp_path):
+    """Disk mode honors validation_split (val_loss in history) and the
+    store= rank-0 checkpoint — parity with the in-memory path."""
+    import sys
+    import types
+
+    import test_spark as stubmod
+
+    ctx = stubmod._StubContext(default_parallelism=1)
+    mod = types.ModuleType("pyspark")
+    mod.SparkContext = types.SimpleNamespace(_active_spark_context=ctx)
+    mod.BarrierTaskContext = stubmod._BarrierTaskContext
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+
+    from horovod_tpu.orchestrate import KerasEstimator
+
+    rows = [{"x": float(i % 9) / 9.0, "label": 2.0 * (i % 9) / 9.0}
+            for i in range(64)]
+    df = stubmod._StubDataFrame(rows, ["x", "label"], ctx)
+
+    keras.utils.set_random_seed(4)
+    m = keras.Sequential([keras.layers.Input((1,)),
+                          keras.layers.Dense(1, use_bias=False)])
+    m.compile(optimizer=keras.optimizers.SGD(learning_rate=0.5),
+              loss="mse")
+    store = str(tmp_path / "store")
+    est = KerasEstimator(model=m, num_workers=1, epochs=3, batch_size=16,
+                         validation_split=0.25, store=store,
+                         cache="disk", rows_per_group=16)
+    est.fit(df.repartition(1))
+    assert "val_loss" in est.history_[-1]
+    import os
+    assert os.path.exists(os.path.join(store, "checkpoint.keras"))

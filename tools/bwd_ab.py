@@ -68,12 +68,31 @@ def main():
 
     # correctness gate before timing: a numerically wrong kernel must
     # not publish a speedup that could flip the HVDT_FLASH_BWD default.
-    r1, r2 = xla_bwd(q, k, v, do), pallas_bwd(q, k, v, do)
-    rel = max(
-        float(np.abs(np.asarray(a, np.float32)
-                     - np.asarray(bb, np.float32)).max()
-              / (np.abs(np.asarray(a, np.float32)).max() or 1.0))
-        for a, bb in zip(r1, r2))
+    # The diff reduces ON DEVICE — fetching the full gradient tensors to
+    # the host (GBs at these shapes) takes longer than the tunnelled
+    # chip's 900 s A/B budget.  It takes the ALREADY-COMPUTED gradients,
+    # so neither backward is compiled or executed a second time.
+    @jax.jit
+    def rel_diff(r1, r2):
+        rels = [jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max()
+                / jnp.maximum(jnp.abs(a.astype(jnp.float32)).max(), 1e-9)
+                for a, b in zip(r1, r2)]
+        return jnp.stack(rels).max()
+
+    def stage(msg):
+        print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+              flush=True)
+
+    stage("compiling+running xla_bwd")
+    rx = xla_bwd(q, k, v, do)
+    stage("xla_bwd dispatched; fetching")
+    fetch0 = float(jnp.asarray(rx[0]).ravel()[0].astype(jnp.float32))
+    stage(f"xla_bwd done ({fetch0:.3g}); compiling+running pallas_bwd")
+    rp = pallas_bwd(q, k, v, do)
+    fetch1 = float(jnp.asarray(rp[0]).ravel()[0].astype(jnp.float32))
+    stage(f"pallas_bwd done ({fetch1:.3g}); computing on-device diff")
+    rel = float(rel_diff(list(rx), list(rp)))
+    stage(f"rel diff {rel:.3g}")
     correct = rel < 5e-2       # bf16 inputs, f32 accumulation
     t_x = bench(xla_bwd)
     t_p = bench(pallas_bwd) if correct else None

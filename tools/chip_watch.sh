@@ -1,0 +1,18 @@
+#!/bin/bash
+# Wait for the tunnelled TPU to answer a real matmul, then run the A/B
+# queue once.  The probe is a separate bounded subprocess because a down
+# tunnel hangs jax.devices() indefinitely (measured round 3 + round 4).
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  if timeout 60 python -c "
+import jax, jax.numpy as jnp
+jax.devices()
+float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())
+" >/dev/null 2>&1; then
+    echo "chip up at $(date -u +%FT%TZ)"
+    break
+  fi
+  echo "chip down at $(date -u +%FT%TZ); retry in 180s"
+  sleep 180
+done
+exec python tools/tpu_ab.py "$@"

@@ -66,6 +66,13 @@ def aggregate(xplane_path: str, device_substr: str = "TPU"):
             if dev is None or len(p.lines) > len(dev.lines):
                 dev = p
     if dev is None:
+        # CPU-sim fallback: jax's CPU profiler puts XLA op events on the
+        # '/host:CPU' plane (there is no separate device plane).
+        for p in planes:
+            if p.name == "/host:CPU":
+                dev = p
+                break
+    if dev is None:
         raise RuntimeError(
             f"no device plane matching {device_substr!r}; planes: "
             f"{[p.name for p in planes]}")

@@ -153,13 +153,18 @@ def _run_child(args) -> None:
         cost = compiled.cost_analysis()
     except Exception:
         cost = {}
+    # XLA cost analysis counts a while/fori_loop BODY ONCE (trip count is
+    # not multiplied), so the N-steps-per-call program reports ~one step's
+    # flops/bytes already — do NOT divide by steps_per_call (measured:
+    # dividing made the probe's MFU exactly 10x low at
+    # --steps-per-call 10, tools/ab_results.json resnet_steps_per_call10).
     try:
-        flops_per_step = float(cost["flops"]) / args.steps_per_call
+        flops_per_step = float(cost["flops"])
     except (KeyError, TypeError, ValueError):
         # Analytic fallback: ~3x forward FLOPs for training ResNet-50.
         flops_per_step = 3 * 4.1e9 * args.batch_size
     try:
-        bytes_per_step = float(cost["bytes accessed"]) / args.steps_per_call
+        bytes_per_step = float(cost["bytes accessed"])
     except (KeyError, TypeError, ValueError):
         bytes_per_step = None
 

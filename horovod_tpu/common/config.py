@@ -89,6 +89,17 @@ KNOBS: Dict[str, Knob] = {
         # --- kernels ---
         _k("HVDT_FLASH_ATTENTION", "auto", str,
            "Pallas flash-attention kernel: auto (TPU only), on, off."),
+        _k("HVDT_FLASH_SMALLSEQ", "auto", str,
+           "Head-batched single-block attention kernel "
+           "(flash_attention_smallseq) for short sequences: auto "
+           "(TPU, seq <= 1024, enough batch*heads to fill the grid), "
+           "on, off.  HVDT_FLASH_ATTENTION=off overrides to off; "
+           "HVDT_FLASH_ATTENTION=on forces the streaming kernel "
+           "instead (A/B semantics)."),
+        _k("HVDT_FLASH_SMALLSEQ_HB", 8, int,
+           "heads_per_block for the smallseq attention kernel (clamped "
+           "to divide the head count; tuning knob for the grid-overhead "
+           "vs VMEM trade)."),
         _k("HVDT_FLASH_BWD", "xla", str,
            "flash_attention backward: xla (blockwise XLA recompute) or "
            "kernel (Pallas flash_grad_block passes). Read at TRACE time "

@@ -194,9 +194,7 @@ def _attention(p, x, positions, cfg: TransformerConfig):
     elif flash_plan == "direct":
         # Pallas fused attention on TPU: O(L·D) HBM traffic instead of a
         # materialized [B,H,L,L] score matrix (ops/pallas_kernels.py).
-        from ..ops.pallas_kernels import flash_attention
-
-        o = flash_attention(q, k, v, causal=True)
+        o = _flash_fn(l, dh, batch=b, heads=h)(q, k, v)
     elif flash_plan is not None:
         # GSPMD-auto mesh: Mosaic kernels can't be auto-partitioned, so
         # open a manual shard_map island over the batch (dp/fsdp) and
@@ -207,13 +205,14 @@ def _attention(p, x, positions, cfg: TransformerConfig):
         # inside the compiled program").
         from jax.sharding import PartitionSpec as P
 
-        from ..ops.pallas_kernels import flash_attention
-
         dp_axes, tp_ax, names = flash_plan
+        dp_size, tp_size = _island_local_sizes(
+            jax.sharding.get_abstract_mesh(), dp_axes, tp_ax)
+        fn = _flash_fn(l, dh, batch=max(1, b // dp_size),
+                       heads=max(1, h // tp_size))
         spec = P(dp_axes if dp_axes else None, None, tp_ax, None)
         o = jax.shard_map(
-            functools.partial(flash_attention, causal=True),
-            in_specs=(spec, spec, spec), out_specs=spec,
+            fn, in_specs=(spec, spec, spec), out_specs=spec,
             axis_names=names)(q, k, v)
     else:
         scale = dh ** -0.5
@@ -257,6 +256,63 @@ def _flash_enabled(seq_len: int, head_dim: int, *, batch: int = 1,
             and jax.devices()[0].platform == "tpu")
 
 
+def _island_local_sizes(am, dp_axes, tp_ax) -> Tuple[int, int]:
+    """(dp_size, tp_size) of an island plan under abstract mesh ``am`` —
+    the ONE place this arithmetic lives: _flash_plan gates on the local
+    shapes it implies and _attention picks the kernel with the same
+    numbers, so they cannot diverge."""
+    dp_size = (int(np.prod([am.shape[a] for a in dp_axes]))
+               if dp_axes else 1)
+    tp_size = am.shape[tp_ax] if tp_ax else 1
+    return dp_size, tp_size
+
+
+def _smallseq_enabled(seq_len: int, head_dim: int, *, batch: int,
+                      heads: int) -> bool:
+    """Head-batched single-block kernel policy: HVDT_FLASH_SMALLSEQ.
+
+    The complement of :func:`_flash_enabled`'s capacity play — the
+    streaming kernel's per-grid-step overhead is ruinous at short
+    sequence / large batch*heads (measured 3x WORSE than XLA end-to-end
+    at BERT-Large bs128 seq512, tools/ab_results.json
+    lm_flash_kernelbwd_bs128), while the profiled XLA path spends
+    ~30% of the step materializing scores there.  'auto' engages
+    flash_attention_smallseq on TPU when the whole sequence fits one
+    VMEM block and there are enough (batch x head) programs to fill the
+    grid.  ``batch``/``heads`` are LOCAL (per-shard) sizes."""
+    from ..common import config
+
+    mode = config.get_str("HVDT_FLASH_SMALLSEQ").lower()
+    if mode == "off":
+        return False
+    shapes_ok = seq_len % 128 == 0 and seq_len <= 1024
+    if mode == "on":
+        return shapes_ok
+    return (shapes_ok and batch * heads >= 64
+            and jax.devices()[0].platform == "tpu")
+
+
+def _flash_fn(seq_len: int, head_dim: int, *, batch: int, heads: int):
+    """The attention kernel to use for these LOCAL shapes, or None for
+    XLA attention.  HVDT_FLASH_ATTENTION=off is the master off switch;
+    =on keeps its A/B meaning (force the STREAMING kernel)."""
+    from ..common import config
+    from ..ops.pallas_kernels import (flash_attention,
+                                      flash_attention_smallseq)
+
+    mode = config.get_str("HVDT_FLASH_ATTENTION").lower()
+    if mode == "off":
+        return None
+    if mode != "on" and _smallseq_enabled(seq_len, head_dim, batch=batch,
+                                          heads=heads):
+        return functools.partial(
+            flash_attention_smallseq, causal=True,
+            heads_per_block=config.get_int("HVDT_FLASH_SMALLSEQ_HB"))
+    if _flash_enabled(seq_len, head_dim, batch=batch, heads=heads):
+        return functools.partial(flash_attention, causal=True)
+    return None
+
+
 def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
     """Decide how the flash kernel can engage under the ambient mesh.
 
@@ -278,7 +334,8 @@ def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
     except Exception:       # pragma: no cover - very old jax
         auto, manual = [], []
     if not auto:
-        return "direct" if _flash_enabled(l, dh, batch=b, heads=h) else None
+        return ("direct"
+                if _flash_fn(l, dh, batch=b, heads=h) is not None else None)
     if manual:
         # Already inside a shard_map (e.g. the pp/sp/ep pipeline island)
         # with auto axes remaining: nesting another partial-manual island
@@ -290,13 +347,12 @@ def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
     # Shard batch over dp-like axes and heads over tp, where divisible.
     dp_axes: Tuple[str, ...] = tuple(a for a in ("dp", "fsdp")
                                      if a in auto)
-    while dp_axes and b % int(np.prod([am.shape[a] for a in dp_axes])):
+    while dp_axes and b % _island_local_sizes(am, dp_axes, None)[0]:
         dp_axes = dp_axes[:-1]
-    dp_size = int(np.prod([am.shape[a] for a in dp_axes])) if dp_axes else 1
     tp_ax = "tp" if "tp" in auto else None
     if tp_ax and (h % am.shape[tp_ax] or hk % am.shape[tp_ax]):
         tp_ax = None
-    tp_size = am.shape[tp_ax] if tp_ax else 1
+    dp_size, tp_size = _island_local_sizes(am, dp_axes, tp_ax)
     # Any OTHER size>1 auto axis (e.g. an auto axis sharding the
     # sequence) means the island's replicated in_specs would force a
     # full-sequence all-gather per layer — don't engage the kernel there.
@@ -305,8 +361,8 @@ def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
     leftover = [a for a in auto if a not in dp_axes and a != tp_ax]
     if any(am.shape[a] > 1 for a in leftover):
         return None
-    if not _flash_enabled(l, dh, batch=max(1, b // dp_size),
-                          heads=max(1, h // tp_size)):
+    if _flash_fn(l, dh, batch=max(1, b // dp_size),
+                 heads=max(1, h // tp_size)) is None:
         return None
     names = frozenset(dp_axes) | ({tp_ax} if tp_ax else set()) | \
         frozenset(leftover)

@@ -28,10 +28,10 @@ LM = [PY, os.path.join(REPO, "examples", "jax_transformer_lm.py"),
 TOKS = re.compile(r"(\d+) tokens/sec, ~([\d.]+) model TFLOP/s")
 
 
-def lm_leg(name, extra, steps="30", timeout=900):
+def lm_leg(name, extra, steps="30", timeout=900, env=None):
     return {"name": name,
             "cmd": LM + ["--steps", steps] + extra,
-            "timeout": timeout,
+            "timeout": timeout, "env": env,
             "parse": lambda out: (
                 {"tokens_per_sec": int(TOKS.search(out).group(1)),
                  "model_tflops": float(TOKS.search(out).group(2))}
@@ -79,13 +79,45 @@ LEGS = [
     # the round-2 49.5 TFLOP bs64 row predates both.
     lm_leg("lm_bs64_long", ["--batch", "64", "--steps", "120"],
            timeout=1200),
+    # Full-Pallas attention at the flagship shape: round-2 measured XLA
+    # attention ~1.5x faster than kernel-fwd + BLOCKWISE-XLA bwd at
+    # seq 512 — but the round-3 flash_grad_block kernel bwd was never in
+    # that comparison.  If kernel+kernel beats XLA end-to-end here, the
+    # auto gate's 4 GB threshold is wrong and the defaults flip.
+    lm_leg("lm_flash_kernelbwd_bs128", ["--batch", "128"],
+           env={"HVDT_FLASH_ATTENTION": "on", "HVDT_FLASH_BWD": "kernel"}),
+    lm_leg("lm_flash_xlabwd_bs128", ["--batch", "128"],
+           env={"HVDT_FLASH_ATTENTION": "on"}),
     # Flash backward kernel vs XLA blockwise (the knob-flip evidence).
+    json_leg("bwd_ab_seq2048",
+             [PY, os.path.join(REPO, "tools", "bwd_ab.py"),
+              "--seq", "2048", "--batch", "16"], timeout=1500),
     json_leg("bwd_ab_seq4096",
              [PY, os.path.join(REPO, "tools", "bwd_ab.py"),
               "--seq", "4096", "--batch", "8"], timeout=1500),
     json_leg("bwd_ab_seq8192",
              [PY, os.path.join(REPO, "tools", "bwd_ab.py"),
               "--seq", "8192", "--batch", "4"], timeout=1500),
+    # Head-batched single-block kernel (flash_attention_smallseq) at the
+    # flagship shape — the smallseq answer to the streaming kernel's 3x
+    # loss above.  Baseline to beat: 29,374 tok/s (lm_base_bs128_remat).
+    lm_leg("lm_smallseq_hb8_bs128", ["--batch", "128"],
+           env={"HVDT_FLASH_SMALLSEQ": "on"}),
+    lm_leg("lm_smallseq_hb16_bs128", ["--batch", "128"],
+           env={"HVDT_FLASH_SMALLSEQ": "on",
+                "HVDT_FLASH_SMALLSEQ_HB": "16"}),
+    lm_leg("lm_smallseq_hb4_bs128", ["--batch", "128"],
+           env={"HVDT_FLASH_SMALLSEQ": "on",
+                "HVDT_FLASH_SMALLSEQ_HB": "4"}),
+    # Ring attention per-step block primitives, Pallas vs jnp (the
+    # HVDT_RING_PALLAS evidence — sp>=2 can't run on one chip, but the
+    # ring cost is sp repetitions of exactly these two per-device ops).
+    json_leg("ring_ab_local2048",
+             [PY, os.path.join(REPO, "tools", "ring_ab.py"),
+              "--local-seqs", "2048", "--batch", "2"], timeout=1200),
+    json_leg("ring_ab_local8192",
+             [PY, os.path.join(REPO, "tools", "ring_ab.py"),
+              "--local-seqs", "8192", "--batch", "1"], timeout=1200),
     # ResNet dispatch-gap probe: N steps per jit call via lax.fori_loop
     # (larger batches were already measured WORSE in round 2 — activation
     # traffic scales with batch; docs/performance.md).
@@ -103,6 +135,8 @@ _LEG_SPECIFIC = ("RESOURCE_EXHAUSTED", "AllocateBuffer", "Allocation type",
 
 def run_leg(leg, env):
     t0 = time.time()
+    if leg.get("env"):
+        env = dict(env, **leg["env"])
     try:
         proc = subprocess.run(leg["cmd"], env=env, capture_output=True,
                               text=True, timeout=leg["timeout"], cwd=REPO)

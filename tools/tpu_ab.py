@@ -50,7 +50,8 @@ def json_leg(name, cmd, timeout=900):
     return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse}
 
 
-def raw_leg(name, cmd, timeout=900, keep=8000, marker="by category:"):
+def raw_leg(name, cmd, timeout=900, keep=8000, marker="by category:",
+            env=None):
     """Keep stdout from the report marker on (profile tables etc.).
     Success requires the marker — partial stdout before a crash must not
     record as ok."""
@@ -59,7 +60,8 @@ def raw_leg(name, cmd, timeout=900, keep=8000, marker="by category:"):
         if i < 0:
             return None
         return {"raw": out[i:i + keep]}
-    return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse}
+    return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse,
+            "env": env}
 
 
 LEGS = [
@@ -109,6 +111,22 @@ LEGS = [
     lm_leg("lm_smallseq_hb4_bs128", ["--batch", "128"],
            env={"HVDT_FLASH_SMALLSEQ": "on",
                 "HVDT_FLASH_SMALLSEQ_HB": "4"}),
+    # Where does the smallseq step go?  (Shows immediately whether the
+    # wrapper's [B,L,H,D]<->[B,H,L,D] transposes matter.)
+    raw_leg("lm_smallseq_profile_bs128",
+            LM + ["--batch", "128", "--steps", "10", "--profile"],
+            timeout=1200, env={"HVDT_FLASH_SMALLSEQ": "on"}),
+    # Chunked-xent scan granularity: 2 chunks of 16384 vs 4 of 8192 —
+    # fewer sequential scan steps vs a 4.3 GB live logits tile.
+    lm_leg("lm_chunk16384_bs128", ["--batch", "128",
+                                   "--loss-chunk", "16384"]),
+    # e2e confirmation of the bwd_ab seq-4096 kernel win (1.14x
+    # backward-only): long-context config, flash fwd auto-engaged
+    # (score bytes >= 4 GB), backward knob A/B.
+    lm_leg("lm_seq4096_fbwd_kernel", ["--batch", "16", "--seq", "4096"],
+           env={"HVDT_FLASH_BWD": "kernel"}, timeout=1200),
+    lm_leg("lm_seq4096_fbwd_xla", ["--batch", "16", "--seq", "4096"],
+           timeout=1200),
     # Ring attention per-step block primitives, Pallas vs jnp (the
     # HVDT_RING_PALLAS evidence — sp>=2 can't run on one chip, but the
     # ring cost is sp repetitions of exactly these two per-device ops).

@@ -91,9 +91,10 @@ KNOBS: Dict[str, Knob] = {
            "Pallas flash-attention kernel: auto (TPU only), on, off."),
         _k("HVDT_FLASH_SMALLSEQ", "auto", str,
            "Head-batched single-block attention kernel "
-           "(flash_attention_smallseq) for short sequences: auto "
-           "(TPU, seq <= 1024, enough batch*heads to fill the grid), "
-           "on, off.  HVDT_FLASH_ATTENTION=off overrides to off; "
+           "(flash_attention_smallseq) for short sequences (seq <= "
+           "1024): auto (currently DISENGAGED pending the TPU A/B — an "
+           "unmeasured kernel is not a default), on, off.  "
+           "HVDT_FLASH_ATTENTION=off overrides to off; "
            "HVDT_FLASH_ATTENTION=on forces the streaming kernel "
            "instead (A/B semantics)."),
         _k("HVDT_FLASH_SMALLSEQ_HB", 8, int,

@@ -288,8 +288,12 @@ def _smallseq_enabled(seq_len: int, head_dim: int, *, batch: int,
     shapes_ok = seq_len % 128 == 0 and seq_len <= 1024
     if mode == "on":
         return shapes_ok
-    return (shapes_ok and batch * heads >= 64
-            and jax.devices()[0].platform == "tpu")
+    # 'auto' does not engage yet: the kernel is correctness-proven (CPU
+    # interpret suite) but its TPU A/B (tools/tpu_ab.py lm_smallseq_*
+    # legs) hasn't run — an unmeasured kernel must not be a default
+    # (round-3 verdict discipline).  Flip to the measured threshold once
+    # the legs land.
+    return False
 
 
 def _flash_fn(seq_len: int, head_dim: int, *, batch: int, heads: int):

@@ -158,11 +158,23 @@ def _run_child(args) -> None:
     # flops/bytes already — do NOT divide by steps_per_call (measured:
     # dividing made the probe's MFU exactly 10x low at
     # --steps-per-call 10, tools/ab_results.json resnet_steps_per_call10).
+    # That body-counted-once behavior is undocumented XLA internals, so
+    # sanity-check it against the analytic step count (~3x forward FLOPs
+    # for training ResNet-50) instead of trusting it across versions: if a
+    # future XLA starts multiplying by trip count, the reported flops jump
+    # ~steps_per_call-fold and we rescale rather than inflate MFU.
+    analytic_flops = 3 * 4.1e9 * args.batch_size
     try:
         flops_per_step = float(cost["flops"])
+        if args.steps_per_call > 1 and flops_per_step > 2 * analytic_flops:
+            rescaled = flops_per_step / args.steps_per_call
+            if rescaled <= 2 * analytic_flops:
+                print(f"cost_analysis flops {flops_per_step:.3e} looks "
+                      f"trip-count-multiplied; using /steps_per_call = "
+                      f"{rescaled:.3e}", file=sys.stderr)
+                flops_per_step = rescaled
     except (KeyError, TypeError, ValueError):
-        # Analytic fallback: ~3x forward FLOPs for training ResNet-50.
-        flops_per_step = 3 * 4.1e9 * args.batch_size
+        flops_per_step = analytic_flops
     try:
         bytes_per_step = float(cost["bytes accessed"])
     except (KeyError, TypeError, ValueError):
@@ -360,23 +372,53 @@ def main() -> None:
                             int(os.environ.get("HVDT_BENCH_CPU_TIMEOUT",
                                                "600")), cpu_only=True)
     last_good = _load_last_good()
+    probe = None
     if ok and line:
-        d = json.loads(line)
-        d["error"] = "accelerator unavailable; CPU fallback — " + \
+        probe = json.loads(line)
+        probe["error"] = "accelerator unavailable; CPU fallback — " + \
             "; ".join(notes)
-        if last_good:
-            d["last_good"] = last_good
-        print(json.dumps(d))
+    else:
+        notes.append(f"cpu-fallback: {note}")
+
+    # Headline rule (VERDICT r4 weak #4): when a dated TPU measurement
+    # exists, the top-level value/vs_baseline are NEVER a CPU fallback or
+    # zero — the cached accelerator number is promoted to the headline,
+    # explicitly marked stale with its age, and the live probe (proof the
+    # harness itself still runs) is kept as a sub-record.
+    if last_good:
+        out = dict(last_good)
+        out["stale"] = True
+        try:
+            import calendar
+
+            # timegm, not mktime: measured_at is UTC; mktime would read
+            # the struct as LOCAL time and skew the age by the host's
+            # UTC offset (negative ages west of UTC).
+            age_s = time.time() - calendar.timegm(time.strptime(
+                last_good["measured_at"], "%Y-%m-%dT%H:%M:%SZ"))
+            out["age_hours"] = round(age_s / 3600.0, 1)
+        except (KeyError, ValueError, OverflowError):
+            out["age_hours"] = None
+        out["error"] = "accelerator unavailable; headline is the cached " \
+            "last-good TPU measurement — " + "; ".join(notes)[-1200:]
+        if probe:
+            out["fallback_probe"] = {
+                k: probe.get(k) for k in
+                ("metric", "value", "unit", "platform", "device_kind",
+                 "batch_size")}
+        print(json.dumps(out))
         return
 
-    notes.append(f"cpu-fallback: {note}")
+    if probe:
+        print(json.dumps(probe))
+        return
+
     # Phase 3: diagnostics-only JSON — still one parseable line.
     print(json.dumps({
         "metric": METRIC, "value": 0.0, "unit": UNIT, "vs_baseline": 0.0,
         "platform": None, "device_kind": None, "mfu": None,
         "hbm_util": None,
         "error": "; ".join(notes)[-1500:],
-        **({"last_good": last_good} if last_good else {}),
     }))
 
 

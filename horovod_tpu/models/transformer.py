@@ -267,6 +267,29 @@ def _island_local_sizes(am, dp_axes, tp_ax) -> Tuple[int, int]:
     return dp_size, tp_size
 
 
+# 'auto' engagement threshold for the smallseq kernel: minimum number of
+# (batch x head-block) grid programs.  None = auto disengaged: the kernel
+# is correctness-proven (CPU interpret suite) but its TPU A/B
+# (tools/tpu_ab.py lm_smallseq_* legs) hasn't run — an unmeasured kernel
+# must not be a default (round-3 verdict discipline).  Set to the
+# measured break-even once the legs land.
+_SMALLSEQ_AUTO_MIN_PROGRAMS: Optional[int] = None
+
+
+def _smallseq_vmem_ok(seq_len: int, head_dim: int, hb: int) -> bool:
+    """Whether one (batch, head-block) program's working set fits VMEM.
+
+    Models the BACKWARD kernel (the larger of the two): bf16 q/do/out +
+    k/v blocks, f32 dq/dk/dv outputs, plus one head's f32 probability
+    and d-score [L, L] scratch pair.  Budget 12 MiB of the ~16 MiB/core
+    so Mosaic keeps headroom for pipelining.  Assumes hb_kv == hb (no
+    GQA shrink) — conservative: GQA only makes the k/v blocks smaller."""
+    bf16_in = 5 * hb * seq_len * head_dim * 2
+    f32_out = 3 * hb * seq_len * head_dim * 4
+    scratch = 2 * seq_len * seq_len * 4
+    return bf16_in + f32_out + scratch <= 12 * 1024 ** 2
+
+
 def _smallseq_enabled(seq_len: int, head_dim: int, *, batch: int,
                       heads: int) -> bool:
     """Head-batched single-block kernel policy: HVDT_FLASH_SMALLSEQ.
@@ -278,8 +301,11 @@ def _smallseq_enabled(seq_len: int, head_dim: int, *, batch: int,
     lm_flash_kernelbwd_bs128), while the profiled XLA path spends
     ~30% of the step materializing scores there.  'auto' engages
     flash_attention_smallseq on TPU when the whole sequence fits one
-    VMEM block and there are enough (batch x head) programs to fill the
-    grid.  ``batch``/``heads`` are LOCAL (per-shard) sizes."""
+    VMEM block ('on' honors the same fit — a kernel that cannot lower is
+    never a valid choice) and there are at least
+    ``_SMALLSEQ_AUTO_MIN_PROGRAMS`` (batch x head-block) grid programs
+    to amortize per-program overhead.  ``batch``/``heads`` are LOCAL
+    (per-shard) sizes."""
     from ..common import config
 
     mode = config.get_str("HVDT_FLASH_SMALLSEQ").lower()
@@ -287,13 +313,19 @@ def _smallseq_enabled(seq_len: int, head_dim: int, *, batch: int,
         return False
     shapes_ok = seq_len % 128 == 0 and seq_len <= 1024
     if mode == "on":
+        # 'on' is the A/B force switch: it must select the kernel for
+        # every tiling shape, or a forced leg would silently measure the
+        # baseline path.  The VMEM estimate below is a MODEL — only
+        # 'auto' trusts it; a genuinely unlowerable block still fails
+        # loudly in the kernel's own _fit_block.
         return shapes_ok
-    # 'auto' does not engage yet: the kernel is correctness-proven (CPU
-    # interpret suite) but its TPU A/B (tools/tpu_ab.py lm_smallseq_*
-    # legs) hasn't run — an unmeasured kernel must not be a default
-    # (round-3 verdict discipline).  Flip to the measured threshold once
-    # the legs land.
-    return False
+    if _SMALLSEQ_AUTO_MIN_PROGRAMS is None:
+        return False
+    hb = min(config.get_int("HVDT_FLASH_SMALLSEQ_HB"), max(heads, 1))
+    programs = batch * max(heads, 1) // max(hb, 1)
+    return (shapes_ok and _smallseq_vmem_ok(seq_len, head_dim, hb)
+            and programs >= _SMALLSEQ_AUTO_MIN_PROGRAMS
+            and jax.devices()[0].platform == "tpu")
 
 
 def _flash_fn(seq_len: int, head_dim: int, *, batch: int, heads: int):

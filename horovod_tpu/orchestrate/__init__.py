@@ -13,12 +13,16 @@ from .executor import Executor
 from .ray_adapter import RayExecutor
 from .ray_elastic import ElasticRayExecutor, RayHostDiscovery
 from .estimator import JaxEstimator, JaxModel, ParquetSource
+from .ml_params import (MLParams, Pipeline, PipelineModel, load_ml,
+                        register_pyspark_stages)
 from . import spark  # noqa: F401  (pyspark itself is imported lazily)
 
 __all__ = ["Executor", "RayExecutor", "ElasticRayExecutor",
            "RayHostDiscovery", "JaxEstimator", "JaxModel", "ParquetSource",
            "KerasEstimator", "KerasModel", "TorchEstimator", "TorchModel",
-           "LightningEstimator", "LightningModel", "spark"]
+           "LightningEstimator", "LightningModel", "spark",
+           "MLParams", "Pipeline", "PipelineModel", "load_ml",
+           "register_pyspark_stages"]
 
 
 def __getattr__(name):

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .executor import Executor
+from .ml_params import MLParams
 
 __all__ = ["JaxEstimator", "JaxModel", "ParquetSource"]
 
@@ -50,9 +51,12 @@ class ParquetSource:
     feature_cols: Optional[Tuple[str, ...]] = None
 
 
-class JaxModel:
+class JaxModel(MLParams):
     """Trained model handle (ref: spark estimators return a Model whose
-    transform() runs the predict path)."""
+    transform() runs the predict path).  MLParams gives it the Spark-ML
+    Model persistence surface (``save``/``load``, ``write``/``read``)
+    and makes it a registered pyspark Transformer stage
+    (orchestrate/ml_params.py)."""
 
     def __init__(self, params: Any, predict_fn: Callable[[Any, np.ndarray],
                                                          np.ndarray],
@@ -468,8 +472,13 @@ def _declarative_fit(spec: Dict[str, Any], x_train, y_train, x_val, y_val):
             spill_cleanup()
 
 
-class JaxEstimator:
+class JaxEstimator(MLParams):
     """Data-parallel fit over an Executor pool.
+
+    MLParams (orchestrate/ml_params.py) adds the Spark-ML estimator
+    surface: camelCase param get/set (``setEpochs(3)``), ``copy``,
+    ``save``/``load`` persistence, and pyspark ``Pipeline`` stage
+    compatibility (ref: spark/common/params.py EstimatorParams).
 
     Args:
       train_fn: ``train_fn(x_shard, y_shard, **fit_kwargs) -> params`` —

@@ -21,8 +21,33 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .executor import Executor
+from .ml_params import MLParams
 
 __all__ = ["KerasEstimator", "KerasModel"]
+
+
+class _KerasMLStateMixin(MLParams):
+    """Shared persistence hook: a keras model param travels as ``.keras``
+    archive bytes (keras objects are not reliably picklable; the archive
+    also preserves compile state, which ``KerasEstimator.__init__``
+    re-validates on load)."""
+
+    def _ml_get_state(self):
+        state = super()._ml_get_state()
+        if state.get("model") is not None:
+            state["model"] = ("__keras_bytes__",
+                              _model_to_bytes(state["model"]))
+        return state
+
+    @classmethod
+    def _ml_from_state(cls, state):
+        m = state.get("model")
+        if isinstance(m, tuple) and len(m) == 2 and m[0] == "__keras_bytes__":
+            state = dict(state)
+            state["model"] = _model_from_bytes(
+                m[1], distributed=False,
+                custom_objects=state.get("custom_objects"))
+        return cls(**state)
 
 
 def _model_to_bytes(model) -> bytes:
@@ -49,9 +74,12 @@ def _model_from_bytes(data: bytes, distributed: bool,
                                        custom_objects=custom_objects)
 
 
-class KerasModel:
+class KerasModel(_KerasMLStateMixin):
     """Trained model handle (ref: spark/keras KerasModel — transform()
-    runs the predict path; the underlying keras model is exposed)."""
+    runs the predict path; the underlying keras model is exposed).
+    ``save(path)`` keeps its keras-archive meaning; the full-handle
+    Spark-ML persistence (history/df_meta included) is
+    ``write().save(dir)`` / ``KerasModel.load(dir)``."""
 
     def __init__(self, model, history: Optional[List[Dict]] = None,
                  df_meta: Optional[Dict] = None,
@@ -135,7 +163,7 @@ def _keras_worker(spec: Dict[str, Any], model_bytes: bytes, x, y, xv, yv):
     return out
 
 
-class KerasEstimator:
+class KerasEstimator(_KerasMLStateMixin):
     """Fit a compiled keras model data-parallel over worker processes
     (ref: spark/keras/estimator.py:KerasEstimator — the model/optimizer/
     loss travel via keras serialization; ``num_workers`` is the

@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .ml_params import MLParams
 from .estimator import (check_one_world, collective_worker_env,
                         df_transform, split_and_shard)
 from .executor import Executor
@@ -38,9 +39,12 @@ from .executor import Executor
 __all__ = ["LightningEstimator", "LightningModel"]
 
 
-class LightningModel:
+class LightningModel(MLParams):
     """Trained model handle (ref: spark/lightning LightningModel —
-    transform() runs the module's forward; the module is exposed)."""
+    transform() runs the module's forward; the module is exposed).
+    ``save(path)`` keeps its torch.save meaning; the full-handle
+    Spark-ML persistence is ``write().save(dir)`` /
+    ``LightningModel.load(dir)`` (orchestrate/ml_params.py)."""
 
     def __init__(self, model, history: Optional[List[Dict]] = None,
                  df_meta: Optional[Dict] = None):
@@ -170,7 +174,7 @@ def _lightning_worker(spec: Dict[str, Any], model_bytes: bytes,
     return out
 
 
-class LightningEstimator:
+class LightningEstimator(MLParams):
     """Fit a LightningModule-protocol model data-parallel over worker
     processes (ref: spark/lightning/estimator.py LightningEstimator —
     ``num_workers`` is the reference's ``num_proc``; model/loss/optimizer

@@ -1,0 +1,404 @@
+"""Spark-ML Params surface, persistence, and Pipeline compatibility.
+
+Re-conception of ref: spark/common/params.py (EstimatorParams — a
+pyspark ``Params`` subclass declaring one ``Param`` plus a set/get pair
+per knob) and the per-framework ParamsWriter/Reader persistence
+(spark/lightning/estimator.py:67-99, spark/torch/estimator.py
+TorchEstimatorParamsWritable/Readable).  Three capabilities:
+
+* **Params surface** — every estimator/model exposes
+  ``getOrDefault``/``setParams``/``copy``/``explainParams`` plus the
+  camelCase ``setEpochs()``/``getEpochs()`` pairs of the reference.
+  TPU-native difference: the constructor signature IS the param
+  registry.  Params, defaults, and the set/get surface are derived from
+  ``__init__`` by introspection, so there is exactly one source of
+  truth and the Params layer cannot drift from the constructor (the
+  reference maintains the dummy-parent ``Param`` table and the
+  constructor defaults as two parallel lists).  ``_set`` re-runs
+  ``__init__`` with the merged kwargs, so constructor validation and
+  derived state always apply.
+
+* **Persistence** — ``est.save(dir)`` / ``Est.load(dir)`` (and the
+  pyspark-style ``write().save`` / ``read().load`` spellings) round-trip
+  estimators AND trained model handles: a human-readable
+  ``metadata.json`` (class + JSON-able params) next to a ``state.pkl``
+  cloudpickle of the full param map.  One blob, not per-param blobs,
+  so object identity inside the map survives (a torch optimizer's
+  references INTO ``model.parameters()`` stay intact — per-param
+  serialization, the reference's scheme, silently severs them).
+  Framework-specific payloads hook ``_ml_get_state``/``_ml_from_state``
+  (keras models travel as ``.keras`` archive bytes).  Like the
+  reference's codec layer this is pickle-based: only load artifacts you
+  trust.
+
+* **Pipeline compatibility** — pyspark's ``Pipeline`` hard-gates stages
+  on ``isinstance(stage, Estimator/Transformer)``; the reference
+  satisfies it by inheriting pyspark bases.  Here
+  :func:`register_pyspark_stages` registers the framework classes as
+  ABC *virtual subclasses* of ``pyspark.ml.base`` — a real
+  ``pyspark.ml.Pipeline([...]).fit(df)`` accepts them with pyspark
+  fully absent from this package's import graph.  A native
+  :class:`Pipeline`/:class:`PipelineModel` pair provides the same
+  chaining without any pyspark at all.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Param", "MLParams", "Pipeline", "PipelineModel", "load",
+           "load_ml", "register_pyspark_stages"]
+
+_CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
+_METADATA = "metadata.json"
+_STATE = "state.pkl"
+
+
+def _snake(name: str) -> str:
+    return _CAMEL_RE.sub("_", name).lower()
+
+
+class Param:
+    """A named parameter handle (ref: pyspark.ml.param.Param — here a
+    lightweight view over a constructor argument)."""
+
+    __slots__ = ("parent", "name", "doc")
+
+    def __init__(self, name: str, doc: str = "", parent: str = ""):
+        self.name = name
+        self.doc = doc
+        self.parent = parent
+
+    def __repr__(self) -> str:
+        return f"Param({self.parent}.{self.name})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Param) and other.name == self.name
+                and other.parent == self.parent)
+
+    def __hash__(self) -> int:
+        return hash((self.parent, self.name))
+
+
+def _capturing(init):
+    """Wrap ``__init__`` to record the fully-bound constructor kwargs in
+    ``self._ml_param_values`` — the single source of truth the whole
+    Params surface reads."""
+    if getattr(init, "_ml_capturing", False):
+        return init
+    sig = inspect.signature(init)
+
+    @functools.wraps(init)
+    def wrapper(self, *args, **kwargs):
+        bound = sig.bind(self, *args, **kwargs)
+        bound.apply_defaults()
+        values = dict(bound.arguments)
+        values.pop("self", None)
+        for p in sig.parameters.values():
+            if p.kind is inspect.Parameter.VAR_KEYWORD:
+                values.update(values.pop(p.name, {}) or {})
+            elif p.kind is inspect.Parameter.VAR_POSITIONAL:
+                values.pop(p.name, None)
+        # Run the real constructor FIRST: if its validation rejects the
+        # arguments (e.g. a bad _set), the recorded param map must keep
+        # the last-good values, not the rejected ones.
+        result = init(self, *args, **kwargs)
+        self._ml_param_values = values
+        return result
+
+    wrapper._ml_capturing = True
+    return wrapper
+
+
+class MLParams:
+    """Mixin: pyspark-ml ``Params`` + ``MLWritable``/``MLReadable``
+    surface for a plain-constructor class (see module docstring)."""
+
+    _ml_param_values: Dict[str, Any]
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        if "__init__" in cls.__dict__:
+            cls.__init__ = _capturing(cls.__dict__["__init__"])
+
+    # ---- Params surface -------------------------------------------------
+    # NOTE: pyspark's ``.params`` listing is served from __getattr__ (not
+    # a property) so a class whose own state legitimately uses the name —
+    # JaxModel.params IS the trained weights — keeps it; the Params
+    # listing then lives only on classes that don't claim the attribute.
+
+    def hasParam(self, name: str) -> bool:
+        return name in self._ml_param_values
+
+    def getParam(self, name: str) -> Param:
+        if not self.hasParam(name):
+            raise AttributeError(
+                f"{type(self).__name__} has no param {name!r}")
+        return Param(name, parent=type(self).__name__)
+
+    def getOrDefault(self, param) -> Any:
+        name = getattr(param, "name", param)
+        if name not in self._ml_param_values:
+            raise AttributeError(
+                f"{type(self).__name__} has no param {name!r}")
+        return self._ml_param_values[name]
+
+    def isDefined(self, param) -> bool:
+        return self.hasParam(getattr(param, "name", param))
+
+    def _set(self, **kwargs) -> "MLParams":
+        unknown = sorted(set(kwargs) - set(self._ml_param_values))
+        if unknown:
+            raise AttributeError(
+                f"{type(self).__name__} has no params {unknown} "
+                f"(valid: {sorted(self._ml_param_values)})")
+        merged = dict(self._ml_param_values)
+        merged.update(kwargs)
+        # Re-run the constructor: validation and derived state (specs,
+        # serialized optimizer groups, ...) are rebuilt, never patched.
+        self.__init__(**merged)
+        return self
+
+    def setParams(self, **kwargs) -> "MLParams":
+        return self._set(**kwargs)
+
+    def copy(self, extra: Optional[Dict] = None) -> "MLParams":
+        merged = dict(self._ml_param_values)
+        for key, value in (extra or {}).items():
+            merged[getattr(key, "name", key)] = value
+        return type(self)(**merged)
+
+    def explainParams(self) -> str:
+        return "\n".join(f"{n}: {v!r}"
+                         for n, v in sorted(self._ml_param_values.items()))
+
+    def __getattr__(self, name: str):
+        # Generated camelCase accessors: setEpochs/getEpochs <->
+        # the 'epochs' constructor kwarg.  Reads self.__dict__ directly
+        # so unpickling (which probes attributes before __dict__ is
+        # restored) cannot recurse.
+        if name[:3] in ("set", "get") and len(name) > 3:
+            values = self.__dict__.get("_ml_param_values")
+            pname = _snake(name[3:])
+            if values is not None and pname in values:
+                if name.startswith("set"):
+                    return lambda value: self._set(**{pname: value})
+                return lambda: self._ml_param_values[pname]
+        if name == "params":
+            values = self.__dict__.get("_ml_param_values")
+            if values is not None:
+                return [Param(n, parent=type(self).__name__)
+                        for n in values]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    # ---- persistence ----------------------------------------------------
+    def _ml_get_state(self) -> Dict[str, Any]:
+        """Picklable param map; override to swap framework payloads for
+        portable encodings (keras -> archive bytes)."""
+        return dict(self._ml_param_values)
+
+    @classmethod
+    def _ml_from_state(cls, state: Dict[str, Any]) -> "MLParams":
+        return cls(**state)
+
+    def save(self, path: str, overwrite: bool = False) -> None:
+        import cloudpickle
+
+        if os.path.exists(os.path.join(path, _METADATA)) and not overwrite:
+            raise FileExistsError(
+                f"{path} already holds a saved instance; pass "
+                "overwrite=True (the pyspark write().overwrite() analog)")
+        os.makedirs(path, exist_ok=True)
+        state = self._ml_get_state()
+        preview = {}
+        for name, value in state.items():
+            try:
+                json.dumps(value)
+            except (TypeError, ValueError):
+                preview[name] = f"<pickled {type(value).__name__}>"
+            else:
+                # Namedtuples (e.g. optax transforms) JSON-flatten to
+                # plain lists — preview only; the pickle keeps the type.
+                preview[name] = (f"<pickled {type(value).__name__}>"
+                                 if hasattr(value, "_fields") else value)
+        meta = {"class": f"{type(self).__module__}.{type(self).__qualname__}",
+                "params": preview}
+        with open(os.path.join(path, _METADATA), "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+        with open(os.path.join(path, _STATE), "wb") as f:
+            cloudpickle.dump(state, f)
+
+    @classmethod
+    def load(cls, path: str) -> "MLParams":
+        obj = load(path)
+        if not isinstance(obj, cls):
+            raise TypeError(
+                f"{path} holds a {type(obj).__name__}, not a {cls.__name__}")
+        return obj
+
+    # pyspark MLWritable/MLReadable spellings.
+    def write(self) -> "_MLWriter":
+        return _MLWriter(self)
+
+    @classmethod
+    def read(cls) -> "_MLReader":
+        return _MLReader(cls)
+
+
+class _MLWriter:
+    def __init__(self, instance: MLParams):
+        self._instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_MLWriter":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        # Invoke the mixin's persistence explicitly: model handles like
+        # KerasModel/TorchModel define their own save(path) with a
+        # framework-export meaning, which must not shadow the
+        # full-handle write().save() path.
+        MLParams.save(self._instance, path, overwrite=self._overwrite)
+
+
+class _MLReader:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def load(self, path: str) -> MLParams:
+        return self._cls.load(path)
+
+
+def load(path: str) -> MLParams:
+    """Load any saved estimator/model/pipeline by its recorded class.
+
+    Pickle-based (cloudpickle of the param map, like the reference's
+    base64-codec params): only load artifacts you trust."""
+    import cloudpickle
+
+    with open(os.path.join(path, _METADATA)) as f:
+        meta = json.load(f)
+    module, _, qualname = meta["class"].rpartition(".")
+    import importlib
+
+    cls = importlib.import_module(module)
+    for part in qualname.split("."):
+        cls = getattr(cls, part)
+    with open(os.path.join(path, _STATE), "rb") as f:
+        state = cloudpickle.load(f)
+    return cls._ml_from_state(state)
+
+
+#: Package-level alias (``orchestrate.load_ml``): ``load`` is too generic
+#: a name to re-export next to checkpoint loaders.
+load_ml = load
+
+
+class Pipeline(MLParams):
+    """Native ``pyspark.ml.Pipeline`` analog: chain transformers and
+    estimators; ``fit`` trains each estimator stage on the running
+    DataFrame and returns a :class:`PipelineModel` of the fitted stages
+    (ref: the Pipeline the reference's estimators drop into —
+    spark/common/params.py builds on pyspark Params for exactly this).
+    Works with zero pyspark; with pyspark present the framework
+    estimators also drop into the real ``pyspark.ml.Pipeline`` via
+    :func:`register_pyspark_stages`."""
+
+    def __init__(self, stages: Optional[List] = None):
+        self.stages = list(stages or [])
+
+    def fit(self, df) -> "PipelineModel":
+        fitted: List[Any] = []
+        data = df
+        # Data only needs to flow as far as the LAST estimator: stages
+        # past it are appended untrained/unrun (pyspark's
+        # indexOfLastEstimator rule) — running a trailing transformer's
+        # full-dataset pass here would just be discarded work.
+        last_fit = max((i for i, s in enumerate(self.stages)
+                        if hasattr(s, "fit")), default=-1)
+        for i, stage in enumerate(self.stages):
+            if hasattr(stage, "fit"):
+                model = stage.fit(data)
+                fitted.append(model)
+                if i < last_fit:
+                    data = model.transform(data)
+            elif hasattr(stage, "transform"):
+                fitted.append(stage)
+                if i < last_fit:
+                    data = stage.transform(data)
+            else:
+                raise TypeError(
+                    f"pipeline stage {i} ({type(stage).__name__}) has "
+                    "neither fit nor transform")
+        return PipelineModel(fitted)
+
+    def getStages(self) -> List:
+        return self.stages
+
+    def setStages(self, stages: List) -> "Pipeline":
+        return self._set(stages=stages)
+
+
+class PipelineModel(MLParams):
+    """Fitted pipeline: ``transform`` chains every stage's transform."""
+
+    def __init__(self, stages: Optional[List] = None):
+        self.stages = list(stages or [])
+
+    def transform(self, df):
+        for stage in self.stages:
+            df = stage.transform(df)
+        return df
+
+
+def _framework_stage_classes():
+    """(estimator_classes, model_classes) importable in this image —
+    heavyweight frameworks resolve lazily and are skipped if absent."""
+    from .estimator import JaxEstimator, JaxModel
+
+    estimators: List[type] = [JaxEstimator, Pipeline]
+    models: List[type] = [JaxModel, PipelineModel]
+    for mod_name, est_name, mdl_name in (
+            (".keras_estimator", "KerasEstimator", "KerasModel"),
+            (".torch_estimator", "TorchEstimator", "TorchModel"),
+            (".lightning_estimator", "LightningEstimator",
+             "LightningModel")):
+        try:
+            import importlib
+
+            mod = importlib.import_module(mod_name, __package__)
+        except ImportError:
+            continue
+        estimators.append(getattr(mod, est_name))
+        models.append(getattr(mod, mdl_name))
+    return estimators, models
+
+
+def register_pyspark_stages() -> bool:
+    """Register the framework estimators/models as pyspark.ml stages.
+
+    pyspark's ``Pipeline._fit`` gates every stage on
+    ``isinstance(stage, (Estimator, Transformer))``; those bases are
+    ABCs, so virtual-subclass registration satisfies the gate without
+    this package inheriting (or even importing, when absent) pyspark.
+    Idempotent; returns False when pyspark has no ml bases to register
+    against.  Call after installing pyspark into an existing session."""
+    try:
+        from pyspark.ml.base import Estimator, Model, Transformer
+    except ImportError:
+        return False
+    estimators, models = _framework_stage_classes()
+    for cls in estimators:
+        Estimator.register(cls)
+    for cls in models:
+        Transformer.register(cls)
+        if Model is not Transformer:
+            Model.register(cls)
+    return True

@@ -21,13 +21,17 @@ import numpy as np
 from .estimator import (check_one_world, collective_worker_env,
                         split_and_shard)
 from .executor import Executor
+from .ml_params import MLParams
 
 __all__ = ["TorchEstimator", "TorchModel"]
 
 
-class TorchModel:
+class TorchModel(MLParams):
     """Trained model handle (ref: spark/torch TorchModel — transform()
-    runs the predict path; the underlying torch module is exposed)."""
+    runs the predict path; the underlying torch module is exposed).
+    ``save(path)`` keeps its torch.save meaning; the full-handle
+    Spark-ML persistence is ``write().save(dir)`` /
+    ``TorchModel.load(dir)`` (orchestrate/ml_params.py)."""
 
     def __init__(self, model, history: Optional[List[Dict]] = None,
                  df_meta: Optional[Dict] = None):
@@ -218,7 +222,7 @@ def _torch_stream_worker(spec: Dict[str, Any], meta: Dict[str, Any],
         cleanup()
 
 
-class TorchEstimator:
+class TorchEstimator(MLParams):
     """Fit a torch module data-parallel over worker processes (ref:
     spark/torch/estimator.py:TorchEstimator — model/optimizer/loss
     params; ``num_workers`` is the reference's ``num_proc``).

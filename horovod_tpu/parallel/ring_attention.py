@@ -53,6 +53,42 @@ def _block_update(q, k, v, acc, row_max, row_sum, mask, scale):
     return acc, new_max, row_sum
 
 
+def _bwd_block_grads(qf, dof, k_blk, v_blk, lse, delta_bhq, mask, scale,
+                     group):
+    """One visiting K/V block's (dq, dk, dv) contributions in the jnp
+    ring backward — scores recomputed from the saved logsumexp.
+
+    qf/dof: f32 ``[B, Lq, H, D]``; k_blk/v_blk: raw ``[B, Lk, Hkv, D]``;
+    lse: ``[B, H, Lq]``; delta_bhq: ``[B, H, Lq]``; mask: broadcastable
+    to ``[B, H, Lq, Lk]`` or None (fully visible); group = H // Hkv.
+
+    Factored out of :func:`_ring_diff_bwd`'s scan body so A/B harnesses
+    (tools/ring_ab.py) time the PRODUCTION step math by import instead
+    of an inline copy that could silently drift.
+    """
+    f32 = jnp.float32
+    ks = k_blk.astype(f32)
+    vs = v_blk.astype(f32)
+    if group > 1:
+        ks = jnp.repeat(ks, group, axis=2)
+        vs = jnp.repeat(vs, group, axis=2)
+    s_ = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
+    p = jnp.exp(s_ - lse[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
+    ds = p * (dp - delta_bhq[..., None]) * scale
+    dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
+    dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    if group > 1:
+        b, lk = k_blk.shape[0], k_blk.shape[1]
+        hkv, d = k_blk.shape[2], k_blk.shape[3]
+        dk_c = dk_c.reshape(b, lk, hkv, group, d).sum(3)
+        dv_c = dv_c.reshape(b, lk, hkv, group, d).sum(3)
+    return dq_c, dk_c, dv_c
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    *,
                    axis: str = "sp",
@@ -310,29 +346,19 @@ def _ring_diff_bwd(axis, causal, scale, use_pallas, res, do):
             pstep, (k, v, zeros_kv, zeros_kv, dq0), jnp.arange(sp))
         return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
+    delta_bhq = delta.transpose(0, 2, 1)                      # [B,H,Lq]
+
     def step(carry, s):
         k_blk, v_blk, dk_blk, dv_blk, dq_acc = carry
         src = (my - s) % sp
-        ks = k_blk.astype(f32)
-        vs = v_blk.astype(f32)
-        if group > 1:
-            ks = jnp.repeat(ks, group, axis=2)
-            vs = jnp.repeat(vs, group, axis=2)
-        s_ = jnp.einsum("bqhd,bkhd->bhqk", qf, ks) * scale
         if causal:
             k_pos = src * lk + jnp.arange(lk)
             mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
-            p = jnp.where(mask, jnp.exp(s_ - lse_v[..., None]), 0.0)
         else:
-            p = jnp.exp(s_ - lse_v[..., None])
-        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
-        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
-        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ks)
-        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        if group > 1:
-            dk_c = dk_c.reshape(b, lk, hkv, group, d).sum(3)
-            dv_c = dv_c.reshape(b, lk, hkv, group, d).sum(3)
+            mask = None
+        dq_c, dk_c, dv_c = _bwd_block_grads(
+            qf, dof, k_blk, v_blk, lse_v, delta_bhq, mask, scale, group)
+        dq_acc = dq_acc + dq_c
         dk_blk = dk_blk + dk_c
         dv_blk = dv_blk + dv_c
         return (lax.ppermute(k_blk, axis, fwd),

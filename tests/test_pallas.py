@@ -544,6 +544,33 @@ class TestSmallseqPolicy:
         fn = _flash_fn(128, 32, batch=8, heads=8)
         assert fn.func.__name__ == "flash_attention_smallseq"
 
+    def test_on_forces_every_tiling_shape(self, monkeypatch):
+        """'on' is the A/B force switch: it must pick the kernel for any
+        tiling shape — including the lm_smallseq_hb16_bs128 leg's shape,
+        which the auto path's 12 MiB VMEM MODEL would reject (a forced
+        leg silently measuring the baseline corrupts the A/B)."""
+        from horovod_tpu.models.transformer import _smallseq_enabled
+
+        monkeypatch.setenv("HVDT_FLASH_SMALLSEQ", "on")
+        monkeypatch.setenv("HVDT_FLASH_SMALLSEQ_HB", "16")
+        assert _smallseq_enabled(512, 64, batch=128, heads=16)
+        # non-tiling / long shapes still never route to the kernel
+        assert not _smallseq_enabled(2048, 64, batch=128, heads=16)
+        assert not _smallseq_enabled(130, 64, batch=128, heads=16)
+
+    def test_auto_stays_disengaged_and_gates_on_platform(self, monkeypatch):
+        import horovod_tpu.models.transformer as tr
+
+        monkeypatch.setenv("HVDT_FLASH_SMALLSEQ", "auto")
+        assert not tr._smallseq_enabled(512, 64, batch=128, heads=16)
+        # even with a threshold set, the CPU platform must not engage
+        monkeypatch.setattr(tr, "_SMALLSEQ_AUTO_MIN_PROGRAMS", 16)
+        assert not tr._smallseq_enabled(512, 64, batch=128, heads=16)
+        # the VMEM model only constrains auto
+        monkeypatch.setattr(tr, "_SMALLSEQ_AUTO_MIN_PROGRAMS", None)
+        assert not tr._smallseq_vmem_ok(512, 64, hb=16)
+        assert tr._smallseq_vmem_ok(512, 64, hb=4)
+
 
 def test_ring_ab_tool_correctness_gate(capsys):
     """tools/ring_ab.py re-states the jnp ring-step math inline (so the

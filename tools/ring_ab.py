@@ -30,7 +30,8 @@ import jax.numpy as jnp
 
 from horovod_tpu.ops.pallas_kernels import (flash_block_update,
                                             flash_grad_block)
-from horovod_tpu.parallel.ring_attention import _NEG_INF, _block_update
+from horovod_tpu.parallel.ring_attention import (_NEG_INF, _block_update,
+                                                 _bwd_block_grads)
 
 
 def bench(f, args_, iters, fetch):
@@ -81,18 +82,12 @@ def run_shape(b, l, h, d, iters):
 
     @jax.jit
     def bwd_jnp(q, k, v, do, lse, delta):
-        # the jnp _ring_diff_bwd step body, full-visibility case
+        # the PRODUCTION _ring_diff_bwd step body (imported, not copied
+        # — ADVICE r4: an inline re-implementation can silently drift),
+        # full-visibility case, no GQA (group=1).
         f32 = jnp.float32
-        qf, dof = q.astype(f32), do.astype(f32)
-        ks_, vs = k.astype(f32), v.astype(f32)
-        s_ = jnp.einsum("bqhd,bkhd->bhqk", qf, ks_) * scale
-        p = jnp.exp(s_ - lse[..., None])
-        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vs)
-        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
-        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, ks_)
-        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        return dq_c, dk_c, dv_c
+        return _bwd_block_grads(q.astype(f32), do.astype(f32), k, v, lse,
+                                delta.transpose(0, 2, 1), None, scale, 1)
 
     @jax.jit
     def bwd_pallas(q, k, v, do, out, lse, delta):

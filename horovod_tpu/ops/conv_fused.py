@@ -1,0 +1,140 @@
+"""Fused 1x1-conv (matmul) + BatchNorm-affine epilogue Pallas kernel.
+
+The below-XLA ResNet roofline probe (VERDICT r4 weak #3): the bs128
+ResNet-50 step is pinned at the HBM roofline (``hbm_util`` 1.0,
+docs/performance.md), and the two residual traffic levers round 2 named
+— conv layout copies and unfused BN passes — were never probed beneath
+XLA.  A 1x1 convolution IS a matmul over the flattened spatial grid
+(``[B*H*W, Cin] @ [Cin, Cout]``), and the bottleneck blocks'
+1x1 convs carry most of ResNet-50's conv FLOPs
+(models/resnet.py:_bottleneck — conv1/conv3 of every block; ref: the
+same blocks in the reference's synthetic ResNet benchmark,
+examples/pytorch/pytorch_synthetic_benchmark.py).  This kernel computes
+
+    y = relu((x @ w) * scale + bias)
+
+in one pass: tiled MXU matmul with f32 VMEM accumulation and the BN
+affine (normalized/inference form — scale and bias folded from
+gamma/beta/mean/var) applied in the epilogue before the single bf16
+HBM write.  If XLA already fuses the affine into its conv output, the
+A/B (tools/resnet_probe.py) shows parity and closes the lever with a
+number; if not, the delta is the banked win.
+
+Runs in Pallas interpret mode off-TPU so the CPU suite exercises the
+same kernel code (tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Shared with the attention kernels: the interpret-mode switch and the
+# dtype-aware block fitter (per-dtype sublane floors — bf16 needs 16
+# rows on real TPU; a hand-rolled 8-row check would pass interpret-mode
+# tests and then fail Mosaic lowering on hardware).
+from .pallas_kernels import _fit_block, _use_interpret
+
+__all__ = ["matmul_bn_relu", "conv1x1_bn_relu", "conv1x1_bn_relu_reference"]
+
+
+def _mm_kernel(a_ref, w_ref, s_ref, b_ref, o_ref, acc_ref, *, relu: bool):
+    """Grid program (i, j, k): accumulate one K-block into the f32 VMEM
+    accumulator; on the last K step apply the BN affine (+ReLU) and make
+    the ONLY HBM write of this output tile."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(a_ref[...], w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _epilogue():
+        y = acc_ref[...] * s_ref[...] + b_ref[...]
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        o_ref[...] = y.astype(o_ref.dtype)
+
+
+def matmul_bn_relu(a: jax.Array, w: jax.Array, scale: jax.Array,
+                   bias: jax.Array, *, relu: bool = True,
+                   block_m: int = 512, block_n: int = 256,
+                   block_k: int = 512) -> jax.Array:
+    """``relu((a @ w) * scale + bias)`` with the affine fused into the
+    matmul epilogue.  a: [M, K]; w: [K, N]; scale/bias: [N] (f32);
+    returns [M, N] in ``a``'s dtype with f32 accumulation throughout."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = a.shape
+    k2, n = w.shape
+    if k != k2:
+        raise ValueError(f"a has K={k} but w has K={k2}")
+    if scale.shape != (n,) or bias.shape != (n,):
+        raise ValueError(
+            f"scale/bias must be [{n}], got {scale.shape}/{bias.shape}")
+    # _fit_block enforces the per-dtype sublane floor on real TPU (and
+    # raises loudly); the lane (N) dimension needs full 128-lane tiles,
+    # checked here.
+    bm = _fit_block(m, block_m, a.dtype)
+    bk = _fit_block(k, block_k, a.dtype, w.dtype)
+    bn = min(block_n, n)
+    while n % bn:
+        bn //= 2
+    if bn < 128:
+        raise ValueError(
+            f"N={n} only tiles at {bn} lanes — below the 128-lane TPU "
+            "tile floor; pad the channel dim to a multiple of 128")
+    grid = (m // bm, n // bn, k // bk)
+
+    kwargs = {}
+    if not _use_interpret():
+        # M/N tiles are independent; only K carries the accumulator.
+        params_cls = getattr(pltpu, "CompilerParams",
+                             getattr(pltpu, "TPUCompilerParams", None))
+        if params_cls is not None:
+            kwargs["compiler_params"] = params_cls(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, relu=relu),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=_use_interpret(),
+        **kwargs,
+    )(a, w, scale.astype(jnp.float32).reshape(1, n),
+      bias.astype(jnp.float32).reshape(1, n))
+
+
+def conv1x1_bn_relu(x: jax.Array, w: jax.Array, scale: jax.Array,
+                    bias: jax.Array, *, relu: bool = True) -> jax.Array:
+    """Fused NHWC 1x1 conv + BN affine (+ReLU).  x: [B, H, W, Cin];
+    w: [Cin, Cout]; scale/bias: [Cout]."""
+    b, h, wd, cin = x.shape
+    out = matmul_bn_relu(x.reshape(b * h * wd, cin), w, scale, bias,
+                         relu=relu)
+    return out.reshape(b, h, wd, w.shape[1])
+
+
+def conv1x1_bn_relu_reference(x, w, scale, bias, *, relu=True):
+    """jnp oracle (f32 accumulation, same math, XLA-scheduled)."""
+    y = jnp.einsum("bhwc,cd->bhwd", x.astype(jnp.float32),
+                   w.astype(jnp.float32))
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)

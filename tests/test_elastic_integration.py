@@ -72,7 +72,12 @@ def _rows(path):
     return out
 
 
-def _wait_for_progress(proc, log_path, min_lines, timeout=120):
+def _wait_for_progress(proc, log_path, min_lines, timeout=300):
+    """300 s, not 120: this 1-core box runs the suite concurrently with
+    background chip-watch probes (a down tunnel hangs each probe ~60 s);
+    phase startup pays launcher + per-worker jax imports serially, so a
+    contended window can exceed 120 s with nothing wrong (observed twice
+    in round-5 full-suite runs; the test passes alone in ~17 s)."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(log_path) and len(_rows(log_path)) >= min_lines:

@@ -185,6 +185,62 @@ class TestPersistence:
         assert loaded._spec["optimizer_cls"] is torch.optim.SGD
 
 
+class TestFrameworkPersistence:
+    def test_keras_estimator_roundtrip(self, tmp_path):
+        """The keras model param travels as .keras archive bytes (keras
+        objects are not reliably picklable); compile state must survive
+        so the loaded estimator passes constructor validation."""
+        import keras
+
+        from horovod_tpu.orchestrate import KerasEstimator
+
+        model = keras.Sequential(
+            [keras.layers.Input((3,)), keras.layers.Dense(1)])
+        model.compile(optimizer="sgd", loss="mse")
+        est = KerasEstimator(model=model, epochs=2, batch_size=8,
+                             num_workers=1)
+        path = str(tmp_path / "ke")
+        est.save(path)
+        loaded = KerasEstimator.load(path)
+        assert loaded.getEpochs() == 2
+        assert loaded.model.optimizer is not None     # compiled survived
+        x = np.zeros((4, 3), np.float32)
+        np.testing.assert_allclose(
+            np.asarray(loaded.model.predict(x, verbose=0)),
+            np.asarray(model.predict(x, verbose=0)), atol=1e-6)
+
+    def test_keras_model_handle_roundtrip(self, tmp_path):
+        import keras
+
+        from horovod_tpu.orchestrate import KerasModel
+
+        net = keras.Sequential(
+            [keras.layers.Input((2,)), keras.layers.Dense(1)])
+        net.compile(optimizer="sgd", loss="mse")
+        m = KerasModel(net, history=[{"loss": 1.0}],
+                       df_meta={"output_col": "p"})
+        path = str(tmp_path / "km")
+        m.write().save(path)
+        m2 = KerasModel.load(path)
+        assert m2.history_ == [{"loss": 1.0}]
+        x = np.ones((3, 2), np.float32)
+        np.testing.assert_allclose(m2.predict(x), m.predict(x), atol=1e-6)
+
+    def test_lightning_model_handle_roundtrip(self, tmp_path):
+        import torch
+
+        from horovod_tpu.orchestrate import LightningModel
+
+        torch.manual_seed(1)
+        m = LightningModel(torch.nn.Linear(2, 1), history=[],
+                           df_meta={"output_col": "p"})
+        path = str(tmp_path / "lm")
+        m.write().save(path)
+        m2 = LightningModel.load(path)
+        x = np.ones((3, 2), np.float32)
+        np.testing.assert_allclose(m2.predict(x), m.predict(x), atol=1e-6)
+
+
 def _ls_fit(spec, rows, y_, xv, yv):
     """In-process stand-in for the barrier-task declarative loop: exact
     least squares on this rank's partition rows (the dispatch machinery

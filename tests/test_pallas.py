@@ -572,6 +572,56 @@ class TestSmallseqPolicy:
         assert tr._smallseq_vmem_ok(512, 64, hb=4)
 
 
+class TestConvFused:
+    """ops/conv_fused.py — the below-XLA ResNet probe kernel (fused
+    1x1-conv matmul + BN affine epilogue), interpret mode vs the f32
+    oracle."""
+
+    @pytest.mark.parametrize("cin,cout,relu", [(256, 128, True),
+                                               (128, 512, False)])
+    def test_matches_reference(self, cin, cout, relu):
+        from horovod_tpu.ops.conv_fused import (conv1x1_bn_relu,
+                                                conv1x1_bn_relu_reference)
+
+        ks = jax.random.split(jax.random.PRNGKey(0), 4)
+        x = jax.random.normal(ks[0], (2, 7, 8, cin), jnp.bfloat16)
+        w = jax.random.normal(ks[1], (cin, cout),
+                              jnp.bfloat16) * (cin ** -0.5)
+        s = jax.random.uniform(ks[2], (cout,), jnp.float32, 0.5, 1.5)
+        b = jax.random.normal(ks[3], (cout,), jnp.float32)
+        got = conv1x1_bn_relu(x, w, s, b, relu=relu)
+        ref = conv1x1_bn_relu_reference(x, w, s, b, relu=relu)
+        assert got.dtype == x.dtype
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_multi_k_block_accumulation(self):
+        """K larger than block_k exercises the zero/accumulate/epilogue
+        grid carry."""
+        from horovod_tpu.ops.conv_fused import matmul_bn_relu
+
+        ks = jax.random.split(jax.random.PRNGKey(1), 4)
+        a = jax.random.normal(ks[0], (64, 1024), jnp.float32)
+        w = jax.random.normal(ks[1], (1024, 128), jnp.float32) * 0.03
+        s = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        got = matmul_bn_relu(a, w, s, b, relu=False, block_k=256)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(a @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_bad_shapes_fail_loudly(self):
+        from horovod_tpu.ops.conv_fused import matmul_bn_relu
+
+        a = jnp.zeros((8, 64), jnp.float32)
+        w = jnp.zeros((64, 64), jnp.float32)
+        with pytest.raises(ValueError, match="tile floor"):
+            matmul_bn_relu(a, w, jnp.ones(64), jnp.zeros(64))
+        with pytest.raises(ValueError, match="scale/bias"):
+            matmul_bn_relu(jnp.zeros((8, 64)), jnp.zeros((64, 128)),
+                           jnp.ones(64), jnp.zeros(128))
+
+
 def test_ring_ab_tool_correctness_gate(capsys):
     """tools/ring_ab.py re-states the jnp ring-step math inline (so the
     A/B times exactly what ring_attention runs); if that copy drifts

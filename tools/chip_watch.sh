@@ -4,7 +4,11 @@
 # tunnel hangs jax.devices() indefinitely (measured round 3 + round 4).
 cd "$(dirname "$0")/.." || exit 1
 while true; do
-  if timeout 60 python -c "
+  # nice -19: the probe hangs ~60s on a down tunnel and this box has ONE
+  # core — an un-niced probe every 3 min starves concurrent pytest
+  # integration tests (measured: elastic launcher phases missed their
+  # 120 s progress windows only while the watcher ran).
+  if nice -n 19 timeout 60 python -c "
 import jax, jax.numpy as jnp
 jax.devices()
 float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum())

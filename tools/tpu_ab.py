@@ -50,6 +50,29 @@ def json_leg(name, cmd, timeout=900):
     return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse}
 
 
+def jsonl_leg(name, cmd, timeout=900, expect=None):
+    """All JSON lines, in order (multi-shape probes emit one per shape).
+
+    ``expect``: required row count — a probe that crashes mid-run after
+    emitting a prefix of its shapes must record as FAILED, not as a
+    complete measurement (``require_rc0`` backs this with the exit
+    code)."""
+    def parse(out):
+        rows = []
+        for line in out.strip().splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
+        if not rows or (expect is not None and len(rows) != expect):
+            return None
+        return rows
+    return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse,
+            "require_rc0": True}
+
+
 def raw_leg(name, cmd, timeout=900, keep=8000, marker="by category:",
             env=None):
     """Keep stdout from the report marker on (profile tables etc.).
@@ -136,6 +159,12 @@ LEGS = [
     json_leg("ring_ab_local8192",
              [PY, os.path.join(REPO, "tools", "ring_ab.py"),
               "--local-seqs", "8192", "--batch", "1"], timeout=1200),
+    # Below-XLA ResNet roofline probe (VERDICT r4 weak #3): fused
+    # 1x1-conv+BN Pallas epilogue vs XLA conv/matmul scheduling on the
+    # four hot bottleneck shapes — one JSON row per shape.
+    jsonl_leg("resnet_1x1_probe",
+              [PY, os.path.join(REPO, "tools", "resnet_probe.py")],
+              timeout=1500, expect=4),
     # ResNet dispatch-gap probe: N steps per jit call via lax.fori_loop
     # (larger batches were already measured WORSE in round 2 — activation
     # traffic scales with batch; docs/performance.md).
@@ -160,6 +189,10 @@ def run_leg(leg, env):
                               text=True, timeout=leg["timeout"], cwd=REPO)
         out = proc.stdout + "\n" + proc.stderr
         parsed = leg["parse"](proc.stdout)
+        if parsed is not None and leg.get("require_rc0") \
+                and proc.returncode != 0:
+            # Parsable prefix + crash = incomplete evidence, not a run.
+            parsed = None
         return {"name": leg["name"], "ok": parsed is not None,
                 "wall_s": round(time.time() - t0, 1),
                 "result": parsed,

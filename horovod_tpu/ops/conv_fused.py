@@ -68,7 +68,20 @@ def matmul_bn_relu(a: jax.Array, w: jax.Array, scale: jax.Array,
                    block_k: int = 512) -> jax.Array:
     """``relu((a @ w) * scale + bias)`` with the affine fused into the
     matmul epilogue.  a: [M, K]; w: [K, N]; scale/bias: [N] (f32);
-    returns [M, N] in ``a``'s dtype with f32 accumulation throughout."""
+    returns [M, N] in ``a``'s dtype with f32 accumulation throughout.
+
+    Differentiable (``custom_vjp``): the backward recomputes the
+    pre-activation ``z = a @ w`` instead of saving it — rematerialized
+    FLOPs on the MXU, zero extra residual HBM traffic (recovering z
+    from the saved output would be cheaper still, but is undefined at
+    ``scale == 0``, which zero-init-gamma ResNets hit on every residual
+    block's last BN).  The backward matmuls run in XLA (MXU-shaped
+    dots; fusing them into Pallas is a further step only if the forward
+    probe banks a win)."""
+    return _mm_diff(a, w, scale, bias, relu, block_m, block_n, block_k)
+
+
+def _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -118,6 +131,45 @@ def matmul_bn_relu(a: jax.Array, w: jax.Array, scale: jax.Array,
         **kwargs,
     )(a, w, scale.astype(jnp.float32).reshape(1, n),
       bias.astype(jnp.float32).reshape(1, n))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _mm_diff(a, w, scale, bias, relu, block_m, block_n, block_k):
+    return _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k)
+
+
+def _mm_diff_fwd(a, w, scale, bias, relu, block_m, block_n, block_k):
+    y = _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k)
+    return y, (a, w, scale, bias, y)
+
+
+def _mm_diff_bwd(relu, block_m, block_n, block_k, res, dy):
+    """g = dy * 1[y>0]; dz = g * scale; da = dz w^T; dw = a^T dz;
+    dbias = sum_M g; dscale = sum_M g*z with z = a @ w RECOMPUTED in f32
+    — exact for every scale (including the zero-init-gamma case where z
+    cannot be recovered from the saved output).
+
+    ReLU subgradient convention: relu'(0) = 0 (the flash-kernel norm;
+    jnp.maximum's autodiff instead splits ties 0.5).  Units at EXACTLY
+    zero pre-activation get zero gradient — note zero-init gamma
+    belongs on a residual block's LAST BN, where the add precedes the
+    relu, i.e. this kernel runs with relu=False and gamma trains."""
+    a, w, scale, bias, y = res
+    f32 = jnp.float32
+    g = dy.astype(f32)
+    if relu:
+        g = jnp.where(y.astype(f32) > 0, g, 0.0)
+    af, wf = a.astype(f32), w.astype(f32)
+    dz = g * scale.astype(f32)
+    da = jnp.dot(dz, wf.T).astype(a.dtype)
+    dw = jnp.dot(af.T, dz).astype(w.dtype)
+    dbias = g.sum(axis=0).astype(bias.dtype)
+    z = jnp.dot(af, wf)
+    dscale = (g * z).sum(axis=0).astype(scale.dtype)
+    return da, dw, dscale, dbias
+
+
+_mm_diff.defvjp(_mm_diff_fwd, _mm_diff_bwd)
 
 
 def conv1x1_bn_relu(x: jax.Array, w: jax.Array, scale: jax.Array,

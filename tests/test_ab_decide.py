@@ -63,23 +63,34 @@ def test_two_percent_margin_is_not_a_win(tmp_path):
     assert d["flash_bwd"]["verdict"] == "DEFAULT_KERNEL"
 
 
-def test_ring_needs_both_shards_and_correctness(tmp_path):
+def test_ring_needs_both_shards_correctness_margin_and_tpu(tmp_path):
     good = {"fwd_pallas_speedup": 1.3, "bwd_pallas_speedup": 1.2,
-            "bwd_correctness_ok": True}
-    bad = dict(good, bwd_correctness_ok=False)
+            "bwd_correctness_ok": True, "platform": "tpu"}
     d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
         "t", ring_ab_local2048=good, ring_ab_local8192=good)])))
     assert d["ring"]["verdict"] == "DEFAULT_RING_PALLAS"
+    # correctness failure on one shard
+    bad = dict(good, bwd_correctness_ok=False)
     d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
         "t", ring_ab_local2048=good, ring_ab_local8192=bad)])))
     assert d["ring"]["verdict"] == "KEEP_JNP"
+    # a 1.00-1.02x "win" is inside within-window variance
+    noise = dict(good, fwd_pallas_speedup=1.01)
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", ring_ab_local2048=noise, ring_ab_local8192=good)])))
+    assert d["ring"]["verdict"] == "KEEP_JNP"
+    # interpret-mode CPU rows are not chip evidence
+    cpu = dict(good, platform="cpu")
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", ring_ab_local2048=cpu, ring_ab_local8192=good)])))
+    assert d["ring"]["verdict"] == "unmeasured"
 
 
 def _probe_rows(**over):
     rows = []
     for s in sorted(ab_decide.PROBE_SHAPES):
         r = {"shape": s, "correctness_ok": True, "pallas_vs_conv": 0.9,
-             "matmul_vs_conv": 1.0}
+             "matmul_vs_conv": 1.0, "platform": "tpu"}
         r.update(over.get(s, {}))
         rows.append(r)
     return rows
@@ -113,6 +124,15 @@ def test_resnet_partial_or_failed_probe_is_unmeasured(tmp_path):
         "t", resnet_1x1_probe=failed)])))
     assert d["resnet_1x1"]["verdict"] == "unmeasured"
     assert d["resnet_1x1"]["missing"] == ["s4_expand"]
+
+    # a complete, correctness-passing CPU/interpret run is NOT chip
+    # evidence (code-review r5: the bench.py last-good discipline)
+    cpu = _probe_rows()
+    for r in cpu:
+        r["platform"] = "cpu"
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", resnet_1x1_probe=cpu)])))
+    assert d["resnet_1x1"]["verdict"] == "unmeasured"
 
 
 def test_probe_shapes_in_sync_with_harness():

@@ -621,6 +621,65 @@ class TestConvFused:
             matmul_bn_relu(jnp.zeros((8, 64)), jnp.zeros((64, 128)),
                            jnp.ones(64), jnp.zeros(128))
 
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_gradients_match_reference(self, relu):
+        """custom_vjp: a/w/scale/bias grads vs autodiff through the jnp
+        oracle (the backward RECOMPUTES z = a @ w — see
+        test_zero_init_gamma_still_trains for why recovery from the
+        saved output is not an option)."""
+        from horovod_tpu.ops.conv_fused import matmul_bn_relu
+
+        ks = jax.random.split(jax.random.PRNGKey(3), 4)
+        a = jax.random.normal(ks[0], (32, 128), jnp.float32)
+        w = jax.random.normal(ks[1], (128, 128), jnp.float32) * 0.1
+        s = jax.random.uniform(ks[2], (128,), jnp.float32, 0.5, 1.5)
+        b = jax.random.normal(ks[3], (128,), jnp.float32)
+
+        def loss_kernel(a, w, s, b):
+            return jnp.sum(matmul_bn_relu(a, w, s, b, relu=relu) ** 2)
+
+        def loss_ref(a, w, s, b):
+            y = jnp.dot(a, w) * s + b
+            if relu:
+                y = jnp.maximum(y, 0.0)
+            return jnp.sum(y ** 2)
+
+        got = jax.grad(loss_kernel, argnums=(0, 1, 2, 3))(a, w, s, b)
+        ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(a, w, s, b)
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_zero_init_gamma_still_trains(self):
+        """scale == 0 (zero-init gamma) must produce the exact dscale —
+        the backward recomputes z rather than recovering it from the
+        zeroed output.  Exercised in its REAL placement: a residual
+        block's last BN runs the kernel with relu=False (the add
+        precedes the relu), so the relu'(0)=0 convention never zeroes
+        the gradient path."""
+        from horovod_tpu.ops.conv_fused import matmul_bn_relu
+
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        a = jax.random.normal(ks[0], (16, 128), jnp.float32)
+        w = jax.random.normal(ks[1], (128, 128), jnp.float32) * 0.1
+        shortcut = jax.random.normal(ks[2], (16, 128), jnp.float32)
+        s = jnp.zeros((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+
+        def loss_k(s):
+            block = matmul_bn_relu(a, w, s, b, relu=False)
+            return jnp.sum(jnp.maximum(block + shortcut, 0.0) ** 2)
+
+        def loss_r(s):
+            block = jnp.dot(a, w) * s + b
+            return jnp.sum(jnp.maximum(block + shortcut, 0.0) ** 2)
+
+        got = jax.grad(loss_k)(s)
+        ref = jax.grad(loss_r)(s)
+        assert float(jnp.abs(got).max()) > 0          # gamma can train
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
 
 def test_ring_ab_tool_correctness_gate(capsys):
     """tools/ring_ab.py re-states the jnp ring-step math inline (so the

@@ -109,26 +109,34 @@ def decide(latest):
             r = entry["result"]
             ring[shard] = {"fwd": r.get("fwd_pallas_speedup"),
                            "bwd": r.get("bwd_pallas_speedup"),
-                           "bwd_ok": r.get("bwd_correctness_ok")}
-    if ring:
+                           "bwd_ok": r.get("bwd_correctness_ok"),
+                           "platform": r.get("platform")}
+    if ring and all(v["platform"] == "tpu" for v in ring.values()):
+        # Same WIN_MARGIN as every other default flip — a 1.00x-1.02x
+        # "win" is within the documented within-window variance.
         wins = [s for s, v in ring.items()
                 if v["fwd"] and v["bwd"] and v["bwd_ok"]
-                and v["fwd"] > 1 and v["bwd"] > 1]
+                and v["fwd"] >= WIN_MARGIN and v["bwd"] >= WIN_MARGIN]
         out["ring"] = {"per_shard": ring,
                        "verdict": ("DEFAULT_RING_PALLAS"
-                                   if len(wins) == len(ring)
-                                   else "KEEP_JNP")}
+                                   if len(wins) == 2 else "KEEP_JNP")}
     else:
-        out["ring"] = {"verdict": "unmeasured"}
+        out["ring"] = {"verdict": "unmeasured",
+                       **({"per_shard": ring} if ring else {})}
 
     entry = latest.get("resnet_1x1_probe")
     if entry and isinstance(entry["result"], list):
         rows = {r["shape"]: {"pallas_vs_conv": r.get("pallas_vs_conv"),
                              "matmul_vs_conv": r.get("matmul_vs_conv"),
-                             "ok": r.get("correctness_ok")}
+                             "ok": r.get("correctness_ok"),
+                             "platform": r.get("platform")}
                 for r in entry["result"]}
+        # platform gate: interpret-mode CPU rows are complete and
+        # correctness-pass but time nothing real — only chip rows may
+        # feed a permanent verdict (the bench.py last-good discipline).
         measured = {s for s, v in rows.items()
-                    if v["ok"] and v["pallas_vs_conv"]}
+                    if v["ok"] and v["pallas_vs_conv"]
+                    and v["platform"] == "tpu"}
         if measured == PROBE_SHAPES:
             # CLOSE_LEVER is permanent — it may only come from a FULL
             # probe (every shape correctness-passed AND Pallas-timed);

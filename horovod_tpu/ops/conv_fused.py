@@ -140,14 +140,19 @@ def _mm_diff(a, w, scale, bias, relu, block_m, block_n, block_k):
 
 def _mm_diff_fwd(a, w, scale, bias, relu, block_m, block_n, block_k):
     y = _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k)
-    return y, (a, w, scale, bias, y)
+    # Residuals: y feeds only the relu mask — with relu=False (the
+    # zero-init-gamma residual placement) it is dead in the backward
+    # and must not pin an [M, N] activation.  bias ([N], negligible)
+    # rides along for its dtype.
+    return y, (a, w, scale, bias, y if relu else None)
 
 
 def _mm_diff_bwd(relu, block_m, block_n, block_k, res, dy):
     """g = dy * 1[y>0]; dz = g * scale; da = dz w^T; dw = a^T dz;
-    dbias = sum_M g; dscale = sum_M g*z with z = a @ w RECOMPUTED in f32
-    — exact for every scale (including the zero-init-gamma case where z
-    cannot be recovered from the saved output).
+    dbias = sum_M g; dscale = sum_M g*z with z = a @ w RECOMPUTED
+    (bf16 operands, f32 accumulation — the forward kernel's own
+    precision) — exact for every scale (including the zero-init-gamma
+    case where z cannot be recovered from the saved output).
 
     ReLU subgradient convention: relu'(0) = 0 (the flash-kernel norm;
     jnp.maximum's autodiff instead splits ties 0.5).  Units at EXACTLY
@@ -159,12 +164,15 @@ def _mm_diff_bwd(relu, block_m, block_n, block_k, res, dy):
     g = dy.astype(f32)
     if relu:
         g = jnp.where(y.astype(f32) > 0, g, 0.0)
-    af, wf = a.astype(f32), w.astype(f32)
+    # Native-dtype operands + f32 accumulation: no materialized f32
+    # copies of a/w, full bf16 MXU rate on the backward dots.
     dz = g * scale.astype(f32)
-    da = jnp.dot(dz, wf.T).astype(a.dtype)
-    dw = jnp.dot(af.T, dz).astype(w.dtype)
+    da = jnp.dot(dz.astype(a.dtype), w.T,
+                 preferred_element_type=f32).astype(a.dtype)
+    dw = jnp.dot(a.T, dz.astype(a.dtype),
+                 preferred_element_type=f32).astype(w.dtype)
     dbias = g.sum(axis=0).astype(bias.dtype)
-    z = jnp.dot(af, wf)
+    z = jnp.dot(a, w, preferred_element_type=f32)
     dscale = (g * z).sum(axis=0).astype(scale.dtype)
     return da, dw, dscale, dbias
 

@@ -84,6 +84,10 @@ def test_ring_needs_both_shards_correctness_margin_and_tpu(tmp_path):
     d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
         "t", ring_ab_local2048=cpu, ring_ab_local8192=good)])))
     assert d["ring"]["verdict"] == "unmeasured"
+    # one shard measured mid-outage is incomplete evidence, not a loss
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", ring_ab_local2048=good)])))
+    assert d["ring"]["verdict"] == "unmeasured"
 
 
 def _probe_rows(**over):

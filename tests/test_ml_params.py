@@ -298,6 +298,19 @@ class TestNativePipeline:
         with pytest.raises(TypeError, match="neither fit nor transform"):
             Pipeline(stages=[object()]).fit(None)
 
+    def test_pipeline_roundtrips_with_estimator_stage(self, tmp_path):
+        """Pipeline persistence carries its stages (the estimators
+        cloudpickle whole); the reloaded pipeline fits like the
+        original."""
+        from horovod_tpu.orchestrate import Pipeline as P
+
+        est = _declarative_est(epochs=3)
+        path = str(tmp_path / "pipe")
+        P(stages=[est]).save(path)
+        pipe = P.load(path)
+        assert len(pipe.getStages()) == 1
+        assert pipe.getStages()[0].getEpochs() == 3
+
     def test_data_flows_only_to_last_estimator(self):
         """pyspark's indexOfLastEstimator rule: a transformer BEFORE the
         last estimator feeds it; one AFTER is appended without running

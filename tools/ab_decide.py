@@ -111,9 +111,12 @@ def decide(latest):
                            "bwd": r.get("bwd_pallas_speedup"),
                            "bwd_ok": r.get("bwd_correctness_ok"),
                            "platform": r.get("platform")}
-    if ring and all(v["platform"] == "tpu" for v in ring.values()):
-        # Same WIN_MARGIN as every other default flip — a 1.00x-1.02x
-        # "win" is within the documented within-window variance.
+    if (set(ring) == {2048, 8192}
+            and all(v["platform"] == "tpu" for v in ring.values())):
+        # Complete evidence only (one shard measured mid-outage is not a
+        # loss — it's unmeasured); same WIN_MARGIN as every other
+        # default flip — a 1.00x-1.02x "win" is within the documented
+        # within-window variance.
         wins = [s for s, v in ring.items()
                 if v["fwd"] and v["bwd"] and v["bwd_ok"]
                 and v["fwd"] >= WIN_MARGIN and v["bwd"] >= WIN_MARGIN]

@@ -38,7 +38,38 @@ import jax.numpy as jnp
 # tests and then fail Mosaic lowering on hardware).
 from .pallas_kernels import _fit_block, _use_interpret
 
-__all__ = ["matmul_bn_relu", "conv1x1_bn_relu", "conv1x1_bn_relu_reference"]
+__all__ = ["matmul_bn_relu", "conv1x1_bn_relu", "conv1x1_bn_relu_reference",
+           "matmul_batch_stats", "conv1x1_bn_train",
+           "conv1x1_bn_train_reference"]
+
+
+def _fit_lanes(n: int, block_n: int) -> int:
+    """Lane (last-dim) tile: largest power-of-2 reduction of ``block_n``
+    that divides ``n``; refuses below the 128-lane TPU tile floor."""
+    bn = min(block_n, n)
+    while n % bn:
+        bn //= 2
+    if bn < 128:
+        raise ValueError(
+            f"N={n} only tiles at {bn} lanes — below the 128-lane TPU "
+            "tile floor; pad the channel dim to a multiple of 128")
+    return bn
+
+
+def _tpu_params() -> dict:
+    """compiler_params kwargs for the matmul grids: M/N tiles are
+    independent, only K carries the accumulator.  Empty in interpret
+    mode (and under a JAX without the params class)."""
+    if _use_interpret():
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    params_cls = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams", None))
+    if params_cls is None:
+        return {}
+    return {"compiler_params": params_cls(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))}
 
 
 def _mm_kernel(a_ref, w_ref, s_ref, b_ref, o_ref, acc_ref, *, relu: bool):
@@ -93,27 +124,11 @@ def _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k):
         raise ValueError(
             f"scale/bias must be [{n}], got {scale.shape}/{bias.shape}")
     # _fit_block enforces the per-dtype sublane floor on real TPU (and
-    # raises loudly); the lane (N) dimension needs full 128-lane tiles,
-    # checked here.
+    # raises loudly); _fit_lanes the 128-lane floor on N.
     bm = _fit_block(m, block_m, a.dtype)
     bk = _fit_block(k, block_k, a.dtype, w.dtype)
-    bn = min(block_n, n)
-    while n % bn:
-        bn //= 2
-    if bn < 128:
-        raise ValueError(
-            f"N={n} only tiles at {bn} lanes — below the 128-lane TPU "
-            "tile floor; pad the channel dim to a multiple of 128")
+    bn = _fit_lanes(n, block_n)
     grid = (m // bm, n // bn, k // bk)
-
-    kwargs = {}
-    if not _use_interpret():
-        # M/N tiles are independent; only K carries the accumulator.
-        params_cls = getattr(pltpu, "CompilerParams",
-                             getattr(pltpu, "TPUCompilerParams", None))
-        if params_cls is not None:
-            kwargs["compiler_params"] = params_cls(
-                dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     return pl.pallas_call(
         functools.partial(_mm_kernel, relu=relu),
@@ -128,7 +143,7 @@ def _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k):
         out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=_use_interpret(),
-        **kwargs,
+        **_tpu_params(),
     )(a, w, scale.astype(jnp.float32).reshape(1, n),
       bias.astype(jnp.float32).reshape(1, n))
 
@@ -198,3 +213,171 @@ def conv1x1_bn_relu_reference(x, w, scale, bias, *, relu=True):
     if relu:
         y = jnp.maximum(y, 0.0)
     return y.astype(x.dtype)
+
+
+# ---- train-form BN: matmul + batch-stat partial sums in one pass --------
+#
+# Training BatchNorm normalizes with the CURRENT batch's statistics of
+# the conv output z, so the affine epilogue above cannot apply — the
+# stats are a reduction OVER z.  XLA's schedule reads z (at least)
+# twice: once for the mean/var reduction, once to normalize.  This
+# kernel emits z AND per-(M-block) partial sums (sum z, sum z^2) from
+# the same VMEM-resident accumulator tile, so z takes ONE write and
+# ONE read (the normalize, which XLA fuses with scale/shift/relu):
+# per-op BN traffic drops by a full read of z.  The partial sums are
+# [M/bm, N] f32 — thousands of times smaller than z.
+
+
+def _mm_stats_kernel(a_ref, w_ref, o_ref, s1_ref, s2_ref, acc_ref):
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(a_ref[...], w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _emit():
+        z = acc_ref[...]
+        o_ref[...] = z.astype(o_ref.dtype)
+        s1_ref[...] = z.sum(axis=0, keepdims=True)
+        s2_ref[...] = (z * z).sum(axis=0, keepdims=True)
+
+
+def matmul_batch_stats(a: jax.Array, w: jax.Array, *, block_m: int = 512,
+                       block_n: int = 256, block_k: int = 512):
+    """One fused pass: ``z = a @ w`` (written once, in ``a``'s dtype)
+    plus per-M-block partial sums of z and z^2 (f32 ``[M/bm, N]``).
+    Finalize stats as ``mean = s1.sum(0)/M``,
+    ``var = s2.sum(0)/M - mean^2`` (f32 accumulation throughout)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = a.shape
+    k2, n = w.shape
+    if k != k2:
+        raise ValueError(f"a has K={k} but w has K={k2}")
+    bm = _fit_block(m, block_m, a.dtype)
+    bk = _fit_block(k, block_k, a.dtype, w.dtype)
+    bn = _fit_lanes(n, block_n)
+    grid = (m // bm, n // bn, k // bk)
+
+    stat_spec = pl.BlockSpec((1, bn), lambda i, j, kk: (i, j))
+    return pl.pallas_call(
+        _mm_stats_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+                   stat_spec, stat_spec],
+        out_shape=(jax.ShapeDtypeStruct((m, n), a.dtype),
+                   jax.ShapeDtypeStruct((m // bm, n), jnp.float32),
+                   jax.ShapeDtypeStruct((m // bm, n), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=_use_interpret(),
+        **_tpu_params(),
+    )(a, w)
+
+
+def conv1x1_bn_train(x: jax.Array, w: jax.Array, gamma: jax.Array,
+                     beta: jax.Array, *, eps: float = 1e-5,
+                     relu: bool = True):
+    """Fused NHWC 1x1 conv + TRAIN-mode BN (+ReLU): batch statistics
+    come from the kernel's partial sums; the normalize (+scale/shift/
+    relu) is the only re-read of z and XLA fuses it into one pass.
+    Returns ``(y, batch_mean, batch_var)`` — mean/var feed the caller's
+    running-stat update exactly like models/resnet.py _batch_norm.
+
+    Differentiable (``custom_vjp``): the standard batch-stat BN
+    backward with z recomputed (bf16 operands, f32 accumulation) —
+    same remat philosophy as :func:`matmul_bn_relu`'s backward.
+    Cotangents arriving on the mean/var outputs are honored (callers
+    that treat running stats as non-differentiated aux simply
+    contribute zeros)."""
+    b, h, wd, cin = x.shape
+    cout = w.shape[1]
+    if gamma.shape != (cout,) or beta.shape != (cout,):
+        raise ValueError(
+            f"gamma/beta must be [{cout}], got {gamma.shape}/{beta.shape}")
+    y2d, mean, var = _train_diff(x.reshape(b * h * wd, cin), w, gamma,
+                                 beta, float(eps), relu)
+    return y2d.reshape(b, h, wd, cout), mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _train_diff(a, w, gamma, beta, eps, relu):
+    y, mean, var, _ = _train_forward(a, w, gamma, beta, eps, relu)
+    return y, mean, var
+
+
+def _train_forward(a, w, gamma, beta, eps, relu):
+    m = a.shape[0]
+    z, s1, s2 = matmul_batch_stats(a, w)
+    f32 = jnp.float32
+    mean = s1.sum(axis=0) / m
+    var = jnp.maximum(s2.sum(axis=0) / m - mean * mean, 0.0)
+    scale = gamma.astype(f32) * jax.lax.rsqrt(var + eps)
+    bias = beta.astype(f32) - mean * scale
+    y = z.astype(f32) * scale + bias
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(a.dtype), mean, var, z
+
+
+def _train_diff_fwd(a, w, gamma, beta, eps, relu):
+    y, mean, var, _ = _train_forward(a, w, gamma, beta, eps, relu)
+    # z is recomputed in the backward (remat); y feeds only the relu
+    # mask; mean/var are [N] — negligible residuals.
+    return (y, mean, var), (a, w, gamma, beta, mean, var,
+                            y if relu else None)
+
+
+def _train_diff_bwd(eps, relu, res, cts):
+    """Batch-stat BN backward.  With inv = rsqrt(var+eps) and
+    zhat = (z-mean)*inv:  g = dy*1[y>0]; dbeta = sum g;
+    dgamma = sum g*zhat; dzhat = g*gamma;
+    dz = inv*(dzhat - mean_M(dzhat) - zhat*mean_M(dzhat*zhat));
+    da = dz w^T; dw = a^T dz.  Cotangents on the mean/var outputs add
+    their direct paths (d mean/d z = 1/M; d var/d z = 2(z-mean)/M)."""
+    a, w, gamma, beta, mean, var, y = res
+    dy, dmean_ct, dvar_ct = cts
+    f32 = jnp.float32
+    m = a.shape[0]
+    g = dy.astype(f32)
+    if relu:
+        g = jnp.where(y.astype(f32) > 0, g, 0.0)
+    z = jnp.dot(a, w, preferred_element_type=f32)
+    inv = jax.lax.rsqrt(var + eps)
+    zhat = (z - mean) * inv
+    dbeta = g.sum(axis=0).astype(beta.dtype)
+    dgamma = (g * zhat).sum(axis=0).astype(gamma.dtype)
+    dzhat = g * gamma.astype(f32)
+    dz = inv * (dzhat - dzhat.mean(axis=0)
+                - zhat * (dzhat * zhat).mean(axis=0))
+    dz = dz + dmean_ct.astype(f32) / m
+    dz = dz + dvar_ct.astype(f32) * 2.0 * (z - mean) / m
+    da = jnp.dot(dz.astype(a.dtype), w.T,
+                 preferred_element_type=f32).astype(a.dtype)
+    dw = jnp.dot(a.T, dz.astype(a.dtype),
+                 preferred_element_type=f32).astype(w.dtype)
+    return da, dw, dgamma, dbeta
+
+
+_train_diff.defvjp(_train_diff_fwd, _train_diff_bwd)
+
+
+def conv1x1_bn_train_reference(x, w, gamma, beta, *, eps=1e-5, relu=True):
+    """jnp train-form oracle (f32 throughout)."""
+    f32 = jnp.float32
+    z = jnp.einsum("bhwc,cd->bhwd", x.astype(f32), w.astype(f32))
+    mean = z.mean(axis=(0, 1, 2))
+    var = z.var(axis=(0, 1, 2))
+    y = (z - mean) * jax.lax.rsqrt(var + eps) * gamma.astype(f32) \
+        + beta.astype(f32)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype), mean, var

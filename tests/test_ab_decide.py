@@ -146,6 +146,14 @@ def test_probe_shapes_in_sync_with_harness():
     assert {s[0] for s in probe.SHAPES} == ab_decide.PROBE_SHAPES
 
 
+def test_train_probe_shares_the_rule(tmp_path):
+    rows = _probe_rows(s4_contract={"pallas_vs_conv": 1.3})
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", resnet_1x1_train_probe=rows)])))
+    assert d["resnet_1x1_train"]["verdict"] == "WIRE_FUSED_KERNEL"
+    assert d["resnet_1x1"]["verdict"] == "unmeasured"   # affine separate
+
+
 def test_everything_unmeasured_is_honest(tmp_path):
     d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [])))
     assert all(v["verdict"] == "unmeasured" for v in d.values())

@@ -127,38 +127,39 @@ def decide(latest):
         out["ring"] = {"verdict": "unmeasured",
                        **({"per_shard": ring} if ring else {})}
 
-    entry = latest.get("resnet_1x1_probe")
-    if entry and isinstance(entry["result"], list):
-        rows = {r["shape"]: {"pallas_vs_conv": r.get("pallas_vs_conv"),
-                             "matmul_vs_conv": r.get("matmul_vs_conv"),
-                             "ok": r.get("correctness_ok"),
-                             "platform": r.get("platform")}
-                for r in entry["result"]}
-        # platform gate: interpret-mode CPU rows are complete and
-        # correctness-pass but time nothing real — only chip rows may
-        # feed a permanent verdict (the bench.py last-good discipline).
-        measured = {s for s, v in rows.items()
-                    if v["ok"] and v["pallas_vs_conv"]
-                    and v["platform"] == "tpu"}
-        if measured == PROBE_SHAPES:
-            # CLOSE_LEVER is permanent — it may only come from a FULL
-            # probe (every shape correctness-passed AND Pallas-timed);
-            # a crashed or miscomparing run stays "unmeasured".
-            wins = sorted(s for s in measured
-                          if rows[s]["pallas_vs_conv"] > 1.05)
-            out["resnet_1x1"] = {
-                "per_shape": rows,
-                "verdict": ("WIRE_FUSED_KERNEL" if wins
-                            else "CLOSE_LEVER"),
-                "winning_shapes": wins}
-        else:
-            out["resnet_1x1"] = {
-                "verdict": "unmeasured", "per_shape": rows,
-                "missing": sorted(PROBE_SHAPES - measured)}
-    else:
-        out["resnet_1x1"] = {"verdict": "unmeasured"}
+    out["resnet_1x1"] = _probe_verdict(latest.get("resnet_1x1_probe"))
+    out["resnet_1x1_train"] = _probe_verdict(
+        latest.get("resnet_1x1_train_probe"))
 
     return out
+
+
+def _probe_verdict(entry):
+    """Shared rule for the affine and train-form 1x1 probes."""
+    if not (entry and isinstance(entry["result"], list)):
+        return {"verdict": "unmeasured"}
+    rows = {r["shape"]: {"pallas_vs_conv": r.get("pallas_vs_conv"),
+                         "matmul_vs_conv": r.get("matmul_vs_conv"),
+                         "ok": r.get("correctness_ok"),
+                         "platform": r.get("platform")}
+            for r in entry["result"]}
+    # platform gate: interpret-mode CPU rows are complete and
+    # correctness-pass but time nothing real — only chip rows may
+    # feed a permanent verdict (the bench.py last-good discipline).
+    measured = {s for s, v in rows.items()
+                if v["ok"] and v["pallas_vs_conv"]
+                and v["platform"] == "tpu"}
+    if measured != PROBE_SHAPES:
+        # CLOSE_LEVER is permanent — it may only come from a FULL
+        # probe (every shape correctness-passed AND Pallas-timed);
+        # a crashed or miscomparing run stays "unmeasured".
+        return {"verdict": "unmeasured", "per_shape": rows,
+                "missing": sorted(PROBE_SHAPES - measured)}
+    wins = sorted(s for s in measured
+                  if rows[s]["pallas_vs_conv"] > 1.05)
+    return {"per_shape": rows,
+            "verdict": "WIRE_FUSED_KERNEL" if wins else "CLOSE_LEVER",
+            "winning_shapes": wins}
 
 
 def main():

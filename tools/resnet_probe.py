@@ -43,6 +43,8 @@ from jax import lax
 
 from horovod_tpu.ops.conv_fused import (conv1x1_bn_relu,
                                         conv1x1_bn_relu_reference,
+                                        conv1x1_bn_train,
+                                        conv1x1_bn_train_reference,
                                         matmul_bn_relu)
 
 # The four hot 1x1 shapes of bs128 ResNet-50 stages 3/4 (NHWC,
@@ -126,15 +128,84 @@ def run_shape(label, b, h, w_, cin, cout, iters):
     print(json.dumps(out), flush=True)
 
 
+def run_shape_train(label, b, h, w_, cin, cout, iters):
+    """TRAIN-form leg: batch-stat BN forces (at least) two reads of the
+    conv output under XLA; the fused kernel emits z + stat partials in
+    one pass (ops/conv_fused.matmul_batch_stats) so z is read once."""
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(ks[0], (b, h, w_, cin), jnp.bfloat16)
+    w = jax.random.normal(ks[1], (cin, cout), jnp.bfloat16) * (cin ** -0.5)
+    gamma = jax.random.uniform(ks[2], (cout,), jnp.float32, 0.5, 1.5)
+    beta = jax.random.normal(ks[3], (cout,), jnp.float32)
+    eps = 1e-5
+
+    @jax.jit
+    def xla_train(x, w, gamma, beta):
+        z = lax.conv_general_dilated(
+            x, w.reshape(1, 1, cin, cout), (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        zf = z.astype(jnp.float32)
+        mean = zf.mean(axis=(0, 1, 2))
+        var = zf.var(axis=(0, 1, 2))
+        y = (zf - mean) * lax.rsqrt(var + eps) * gamma + beta
+        return jnp.maximum(y, 0.0).astype(x.dtype), mean, var
+
+    @jax.jit
+    def pallas_train(x, w, gamma, beta):
+        return conv1x1_bn_train(x, w, gamma, beta, eps=eps)
+
+    ref = conv1x1_bn_train_reference(x, w, gamma, beta, eps=eps)
+
+    @jax.jit
+    def rel(out, r):
+        rels = [jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)
+                        ).max()
+                / jnp.maximum(jnp.abs(b_.astype(jnp.float32)).max(), 1e-9)
+                for a, b_ in zip(out, r)]
+        return jnp.stack(rels).max()
+
+    rels = {n: float(rel(list(f(x, w, gamma, beta)), list(ref)))
+            for n, f in (("xla_train", xla_train),
+                         ("pallas_train", pallas_train))}
+    # bf16 z-write rounding bounds the fused y at ~1e-2 rel
+    ok = all(v < 2e-2 for v in rels.values())
+
+    # Time the y output only (y data-depends on mean/var, so the stats
+    # cannot be dead-code-eliminated); bench's fetch needs an array.
+    xla_y = jax.jit(lambda *a: xla_train(*a)[0])
+    pallas_y = jax.jit(lambda *a: pallas_train(*a)[0])
+    t = {n: bench(f, (x, w, gamma, beta), iters)
+         for n, f in (("xla_train", xla_y),
+                      *((("pallas_train", pallas_y),) if ok else ()))}
+
+    m = b * h * w_
+    bytes_min = 2 * (m * cin + cin * cout + 2 * m * cout) + 12 * cout
+    dev = jax.devices()[0]
+    out = {"metric": "resnet_1x1_bn_train_probe", "shape": label,
+           "platform": dev.platform, "device_kind": dev.device_kind,
+           "m_k_n": [m, cin, cout], "iters": iters,
+           "correctness_ok": ok, "rel_max_diff": rels,
+           "min_traffic_mb": round(bytes_min / 2 ** 20, 1)}
+    for n, dt in t.items():
+        out[f"{n}_ms"] = round(dt * 1e3, 3)
+        out[f"{n}_eff_gbps"] = round(bytes_min / dt / 1e9, 1)
+    if ok:
+        out["pallas_vs_conv"] = round(t["xla_train"] / t["pallas_train"], 3)
+    print(json.dumps(out), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=50)
     ap.add_argument("--shapes", default=",".join(s[0] for s in SHAPES))
+    ap.add_argument("--form", choices=("affine", "train"),
+                    default="affine")
     args = ap.parse_args()
     want = set(args.shapes.split(","))
+    run = run_shape if args.form == "affine" else run_shape_train
     for spec in SHAPES:
         if spec[0] in want:
-            run_shape(*spec, iters=args.iters)
+            run(*spec, iters=args.iters)
 
 
 if __name__ == "__main__":

@@ -165,6 +165,13 @@ LEGS = [
     jsonl_leg("resnet_1x1_probe",
               [PY, os.path.join(REPO, "tools", "resnet_probe.py")],
               timeout=1500, expect=4),
+    # TRAIN-form BN (batch stats): the fused kernel emits z + stat
+    # partials in one pass, saving one full read of z vs XLA's
+    # stats-then-normalize schedule.
+    jsonl_leg("resnet_1x1_train_probe",
+              [PY, os.path.join(REPO, "tools", "resnet_probe.py"),
+               "--form", "train"],
+              timeout=1500, expect=4),
     # ResNet dispatch-gap probe: N steps per jit call via lax.fori_loop
     # (larger batches were already measured WORSE in round 2 — activation
     # traffic scales with batch; docs/performance.md).

@@ -51,6 +51,10 @@ def _save_last_good(line: str) -> None:
             # A/B probe variants are not the headline metric — caching
             # one would contaminate the outage-fallback evidence.
             return
+        if os.environ.get("HVDT_BENCH_NO_CACHE", "") not in ("", "0"):
+            # Experimental-config A/B legs (e.g. HVDT_FUSED_CONV1X1=1)
+            # must not overwrite the stock-config headline cache.
+            return
         d["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with open(LAST_GOOD_PATH, "w") as f:
             json.dump(d, f, indent=1)

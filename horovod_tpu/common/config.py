@@ -101,6 +101,14 @@ KNOBS: Dict[str, Knob] = {
            "heads_per_block for the smallseq attention kernel (clamped "
            "to divide the head count; tuning knob for the grid-overhead "
            "vs VMEM trade)."),
+        _k("HVDT_FUSED_CONV1X1", False, _parse_bool,
+           "Route eligible ResNet 1x1 conv+BN(+ReLU) blocks through the "
+           "fused Pallas kernels (ops/conv_fused.py): train mode emits "
+           "conv output + batch-stat partials in one pass, eval mode "
+           "fuses the folded affine into the matmul epilogue.  Default "
+           "OFF pending the TPU A/B (tools/tpu_ab.py resnet_bench_fused "
+           "leg) — an unmeasured kernel is not a default.  Eligibility: "
+           "1x1, stride 1, bn_axis=None, Cout % 128 == 0."),
         _k("HVDT_FLASH_BWD", "xla", str,
            "flash_attention backward: xla (blockwise XLA recompute) or "
            "kernel (Pallas flash_grad_block passes). Read at TRACE time "

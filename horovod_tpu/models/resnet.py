@@ -204,21 +204,62 @@ def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
     return x * a + b, new_s
 
 
+def _fused_1x1_eligible(w, stride, cfg) -> bool:
+    """HVDT_FUSED_CONV1X1 gate: fused Pallas conv+BN only for 1x1
+    stride-1 convs with 128-lane-tiling output channels and LOCAL batch
+    stats (SyncBN's cross-device pmean would need psum'd partials —
+    fall back there)."""
+    from ..common import config
+
+    kh, kw, _, cout = w.shape
+    return (config.get_bool("HVDT_FUSED_CONV1X1") and kh == 1 and kw == 1
+            and stride == 1 and cfg.bn_axis is None and cout % 128 == 0)
+
+
+def _conv_bn(x, w, bn_p, bn_s, cfg, train, *, stride=1, relu=False):
+    """conv + BN (+ReLU) — one call site shape for both the XLA path
+    and the fused Pallas path (ops/conv_fused.py), so the A/B differs
+    ONLY in lowering.  Returns (y, new_bn_stats)."""
+    if _fused_1x1_eligible(w, stride, cfg):
+        from ..ops.conv_fused import conv1x1_bn_relu, conv1x1_bn_train
+
+        w2 = w.reshape(w.shape[2], w.shape[3]).astype(x.dtype)
+        if train:
+            y, mean, var = conv1x1_bn_train(
+                x, w2, bn_p["scale"], bn_p["bias"], eps=cfg.bn_eps,
+                relu=relu)
+            m = cfg.bn_momentum
+            new_s = {"mean": m * bn_s["mean"] + (1 - m) * mean,
+                     "var": m * bn_s["var"] + (1 - m) * var}
+        else:
+            inv = lax.rsqrt(bn_s["var"] + cfg.bn_eps)
+            scale = bn_p["scale"].astype(jnp.float32) * inv
+            bias = (bn_p["bias"].astype(jnp.float32)
+                    - bn_s["mean"] * scale)
+            y = conv1x1_bn_relu(x, w2, scale, bias, relu=relu)
+            new_s = bn_s
+        # Same residency anchor as _conv, so the "epilogue" remat
+        # policy keeps a boundary here on the fused path too.
+        return jax.ad_checkpoint.checkpoint_name(y, "rn_conv_out"), new_s
+    y, new_s = _batch_norm(_conv(x, w, stride), bn_p, bn_s, cfg, train)
+    if relu:
+        y = jax.nn.relu(y)
+    return y, new_s
+
+
 def _bottleneck(x, p, s, cfg, train, stride):
     out_s = {}
-    y, out_s["bn1"] = _batch_norm(_conv(x, p["conv1"]), p["bn1"], s["bn1"],
-                                  cfg, train)
-    y = jax.nn.relu(y)
+    y, out_s["bn1"] = _conv_bn(x, p["conv1"], p["bn1"], s["bn1"], cfg,
+                               train, relu=True)
     # v1.5: stride lives on the 3x3 conv.
-    y, out_s["bn2"] = _batch_norm(_conv(y, p["conv2"], stride), p["bn2"],
-                                  s["bn2"], cfg, train)
-    y = jax.nn.relu(y)
-    y, out_s["bn3"] = _batch_norm(_conv(y, p["conv3"]), p["bn3"], s["bn3"],
-                                  cfg, train)
+    y, out_s["bn2"] = _conv_bn(y, p["conv2"], p["bn2"], s["bn2"], cfg,
+                               train, stride=stride, relu=True)
+    y, out_s["bn3"] = _conv_bn(y, p["conv3"], p["bn3"], s["bn3"], cfg,
+                               train, relu=False)
     if "conv_proj" in p:
-        sc, out_s["bn_proj"] = _batch_norm(
-            _conv(x, p["conv_proj"], stride), p["bn_proj"], s["bn_proj"],
-            cfg, train)
+        sc, out_s["bn_proj"] = _conv_bn(x, p["conv_proj"], p["bn_proj"],
+                                        s["bn_proj"], cfg, train,
+                                        stride=stride, relu=False)
     else:
         sc = x
     return jax.nn.relu(y + sc), out_s

@@ -154,6 +154,28 @@ def test_train_probe_shares_the_rule(tmp_path):
     assert d["resnet_1x1"]["verdict"] == "unmeasured"   # affine separate
 
 
+def test_resnet_e2e_fused_rule(tmp_path):
+    base = {"value": 2700.0, "platform": "tpu"}
+    win = {"value": 2800.0, "platform": "tpu"}
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", resnet_bench_default=base, resnet_bench_fused=win)])))
+    assert d["resnet_e2e_fused"]["verdict"] == "DEFAULT_FUSED"
+    noise = {"value": 2710.0, "platform": "tpu"}
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", resnet_bench_default=base, resnet_bench_fused=noise)])))
+    assert d["resnet_e2e_fused"]["verdict"] == "KEEP_XLA_CONV"
+    # a stale fallback headline is not window evidence
+    stale = {"value": 2800.0, "platform": "tpu", "stale": True}
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [_run(
+        "t", resnet_bench_default=stale, resnet_bench_fused=win)])))
+    assert d["resnet_e2e_fused"]["verdict"] == "unmeasured"
+    # legs from DIFFERENT runs are cross-window — never paired
+    d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [
+        _run("t0", resnet_bench_default=base),
+        _run("t1", resnet_bench_fused=win)])))
+    assert d["resnet_e2e_fused"]["verdict"] == "unmeasured"
+
+
 def test_everything_unmeasured_is_honest(tmp_path):
     d = ab_decide.decide(ab_decide.latest_results(_hist(tmp_path, [])))
     assert all(v["verdict"] == "unmeasured" for v in d.values())

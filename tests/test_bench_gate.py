@@ -100,3 +100,16 @@ def test_healthy_run_unchanged(bench, capsys, tmp_path):
     assert d["value"] == 2693.7
     assert "stale" not in d
     assert os.path.exists(bench.LAST_GOOD_PATH)
+
+
+def test_no_cache_env_protects_headline_cache(bench, capsys, tmp_path,
+                                              monkeypatch):
+    """Experimental-config A/B legs (HVDT_BENCH_NO_CACHE=1, e.g. the
+    fused-conv bench) must not overwrite the stock-config last-good."""
+    tpu_line = json.dumps({**LAST_GOOD, "measured_at": None})
+    bench.LAST_GOOD_PATH = str(tmp_path / "lg.json")
+    monkeypatch.setenv("HVDT_BENCH_NO_CACHE", "1")
+    d = _run_main(bench, capsys,
+                  lambda *a, **k: (True, tpu_line, ""), None)
+    assert d["value"] == 2693.7                  # result still printed
+    assert not os.path.exists(bench.LAST_GOOD_PATH)

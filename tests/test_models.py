@@ -202,6 +202,89 @@ class TestResNet:
             np.asarray(want["bn_stem"]["mean"]), rtol=1e-4, atol=1e-5)
 
 
+class TestFusedConv1x1:
+    """HVDT_FUSED_CONV1X1: the fused Pallas conv+BN route must be a
+    pure lowering change — forward, grads, and running-stat updates
+    identical to the XLA path (models/resnet.py _conv_bn)."""
+
+    def _bottleneck_setup(self):
+        from horovod_tpu.models import resnet as rn
+
+        cfg = rn.ResNetConfig(num_classes=10, dtype=jnp.float32)
+        ks = jax.random.split(jax.random.PRNGKey(0), 5)
+        p = {"conv1": rn._conv_init(ks[0], 1, 1, 128, 128, cfg.dtype),
+             "conv2": rn._conv_init(ks[1], 3, 3, 128, 128, cfg.dtype),
+             "conv3": rn._conv_init(ks[2], 1, 1, 128, 512, cfg.dtype),
+             "conv_proj": rn._conv_init(ks[3], 1, 1, 128, 512, cfg.dtype),
+             "bn1": rn._bn_init(128, cfg.dtype),
+             "bn2": rn._bn_init(128, cfg.dtype),
+             "bn3": rn._bn_init(512, cfg.dtype),
+             "bn_proj": rn._bn_init(512, cfg.dtype)}
+        s = {"bn1": rn._bn_stats(128), "bn2": rn._bn_stats(128),
+             "bn3": rn._bn_stats(512), "bn_proj": rn._bn_stats(512)}
+        x = jax.random.normal(ks[4], (2, 8, 8, 128), cfg.dtype)
+        return rn, cfg, p, s, x
+
+    @pytest.mark.parametrize("train", [True, False])
+    def test_bottleneck_fused_matches_xla(self, monkeypatch, train):
+        rn, cfg, p, s, x = self._bottleneck_setup()
+
+        def run():
+            y, out_s = rn._bottleneck(x, p, s, cfg, train, stride=1)
+            return y, out_s
+
+        monkeypatch.delenv("HVDT_FUSED_CONV1X1", raising=False)
+        y_ref, s_ref = run()
+        monkeypatch.setenv("HVDT_FUSED_CONV1X1", "1")
+        y_fused, s_fused = run()
+        np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                                   rtol=1e-4, atol=1e-4)
+        for k in s_ref:
+            for stat in ("mean", "var"):
+                np.testing.assert_allclose(
+                    np.asarray(s_fused[k][stat]),
+                    np.asarray(s_ref[k][stat]), rtol=1e-4, atol=1e-5)
+
+    def test_bottleneck_fused_grads_match(self, monkeypatch):
+        rn, cfg, p, s, x = self._bottleneck_setup()
+
+        def loss(p):
+            y, _ = rn._bottleneck(x, p, s, cfg, True, stride=1)
+            return jnp.mean(y.astype(jnp.float32) ** 2)
+
+        monkeypatch.delenv("HVDT_FUSED_CONV1X1", raising=False)
+        g_ref = jax.grad(loss)(p)
+        monkeypatch.setenv("HVDT_FUSED_CONV1X1", "1")
+        g_fused = jax.grad(loss)(p)
+        ref_flat = {jax.tree_util.keystr(k): v for k, v in
+                    jax.tree_util.tree_leaves_with_path(g_ref)}
+        fused_flat = {jax.tree_util.keystr(k): v for k, v in
+                      jax.tree_util.tree_leaves_with_path(g_fused)}
+        assert set(ref_flat) == set(fused_flat)
+        for k, va in ref_flat.items():
+            np.testing.assert_allclose(np.asarray(fused_flat[k]),
+                                       np.asarray(va),
+                                       rtol=2e-3, atol=1e-4, err_msg=k)
+
+    def test_sync_bn_config_falls_back(self, monkeypatch):
+        """bn_axis set -> fused path must NOT engage (local-stat kernel
+        would silently skip the cross-device pmean)."""
+        from horovod_tpu.models import resnet as rn
+
+        monkeypatch.setenv("HVDT_FUSED_CONV1X1", "1")
+        cfg = rn.ResNetConfig(num_classes=4, dtype=jnp.float32,
+                              bn_axis="dp")
+        w = jnp.zeros((1, 1, 128, 128))
+        assert not rn._fused_1x1_eligible(w, 1, cfg)
+        cfg_ok = rn.ResNetConfig(num_classes=4, dtype=jnp.float32)
+        assert rn._fused_1x1_eligible(w, 1, cfg_ok)
+        assert not rn._fused_1x1_eligible(w, 2, cfg_ok)
+        assert not rn._fused_1x1_eligible(
+            jnp.zeros((3, 3, 128, 128)), 1, cfg_ok)
+        assert not rn._fused_1x1_eligible(
+            jnp.zeros((1, 1, 128, 64)), 1, cfg_ok)
+
+
 class TestMLP:
     def test_trains(self):
         params = mlp_init(jax.random.PRNGKey(0), (16, 32, 4))

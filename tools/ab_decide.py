@@ -131,6 +131,35 @@ def decide(latest):
     out["resnet_1x1_train"] = _probe_verdict(
         latest.get("resnet_1x1_train_probe"))
 
+    def bench_img_s(name):
+        entry = latest.get(name)
+        if not entry or not isinstance(entry["result"], dict):
+            return None, None
+        r = entry["result"]
+        # stale fallback headlines and CPU probes are not window
+        # evidence
+        if r.get("platform") != "tpu" or r.get("stale"):
+            return None, None
+        return r.get("value"), entry.get("at")
+
+    base_img, base_at = bench_img_s("resnet_bench_default")
+    fused_img, fused_at = bench_img_s("resnet_bench_fused")
+    # Same-run only: the legs are scheduled adjacent precisely so the
+    # comparison is within one measurement window — pairing a default
+    # from run N with a fused from run N+1 is the cross-window
+    # comparison the harness docstring forbids.
+    if base_img and fused_img and base_at == fused_at is not None:
+        out["resnet_e2e_fused"] = {
+            "default_img_s": base_img, "fused_img_s": fused_img,
+            "speedup": round(fused_img / base_img, 4),
+            "verdict": ("DEFAULT_FUSED" if fused_img >= base_img
+                        * WIN_MARGIN else "KEEP_XLA_CONV"),
+            "action": ("default HVDT_FUSED_CONV1X1=1 (common/config.py)"
+                       if fused_img >= base_img * WIN_MARGIN else
+                       "keep off; record the e2e number")}
+    else:
+        out["resnet_e2e_fused"] = {"verdict": "unmeasured"}
+
     return out
 
 

@@ -38,7 +38,7 @@ def lm_leg(name, extra, steps="30", timeout=900, env=None):
                 if TOKS.search(out) else None)}
 
 
-def json_leg(name, cmd, timeout=900):
+def json_leg(name, cmd, timeout=900, env=None):
     def parse(out):
         for line in reversed(out.strip().splitlines()):
             if line.startswith("{"):
@@ -47,7 +47,8 @@ def json_leg(name, cmd, timeout=900):
                 except ValueError:
                     continue
         return None
-    return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse}
+    return {"name": name, "cmd": cmd, "timeout": timeout, "parse": parse,
+            "env": env}
 
 
 def jsonl_leg(name, cmd, timeout=900, expect=None):
@@ -91,6 +92,17 @@ LEGS = [
     # Refresh the headline bench FIRST (also writes .bench_last_good.json).
     json_leg("resnet_bench_default",
              [PY, os.path.join(REPO, "bench.py")], timeout=1500),
+    # IMMEDIATELY after the default: the FULL bench with every eligible
+    # bottleneck 1x1 routed through the fused Pallas kernels
+    # (models/resnet.py _conv_bn) — adjacent legs give the tightest
+    # within-window e2e A/B; >=2% img/s flips HVDT_FUSED_CONV1X1.
+    json_leg("resnet_bench_fused",
+             [PY, os.path.join(REPO, "bench.py")], timeout=1500,
+             env={"HVDT_FUSED_CONV1X1": "1",
+                  # A/B probe, not the headline: do not overwrite the
+                  # last-good cache with the experimental config.
+                  "HVDT_BENCH_NO_CACHE": "1",
+                  "HVDT_BENCH_PROFILE": "0"}),
     # LM: reproduce the round-2/3 baseline.  (The no-remat legs are
     # ANSWERED — r4 measured OOM at batch>=32, tools/ab_results.json —
     # and removed; remat "full" is the only feasible bs128 config.)

@@ -180,11 +180,19 @@ def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
         # f32 upcast + square fuse into the reduction pass (reads bf16
         # from HBM, accumulates f32 — no materialized f32 copy).
         xf = x.astype(jnp.float32)
-        mean = xf.mean(axes)
-        var = (xf ** 2).mean(axes) - mean ** 2
         if cfg.bn_axis is not None:
-            mean = lax.pmean(mean, cfg.bn_axis)
-            var = lax.pmean(var, cfg.bn_axis)   # E[x²]−E[x]² form averages
+            # Sync the MOMENTS, then form the variance (the one shared
+            # implementation — sync_batch_norm.sync_batch_stats):
+            # pmean'ing per-device variances would drop the
+            # between-device mean-variance term, undershooting the
+            # exact global var by Var_devices(mean_d).
+            from ..sync_batch_norm import sync_batch_stats
+
+            mean, var = sync_batch_stats(xf, cfg.bn_axis,
+                                         reduction_axes=axes)
+        else:
+            mean = xf.mean(axes)
+            var = (xf ** 2).mean(axes) - mean ** 2
         m = cfg.bn_momentum
         new_s = {"mean": m * s["mean"] + (1 - m) * mean,
                  "var": m * s["var"] + (1 - m) * var}
@@ -205,15 +213,18 @@ def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
 
 
 def _fused_1x1_eligible(w, stride, cfg) -> bool:
-    """HVDT_FUSED_CONV1X1 gate: fused Pallas conv+BN only for 1x1
-    stride-1 convs with 128-lane-tiling output channels and LOCAL batch
-    stats (SyncBN's cross-device pmean would need psum'd partials —
-    fall back there)."""
+    """HVDT_FUSED_CONV1X1 gate: fused Pallas conv+BN for 1x1 stride-1
+    convs with 128-lane-tiling output channels.  SyncBN (cfg.bn_axis)
+    is supported — the kernel's per-device stat partials are psum'd
+    over the axis (ops/conv_fused.conv1x1_bn_train(axis=...))."""
     from ..common import config
 
-    kh, kw, _, cout = w.shape
+    kh, kw, cin, cout = w.shape
+    # cin gate too: K=64 lane tiles (stage-0 blocks, 64->256) are
+    # outside every probe-validated shape — keep them on XLA until a
+    # probe shape covers them.
     return (config.get_bool("HVDT_FUSED_CONV1X1") and kh == 1 and kw == 1
-            and stride == 1 and cfg.bn_axis is None and cout % 128 == 0)
+            and stride == 1 and cout % 128 == 0 and cin % 128 == 0)
 
 
 def _conv_bn(x, w, bn_p, bn_s, cfg, train, *, stride=1, relu=False):
@@ -227,7 +238,7 @@ def _conv_bn(x, w, bn_p, bn_s, cfg, train, *, stride=1, relu=False):
         if train:
             y, mean, var = conv1x1_bn_train(
                 x, w2, bn_p["scale"], bn_p["bias"], eps=cfg.bn_eps,
-                relu=relu)
+                relu=relu, axis=cfg.bn_axis)
             m = cfg.bn_momentum
             new_s = {"mean": m * bn_s["mean"] + (1 - m) * mean,
                      "var": m * bn_s["var"] + (1 - m) * var}

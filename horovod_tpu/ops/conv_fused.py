@@ -32,15 +32,36 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# Shared with the attention kernels: the interpret-mode switch and the
+# Shared with the attention kernels: the interpret-mode switch, the
 # dtype-aware block fitter (per-dtype sublane floors — bf16 needs 16
 # rows on real TPU; a hand-rolled 8-row check would pass interpret-mode
-# tests and then fail Mosaic lowering on hardware).
-from .pallas_kernels import _fit_block, _use_interpret
+# tests and then fail Mosaic lowering on hardware), and the
+# shard_map/check_vma out-shape helper.
+from .pallas_kernels import _fit_block, _use_interpret, _vma_kw
 
 __all__ = ["matmul_bn_relu", "conv1x1_bn_relu", "conv1x1_bn_relu_reference",
            "matmul_batch_stats", "conv1x1_bn_train",
            "conv1x1_bn_train_reference"]
+
+
+def _ct_to_primal_vma(ct, primal):
+    """psum a cotangent over the mesh axes its PRIMAL does not vary on
+    (a replicated weight meeting sharded activations): custom_vjp must
+    return cotangents with the primal's vma — the same psum XLA's
+    autodiff inserts when transposing the implicit broadcast."""
+    extra = tuple(set(getattr(jax.typeof(ct), "vma", frozenset()))
+                  - set(getattr(jax.typeof(primal), "vma", frozenset())))
+    return jax.lax.psum(ct, extra) if extra else ct
+
+
+def _vma_align(*ops):
+    """Promote every operand to the union of the group's varying
+    manual axes — dot_general (and the interpret-mode kernel body)
+    require matching vma, and replicated params meeting dp-sharded
+    activations inside shard_map don't match without this."""
+    from ..parallel.sharding import pcast_to_union
+
+    return tuple(pcast_to_union(op, *ops) for op in ops)
 
 
 def _fit_lanes(n: int, block_n: int) -> int:
@@ -75,15 +96,23 @@ def _tpu_params() -> dict:
 def _mm_kernel(a_ref, w_ref, s_ref, b_ref, o_ref, acc_ref, *, relu: bool):
     """Grid program (i, j, k): accumulate one K-block into the f32 VMEM
     accumulator; on the last K step apply the BN affine (+ReLU) and make
-    the ONLY HBM write of this output tile."""
+    the ONLY HBM write of this output tile.
+
+    First-k WRITES the accumulator (no zero-init: an unvarying zeros
+    tile added to a shard_map-varying dot fails check_vma in interpret
+    mode)."""
     import jax.experimental.pallas as pl
 
-    @pl.when(pl.program_id(2) == 0)
-    def _zero():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+    part = jnp.dot(a_ref[...], w_ref[...],
+                   preferred_element_type=jnp.float32)
 
-    acc_ref[...] += jnp.dot(a_ref[...], w_ref[...],
-                            preferred_element_type=jnp.float32)
+    @pl.when(pl.program_id(2) == 0)
+    def _first():
+        acc_ref[...] = part
+
+    @pl.when(pl.program_id(2) > 0)
+    def _accumulate():
+        acc_ref[...] += part
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _epilogue():
@@ -129,6 +158,7 @@ def _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k):
     bk = _fit_block(k, block_k, a.dtype, w.dtype)
     bn = _fit_lanes(n, block_n)
     grid = (m // bm, n // bn, k // bk)
+    a, w, scale, bias = _vma_align(a, w, scale, bias)
 
     return pl.pallas_call(
         functools.partial(_mm_kernel, relu=relu),
@@ -140,7 +170,8 @@ def _mm_forward(a, w, scale, bias, relu, block_m, block_n, block_k):
             pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype),
+        out_shape=jax.ShapeDtypeStruct((m, n), a.dtype,
+                                       **_vma_kw(a, w, scale, bias)),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=_use_interpret(),
         **_tpu_params(),
@@ -189,7 +220,9 @@ def _mm_diff_bwd(relu, block_m, block_n, block_k, res, dy):
     dbias = g.sum(axis=0).astype(bias.dtype)
     z = jnp.dot(a, w, preferred_element_type=f32)
     dscale = (g * z).sum(axis=0).astype(scale.dtype)
-    return da, dw, dscale, dbias
+    return (_ct_to_primal_vma(da, a), _ct_to_primal_vma(dw, w),
+            _ct_to_primal_vma(dscale, scale),
+            _ct_to_primal_vma(dbias, bias))
 
 
 _mm_diff.defvjp(_mm_diff_fwd, _mm_diff_bwd)
@@ -231,12 +264,16 @@ def conv1x1_bn_relu_reference(x, w, scale, bias, *, relu=True):
 def _mm_stats_kernel(a_ref, w_ref, o_ref, s1_ref, s2_ref, acc_ref):
     import jax.experimental.pallas as pl
 
-    @pl.when(pl.program_id(2) == 0)
-    def _zero():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+    part = jnp.dot(a_ref[...], w_ref[...],
+                   preferred_element_type=jnp.float32)
 
-    acc_ref[...] += jnp.dot(a_ref[...], w_ref[...],
-                            preferred_element_type=jnp.float32)
+    @pl.when(pl.program_id(2) == 0)
+    def _first():
+        acc_ref[...] = part
+
+    @pl.when(pl.program_id(2) > 0)
+    def _accumulate():
+        acc_ref[...] += part
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _emit():
@@ -263,6 +300,7 @@ def matmul_batch_stats(a: jax.Array, w: jax.Array, *, block_m: int = 512,
     bk = _fit_block(k, block_k, a.dtype, w.dtype)
     bn = _fit_lanes(n, block_n)
     grid = (m // bm, n // bn, k // bk)
+    a, w = _vma_align(a, w)
 
     stat_spec = pl.BlockSpec((1, bn), lambda i, j, kk: (i, j))
     return pl.pallas_call(
@@ -274,9 +312,12 @@ def matmul_batch_stats(a: jax.Array, w: jax.Array, *, block_m: int = 512,
         ],
         out_specs=[pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
                    stat_spec, stat_spec],
-        out_shape=(jax.ShapeDtypeStruct((m, n), a.dtype),
-                   jax.ShapeDtypeStruct((m // bm, n), jnp.float32),
-                   jax.ShapeDtypeStruct((m // bm, n), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct((m, n), a.dtype,
+                                        **_vma_kw(a, w)),
+                   jax.ShapeDtypeStruct((m // bm, n), jnp.float32,
+                                        **_vma_kw(a, w)),
+                   jax.ShapeDtypeStruct((m // bm, n), jnp.float32,
+                                        **_vma_kw(a, w))),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=_use_interpret(),
         **_tpu_params(),
@@ -285,12 +326,19 @@ def matmul_batch_stats(a: jax.Array, w: jax.Array, *, block_m: int = 512,
 
 def conv1x1_bn_train(x: jax.Array, w: jax.Array, gamma: jax.Array,
                      beta: jax.Array, *, eps: float = 1e-5,
-                     relu: bool = True):
+                     relu: bool = True, axis: Optional[str] = None):
     """Fused NHWC 1x1 conv + TRAIN-mode BN (+ReLU): batch statistics
     come from the kernel's partial sums; the normalize (+scale/shift/
     relu) is the only re-read of z and XLA fuses it into one pass.
     Returns ``(y, batch_mean, batch_var)`` — mean/var feed the caller's
     running-stat update exactly like models/resnet.py _batch_norm.
+
+    ``axis``: SyncBatchNorm — statistics are computed over the GLOBAL
+    batch by ``lax.psum`` of the per-device partial sums (the ragged
+    reduction is [devices, N] numbers, not activations).  Must be
+    called under shard_map with that mesh axis bound; the backward's
+    batch-mean terms use the same cross-device means, so gradients
+    match autodiff through the synced unfused path.
 
     Differentiable (``custom_vjp``): the standard batch-stat BN
     backward with z recomputed (bf16 operands, f32 accumulation) —
@@ -304,22 +352,36 @@ def conv1x1_bn_train(x: jax.Array, w: jax.Array, gamma: jax.Array,
         raise ValueError(
             f"gamma/beta must be [{cout}], got {gamma.shape}/{beta.shape}")
     y2d, mean, var = _train_diff(x.reshape(b * h * wd, cin), w, gamma,
-                                 beta, float(eps), relu)
+                                 beta, float(eps), relu, axis)
     return y2d.reshape(b, h, wd, cout), mean, var
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _train_diff(a, w, gamma, beta, eps, relu):
-    y, mean, var, _ = _train_forward(a, w, gamma, beta, eps, relu)
+def _global_m(m: int, axis: Optional[str]):
+    return m * jax.lax.axis_size(axis) if axis else m
+
+
+def _axis_mean(v, axis: Optional[str]):
+    """Mean over the local M rows, then over the sync axis if set."""
+    out = v.mean(axis=0)
+    return jax.lax.pmean(out, axis) if axis else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _train_diff(a, w, gamma, beta, eps, relu, axis):
+    y, mean, var, _ = _train_forward(a, w, gamma, beta, eps, relu, axis)
     return y, mean, var
 
 
-def _train_forward(a, w, gamma, beta, eps, relu):
-    m = a.shape[0]
+def _train_forward(a, w, gamma, beta, eps, relu, axis):
+    mg = _global_m(a.shape[0], axis)
     z, s1, s2 = matmul_batch_stats(a, w)
     f32 = jnp.float32
-    mean = s1.sum(axis=0) / m
-    var = jnp.maximum(s2.sum(axis=0) / m - mean * mean, 0.0)
+    s1t, s2t = s1.sum(axis=0), s2.sum(axis=0)
+    if axis:
+        s1t = jax.lax.psum(s1t, axis)
+        s2t = jax.lax.psum(s2t, axis)
+    mean = s1t / mg
+    var = jnp.maximum(s2t / mg - mean * mean, 0.0)
     scale = gamma.astype(f32) * jax.lax.rsqrt(var + eps)
     bias = beta.astype(f32) - mean * scale
     y = z.astype(f32) * scale + bias
@@ -328,25 +390,27 @@ def _train_forward(a, w, gamma, beta, eps, relu):
     return y.astype(a.dtype), mean, var, z
 
 
-def _train_diff_fwd(a, w, gamma, beta, eps, relu):
-    y, mean, var, _ = _train_forward(a, w, gamma, beta, eps, relu)
+def _train_diff_fwd(a, w, gamma, beta, eps, relu, axis):
+    y, mean, var, _ = _train_forward(a, w, gamma, beta, eps, relu, axis)
     # z is recomputed in the backward (remat); y feeds only the relu
     # mask; mean/var are [N] — negligible residuals.
     return (y, mean, var), (a, w, gamma, beta, mean, var,
                             y if relu else None)
 
 
-def _train_diff_bwd(eps, relu, res, cts):
+def _train_diff_bwd(eps, relu, axis, res, cts):
     """Batch-stat BN backward.  With inv = rsqrt(var+eps) and
     zhat = (z-mean)*inv:  g = dy*1[y>0]; dbeta = sum g;
     dgamma = sum g*zhat; dzhat = g*gamma;
-    dz = inv*(dzhat - mean_M(dzhat) - zhat*mean_M(dzhat*zhat));
+    dz = inv*(dzhat - mean_B(dzhat) - zhat*mean_B(dzhat*zhat))
+    where mean_B is the (optionally cross-device) batch mean;
     da = dz w^T; dw = a^T dz.  Cotangents on the mean/var outputs add
-    their direct paths (d mean/d z = 1/M; d var/d z = 2(z-mean)/M)."""
+    their direct paths (d mean/d z = 1/M_global;
+    d var/d z = 2(z-mean)/M_global)."""
     a, w, gamma, beta, mean, var, y = res
     dy, dmean_ct, dvar_ct = cts
     f32 = jnp.float32
-    m = a.shape[0]
+    mg = _global_m(a.shape[0], axis)
     g = dy.astype(f32)
     if relu:
         g = jnp.where(y.astype(f32) > 0, g, 0.0)
@@ -356,15 +420,20 @@ def _train_diff_bwd(eps, relu, res, cts):
     dbeta = g.sum(axis=0).astype(beta.dtype)
     dgamma = (g * zhat).sum(axis=0).astype(gamma.dtype)
     dzhat = g * gamma.astype(f32)
-    dz = inv * (dzhat - dzhat.mean(axis=0)
-                - zhat * (dzhat * zhat).mean(axis=0))
-    dz = dz + dmean_ct.astype(f32) / m
-    dz = dz + dvar_ct.astype(f32) * 2.0 * (z - mean) / m
+    dz = inv * (dzhat - _axis_mean(dzhat, axis)
+                - zhat * _axis_mean(dzhat * zhat, axis))
+    dz = dz + dmean_ct.astype(f32) / mg
+    dz = dz + dvar_ct.astype(f32) * 2.0 * (z - mean) / mg
     da = jnp.dot(dz.astype(a.dtype), w.T,
                  preferred_element_type=f32).astype(a.dtype)
     dw = jnp.dot(a.T, dz.astype(a.dtype),
                  preferred_element_type=f32).astype(w.dtype)
-    return da, dw, dgamma, dbeta
+    # Param cotangents reduce to their primals' vma (the psum XLA's
+    # autodiff inserts for the replicated-param broadcast) — identical
+    # totals to the synced unfused path.
+    return (_ct_to_primal_vma(da, a), _ct_to_primal_vma(dw, w),
+            _ct_to_primal_vma(dgamma, gamma),
+            _ct_to_primal_vma(dbeta, beta))
 
 
 _train_diff.defvjp(_train_diff_fwd, _train_diff_bwd)

@@ -45,6 +45,17 @@ def _use_interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+def _vma_kw(*ops) -> dict:
+    """``{"vma": ...}`` kwargs for pallas_call out_shapes: inside
+    shard_map (check_vma) out types must carry the varying-axes set, and
+    outputs vary over every axis any operand varies over.  Empty when no
+    operand varies (plain jit)."""
+    vma = frozenset()
+    for op in ops:
+        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
+    return {"vma": vma} if vma else {}
+
+
 def _fit_block(n: int, block: int, *dtypes) -> int:
     """Largest power-of-2 reduction of ``block`` that divides ``n`` (the
     defaults are tuned upper bounds, not divisibility requirements —
@@ -168,12 +179,7 @@ def _flash_call(q, k, v, acc, m, l, q_offset, k_offset, *, causal, scale,
                            lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
 
     kernel = functools.partial(_kernel, causal=causal, scale=scale)
-    # Inside shard_map (check_vma) out types must carry the varying-axes
-    # set; outputs vary over every axis any operand varies over.
-    vma = frozenset()
-    for op in (q, k, v, acc, m, l):
-        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
-    kw = {"vma": vma} if vma else {}
+    kw = _vma_kw(q, k, v, acc, m, l)
     out_shapes = (
         jax.ShapeDtypeStruct((b, h, lq, d), jnp.float32, **kw),
         jax.ShapeDtypeStruct((b, h, lq, 1), jnp.float32, **kw),
@@ -542,10 +548,7 @@ def flash_grad_block(q, k, v, do, out, lse, *, q_offset=0, k_offset=0,
     dl = delta[..., None]                                       # [B,H,Lq,1]
     lse_c = lse[..., None]                                      # [B,H,Lq,1]
 
-    vma = frozenset()
-    for op in (q, k, v, do, lse):
-        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
-    kw = {"vma": vma} if vma else {}
+    kw = _vma_kw(q, k, v, do, lse)
 
     qspec = pl.BlockSpec((1, 1, block_q, d),
                          lambda bb, hh, qq, kk, *_: (bb, hh, qq, 0))
@@ -732,10 +735,7 @@ def _smallseq_call(q, k, v, causal, scale, hb):
     hkv = k.shape[1]
     group = h // hkv
     hb_kv = hb // group
-    vma = frozenset()
-    for op in (q, k, v):
-        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
-    kw = {"vma": vma} if vma else {}
+    kw = _vma_kw(q, k, v)
     qspec = pl.BlockSpec((1, hb, l, d), lambda bb, hh: (bb, hh, 0, 0))
     kvspec = pl.BlockSpec((1, hb_kv, l, d), lambda bb, hh: (bb, hh, 0, 0))
     col = pl.BlockSpec((1, hb, l, 1), lambda bb, hh: (bb, hh, 0, 0))
@@ -774,10 +774,7 @@ def _smallseq_diff_bwd(causal, scale, hb, res, do):
     hb_kv = hb // group
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     dot = do.transpose(0, 2, 1, 3)
-    vma = frozenset()
-    for op in (q, k, v, do):
-        vma |= frozenset(getattr(jax.typeof(op), "vma", frozenset()))
-    kw = {"vma": vma} if vma else {}
+    kw = _vma_kw(q, k, v, do)
     qspec = pl.BlockSpec((1, hb, lq, d), lambda bb, hh: (bb, hh, 0, 0))
     kvspec = pl.BlockSpec((1, hb_kv, lq, d), lambda bb, hh: (bb, hh, 0, 0))
     col = pl.BlockSpec((1, hb, lq, 1), lambda bb, hh: (bb, hh, 0, 0))

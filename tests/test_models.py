@@ -266,23 +266,79 @@ class TestFusedConv1x1:
                                        np.asarray(va),
                                        rtol=2e-3, atol=1e-4, err_msg=k)
 
-    def test_sync_bn_config_falls_back(self, monkeypatch):
-        """bn_axis set -> fused path must NOT engage (local-stat kernel
-        would silently skip the cross-device pmean)."""
+    def test_eligibility_gate(self, monkeypatch):
         from horovod_tpu.models import resnet as rn
 
         monkeypatch.setenv("HVDT_FUSED_CONV1X1", "1")
-        cfg = rn.ResNetConfig(num_classes=4, dtype=jnp.float32,
-                              bn_axis="dp")
-        w = jnp.zeros((1, 1, 128, 128))
-        assert not rn._fused_1x1_eligible(w, 1, cfg)
         cfg_ok = rn.ResNetConfig(num_classes=4, dtype=jnp.float32)
+        w = jnp.zeros((1, 1, 128, 128))
         assert rn._fused_1x1_eligible(w, 1, cfg_ok)
+        # SyncBN is eligible too (psum'd stat partials)
+        assert rn._fused_1x1_eligible(
+            w, 1, rn.ResNetConfig(num_classes=4, dtype=jnp.float32,
+                                  bn_axis="dp"))
         assert not rn._fused_1x1_eligible(w, 2, cfg_ok)
         assert not rn._fused_1x1_eligible(
             jnp.zeros((3, 3, 128, 128)), 1, cfg_ok)
         assert not rn._fused_1x1_eligible(
             jnp.zeros((1, 1, 128, 64)), 1, cfg_ok)
+        # stage-0 shapes (Cin=64) are outside the probe-validated set
+        assert not rn._fused_1x1_eligible(
+            jnp.zeros((1, 1, 64, 256)), 1, cfg_ok)
+        monkeypatch.delenv("HVDT_FUSED_CONV1X1")
+        assert not rn._fused_1x1_eligible(w, 1, cfg_ok)
+
+    def test_sync_bn_fused_matches_unfused(self, monkeypatch):
+        """SyncBN under dp2 shard_map: the fused kernel's psum'd stat
+        partials must reproduce the unfused synced path — forward,
+        running stats, and parameter grads."""
+        from functools import partial
+
+        from horovod_tpu.models import resnet as rn
+        from horovod_tpu.parallel import make_mesh
+
+        rn_, cfg, p, s, _ = self._bottleneck_setup()
+        cfg = rn.ResNetConfig(num_classes=10, dtype=jnp.float32,
+                              bn_axis="dp")
+        x = jax.random.normal(jax.random.PRNGKey(9), (4, 8, 8, 128),
+                              cfg.dtype)
+        mesh = make_mesh(dp=2, devices=jax.devices()[:2])
+
+        def sharded_loss_and_stats(p):
+            def local(p, xx):
+                y, out_s = rn._bottleneck(xx, p, s, cfg, True, 1)
+                from jax import lax
+
+                return (lax.pmean(jnp.mean(y.astype(jnp.float32) ** 2),
+                                  "dp"), out_s)
+
+            loss, out_s = jax.shard_map(
+                local, mesh=mesh, in_specs=(P(), P("dp")),
+                out_specs=(P(), P()))(p, x)
+            return loss, out_s
+
+        def run(p):
+            (l, out_s), g = jax.value_and_grad(
+                lambda p: sharded_loss_and_stats(p), has_aux=True)(p)
+            return l, out_s, g
+
+        monkeypatch.delenv("HVDT_FUSED_CONV1X1", raising=False)
+        l_ref, s_ref, g_ref = run(p)
+        monkeypatch.setenv("HVDT_FUSED_CONV1X1", "1")
+        l_fused, s_fused, g_fused = run(p)
+        np.testing.assert_allclose(float(l_fused), float(l_ref),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(s_fused["bn1"]["mean"]),
+            np.asarray(s_ref["bn1"]["mean"]), rtol=1e-5, atol=1e-6)
+        ref_flat = {jax.tree_util.keystr(k): v for k, v in
+                    jax.tree_util.tree_leaves_with_path(g_ref)}
+        fused_flat = {jax.tree_util.keystr(k): v for k, v in
+                      jax.tree_util.tree_leaves_with_path(g_fused)}
+        for k, va in ref_flat.items():
+            np.testing.assert_allclose(np.asarray(fused_flat[k]),
+                                       np.asarray(va),
+                                       rtol=2e-3, atol=1e-5, err_msg=k)
 
 
 class TestMLP:

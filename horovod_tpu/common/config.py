@@ -108,7 +108,8 @@ KNOBS: Dict[str, Knob] = {
            "fuses the folded affine into the matmul epilogue.  Default "
            "OFF pending the TPU A/B (tools/tpu_ab.py resnet_bench_fused "
            "leg) — an unmeasured kernel is not a default.  Eligibility: "
-           "1x1, stride 1, bn_axis=None, Cout % 128 == 0."),
+           "1x1, stride 1, Cin % 128 == 0 AND Cout % 128 == 0 (SyncBN "
+           "via psum'd stat partials when bn_axis is set)."),
         _k("HVDT_FLASH_BWD", "xla", str,
            "flash_attention backward: xla (blockwise XLA recompute) or "
            "kernel (Pallas flash_grad_block passes). Read at TRACE time "

@@ -1,0 +1,55 @@
+"""tools/resnet_probe.py — the harness that decides the fused-conv
+levers must itself be bitrot-proof: both forms run end to end on tiny
+shapes, gate correctness, and emit the JSON contract ab_decide reads."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+probe = importlib.import_module("tools.resnet_probe")
+
+
+def _last_json(capsys):
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_affine_form_contract(capsys):
+    probe.run_shape("tiny", 2, 4, 4, 256, 128, iters=1)
+    d = _last_json(capsys)
+    assert d["metric"] == "resnet_1x1_bn_probe"
+    assert d["correctness_ok"] is True
+    assert d["platform"] == "cpu"           # suite runs interpret mode
+    for key in ("xla_conv_ms", "xla_matmul_ms", "pallas_ms",
+                "pallas_vs_conv", "matmul_vs_conv", "min_traffic_mb"):
+        assert key in d, key
+    assert d["m_k_n"] == [2 * 4 * 4, 256, 128]
+
+
+def test_train_form_contract(capsys):
+    probe.run_shape_train("tiny", 2, 4, 4, 256, 128, iters=1)
+    d = _last_json(capsys)
+    assert d["metric"] == "resnet_1x1_bn_train_probe"
+    assert d["correctness_ok"] is True
+    for key in ("xla_train_ms", "pallas_train_ms", "pallas_vs_conv"):
+        assert key in d, key
+
+
+def test_correctness_gate_blocks_timing(capsys, monkeypatch):
+    """A wrong kernel must not publish a speedup: break the kernel and
+    the pallas timing keys must vanish while the row still records the
+    failure."""
+    orig = probe.conv1x1_bn_relu
+    monkeypatch.setattr(
+        probe, "conv1x1_bn_relu",
+        lambda x, w, s, b, **kw: orig(x, w, s + 1.0, b, **kw))
+    probe.run_shape("tiny", 2, 4, 4, 256, 128, iters=1)
+    d = _last_json(capsys)
+    assert d["correctness_ok"] is False
+    assert "pallas_ms" not in d
+    assert "pallas_vs_conv" not in d
+    assert "xla_conv_ms" in d               # baselines still recorded

@@ -47,7 +47,7 @@ def _save_last_good(line: str) -> None:
         d = json.loads(line)
         if d.get("platform") in (None, "cpu"):
             return
-        if d.get("steps_per_call"):
+        if d.get("steps_per_call") or d.get("fused_optimizer"):
             # A/B probe variants are not the headline metric — caching
             # one would contaminate the outage-fallback evidence.
             return
@@ -99,6 +99,13 @@ def _parse_args(argv=None):
                     help=">1: run N steps inside one jit via lax.fori_loop "
                          "(removes per-call dispatch gaps; A/B probe for "
                          "the non-conv overlap question, VERDICT r3 #4)")
+    ap.add_argument("--fused-optimizer", action="store_true",
+                    help="A/B leg: run the SGD-momentum update through "
+                         "the fused Pallas optimizer kernels "
+                         "(ops/optim_kernels.fused_sgd) instead of stock "
+                         "optax — one HBM pass per eligible parameter. "
+                         "Default off pending the TPU A/B; the leg is "
+                         "kept out of the last-good headline cache.")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -112,14 +119,31 @@ def _run_child(args) -> None:
     import numpy as np
 
     from horovod_tpu.models import ResNetConfig, resnet50_init, resnet_loss
+    from horovod_tpu.step_pipeline import (donated_step,
+                                           enable_compilation_cache)
+
+    # Persistent XLA compilation cache: default to a repo-local dir so
+    # the second invocation of the same program skips the ~15 s compile
+    # entirely (HVDT_COMPILATION_CACHE=off opts out).
+    os.environ.setdefault(
+        "HVDT_COMPILATION_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".xla_cache"))
+    cache_dir = enable_compilation_cache()
 
     dev = jax.devices()[0]
-    print(f"benchmarking on {dev.platform}:{dev.device_kind}",
+    print(f"benchmarking on {dev.platform}:{dev.device_kind}"
+          + (f" (compile cache: {cache_dir})" if cache_dir else ""),
           file=sys.stderr)
 
     cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16)
     params, stats = resnet50_init(jax.random.PRNGKey(0), cfg)
-    opt = optax.sgd(0.01, momentum=0.9)
+    if args.fused_optimizer:
+        from horovod_tpu.ops.optim_kernels import fused_sgd
+
+        opt = fused_sgd(0.01, momentum=0.9)
+    else:
+        opt = optax.sgd(0.01, momentum=0.9)
     opt_state = opt.init(params)
 
     images = jax.random.normal(
@@ -148,11 +172,12 @@ def _run_child(args) -> None:
             return lax.fori_loop(0, args.steps_per_call, body, init)
     else:
         step_fn = one_step
-    step = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+    step = donated_step(step_fn, donate_argnums=(0, 1, 2))
 
     t0 = time.perf_counter()
     compiled = step.lower(params, stats, opt_state, images, labels).compile()
-    print(f"compile: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    compile_s = time.perf_counter() - t0
+    print(f"compile: {compile_s:.1f}s", file=sys.stderr)
     try:
         cost = compiled.cost_analysis()
     except Exception:
@@ -168,8 +193,10 @@ def _run_child(args) -> None:
     # future XLA starts multiplying by trip count, the reported flops jump
     # ~steps_per_call-fold and we rescale rather than inflate MFU.
     analytic_flops = 3 * 4.1e9 * args.batch_size
+    flops_pre_rescale = None
     try:
         flops_per_step = float(cost["flops"])
+        flops_pre_rescale = flops_per_step
         if args.steps_per_call > 1 and flops_per_step > 2 * analytic_flops:
             rescaled = flops_per_step / args.steps_per_call
             if rescaled <= 2 * analytic_flops:
@@ -264,6 +291,14 @@ def _run_child(args) -> None:
         "hbm_util_est_upper": (round(est_upper, 4)
                                if est_upper is not None else None),
         "batch_size": args.batch_size,
+        "compile_s": round(compile_s, 2),
+        # Auditability of the trip-count rescale heuristic (ADVICE r5):
+        # the raw cost-analysis flops ride along, so a wrong rescale is
+        # visible from the results file, not just stderr.
+        "flops_per_step": flops_per_step,
+        "flops_pre_rescale": flops_pre_rescale,
+        **({"compile_cache": cache_dir} if cache_dir else {}),
+        **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
            if args.steps_per_call != 1 else {}),
     }))
@@ -339,7 +374,8 @@ def main() -> None:
             "--num-iters", str(args.num_iters),
             "--num-batches-per-iter", str(args.num_batches_per_iter),
             "--num-warmup", str(args.num_warmup),
-            "--steps-per-call", str(args.steps_per_call)]
+            "--steps-per-call", str(args.steps_per_call)] \
+        + (["--fused-optimizer"] if args.fused_optimizer else [])
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

@@ -2,8 +2,19 @@
 # Emit the docker-compose test matrix as one runnable command per
 # service (ref: .buildkite/gen-pipeline.sh — the reference generates its
 # Buildkite pipeline the same way).  Usage: ci/gen-matrix.sh | sh -x
+#
+#   ci/gen-matrix.sh --smoke   emit only the fast smoke service
+#       (compileall + optimizer-kernel tests on CPU) — the pre-merge gate.
 set -eu
+only=""
+if [ "${1:-}" = "--smoke" ]; then
+  only="test-smoke"
+  shift
+fi
 compose=${1:-docker-compose.test.yml}
 for svc in $(sed -n 's/^  \([a-z0-9-]*\):$/\1/p' "$compose"); do
+  if [ -n "$only" ] && [ "$svc" != "$only" ]; then
+    continue
+  fi
   echo "docker compose -f $compose run --rm $svc"
 done

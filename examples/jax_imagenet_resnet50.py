@@ -97,7 +97,10 @@ def main():
         new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, "dp"), new_stats)
         return params, new_stats, opt_state, jax.lax.pmean(loss, "dp")
 
-    step = jax.jit(jax.shard_map(
+    # donated_step: params/stats/opt-state buffers donated through the
+    # pipeline + the persistent compilation cache engaged when
+    # HVDT_COMPILATION_CACHE names a directory.
+    step = hvd.donated_step(jax.shard_map(
         local_step, mesh=mesh,
         in_specs=(P(), P(), P(), P("dp"), P("dp")),
         out_specs=(P(), P(), P(), P())),

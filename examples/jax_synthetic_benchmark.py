@@ -43,6 +43,12 @@ def parse_args(argv=None):
     p.add_argument("--autotune", action="store_true",
                    help="drive the fusion-knob autotuner from measured "
                         "step rates (ref: HOROVOD_AUTOTUNE)")
+    p.add_argument("--fused-optimizer", action="store_true",
+                   help="run the update through the fused Pallas "
+                        "optimizer kernels (hvd.fused_sgd) — one HBM "
+                        "pass per eligible parameter; also the starting "
+                        "point for the autotuner's fused dimension "
+                        "(HVDT_AUTOTUNE_FUSED_OPTIMIZER=1)")
     return p.parse_args(argv)
 
 
@@ -109,12 +115,26 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
         labels = jnp.zeros((global_batch,), jnp.int32)
         loss_fn = mlp_loss
 
-    def build_step(threshold_bytes=None):
+    def build_step(threshold_bytes=None, fused=None):
         """(Re-)jit the train step for a fusion-bucket threshold — the
         autotuner's 'apply' operation (thresholds are trace-time
-        constants under XLA)."""
+        constants under XLA).  ``fused`` picks the update lowering
+        (fused Pallas kernels vs stock optax) — the autotuner's second
+        A/B dimension."""
+        from horovod_tpu.step_pipeline import donated_step
+
+        if fused is not None:
+            # Autotuner-driven A/B: both legs use the fused
+            # transformation (use_kernels flips the lowering) so the
+            # opt-state structure survives mid-run knob changes.
+            inner = hvd.fused_sgd(0.01, momentum=0.9,
+                                  use_kernels=bool(fused))
+        elif args.fused_optimizer:
+            inner = hvd.fused_sgd(0.01, momentum=0.9)
+        else:
+            inner = optax.sgd(0.01, momentum=0.9)
         opt = hvd.DistributedOptimizer(
-            optax.sgd(0.01, momentum=0.9),
+            inner,
             op=hvd.Adasum if args.use_adasum else hvd.Average,
             compression=(hvd.Compression.bf16 if args.fp16_allreduce
                          else hvd.Compression.none),
@@ -128,9 +148,11 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
                 loss = jax.lax.pmean(loss, "dp")
             return optax.apply_updates(params, updates), opt_state, loss
 
+        # donated_step = jit + params/opt-state donation + the persistent
+        # compilation cache (env-transparent, HVDT_COMPILATION_CACHE).
         if not use_shard:
-            return opt, jax.jit(local_step, donate_argnums=(0, 1))
-        return opt, jax.jit(jax.shard_map(
+            return opt, donated_step(local_step, donate_argnums=(0, 1))
+        return opt, donated_step(jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(P(), P(), P("dp"), P() if labels is None else P("dp")),
             out_specs=(P(), P(), P())),
@@ -145,8 +167,8 @@ def measure(args, use_shard: bool, quiet: bool = False) -> float:
     # instance the live step closes over.
     built = {}
 
-    def builder(tb):
-        built["opt"], step_fn = build_step(tb)
+    def builder(tb, fused=None):
+        built["opt"], step_fn = build_step(tb, fused)
         return step_fn
 
     step = autotuned_step(builder, tree_example=params,

@@ -228,15 +228,18 @@ def main():
             params = optax.apply_updates(params, updates)
             return params, opt_state, lax.pmean(loss, "dp")
 
-        step = jax.jit(jax.shard_map(
+        # hvd.donated_step = jit + donation + the persistent compilation
+        # cache (env-transparent via HVDT_COMPILATION_CACHE).
+        step = hvd.donated_step(jax.shard_map(
             local_step, mesh=mesh,
             in_specs=(P(), P(), P("dp")),
             out_specs=(P(), P(), P())), donate_argnums=(0, 1))
     elif single:
-        step = jax.jit(make_step(lambda p, t: transformer_loss(p, t, cfg)),
-                       donate_argnums=(0, 1))
+        step = hvd.donated_step(
+            make_step(lambda p, t: transformer_loss(p, t, cfg)),
+            donate_argnums=(0, 1))
     else:
-        step = jax.jit(make_step(island), donate_argnums=(0, 1))
+        step = hvd.donated_step(make_step(island), donate_argnums=(0, 1))
 
     rng = np.random.default_rng(0)
     tok_sharding = (None if single

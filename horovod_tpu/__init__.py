@@ -115,9 +115,20 @@ def __getattr__(name):
             from .runner import run
 
             return run
+        if name in ("fused_adam", "fused_sgd"):
+            # Fused Pallas optimizer kernels (single-HBM-pass updates;
+            # compose with DistributedOptimizer unchanged).
+            from .ops import optim_kernels
+
+            return getattr(optim_kernels, name)
+        if name in ("enable_compilation_cache", "donated_step"):
+            from . import step_pipeline as _sp
+
+            return getattr(_sp, name)
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
-                    "data", "native", "orchestrate", "interop"):
+                    "data", "native", "orchestrate", "interop",
+                    "step_pipeline"):
             import importlib
 
             return importlib.import_module(f".{name}", __name__)

@@ -162,13 +162,15 @@ class ParameterManager:
 
     LOG2_BUCKET_CANDIDATES = tuple(range(20, 29))     # 1 MiB .. 256 MiB
     OVERLAP_CANDIDATES = (1, 2, 4)
+    FUSED_OPTIMIZER_CANDIDATES = (0.0, 1.0)
 
     def __init__(self,
                  warmup_samples: Optional[int] = None,
                  steps_per_sample: Optional[int] = None,
                  max_samples: Optional[int] = None,
                  log_file: Optional[str] = None,
-                 noise: Optional[float] = None):
+                 noise: Optional[float] = None,
+                 tune_fused_optimizer: Optional[bool] = None):
         self.warmup = (warmup_samples if warmup_samples is not None
                        else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
         self.steps_per_sample = (
@@ -180,11 +182,26 @@ class ParameterManager:
         noise = (noise if noise is not None
                  else config.get_float("HVDT_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"))
         self._log_file = log_file or config.get_str("HVDT_AUTOTUNE_LOG") or None
-        grid = np.array([[b, o] for b in self.LOG2_BUCKET_CANDIDATES
-                         for o in self.OVERLAP_CANDIDATES], float)
+        # Optional third knob dimension: fused-vs-unfused optimizer
+        # kernels (ops/optim_kernels) — a 0/1 A/B the GP searches
+        # jointly with the comm knobs, since comm/compute overlap and
+        # the update's HBM footprint interact.
+        self.tune_fused = (
+            tune_fused_optimizer if tune_fused_optimizer is not None
+            else config.get_bool("HVDT_AUTOTUNE_FUSED_OPTIMIZER"))
+        if self.tune_fused:
+            grid = np.array(
+                [[b, o, f] for b in self.LOG2_BUCKET_CANDIDATES
+                 for o in self.OVERLAP_CANDIDATES
+                 for f in self.FUSED_OPTIMIZER_CANDIDATES], float)
+        else:
+            grid = np.array([[b, o] for b in self.LOG2_BUCKET_CANDIDATES
+                             for o in self.OVERLAP_CANDIDATES], float)
         self._bo = BayesianOptimizer(grid, noise=noise)
-        self._current = np.array(
-            [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0])
+        start = [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0]
+        if self.tune_fused:
+            start.append(float(config.get_bool("HVDT_FUSED_OPTIMIZER")))
+        self._current = np.array(start)
         self._sample = _Sample(self._current)
         self._samples_done = 0
         self._warmups_done = 0
@@ -199,6 +216,14 @@ class ParameterManager:
     @property
     def overlap_buckets(self) -> int:
         return int(self._current[1])
+
+    @property
+    def fused_optimizer(self) -> bool:
+        """Current fused-optimizer A/B choice; outside the tuned
+        dimension it reports the HVDT_FUSED_OPTIMIZER default."""
+        if self.tune_fused:
+            return bool(self._current[2] >= 0.5)
+        return config.get_bool("HVDT_FUSED_OPTIMIZER")
 
     @property
     def tuning_complete(self) -> bool:
@@ -245,9 +270,10 @@ class ParameterManager:
             return
         try:
             with open(self._log_file, "a", newline="") as f:
-                csv.writer(f).writerow(
-                    [time.time(), int(2 ** s.point[0]), int(s.point[1]),
-                     f"{s.score:.1f}"])
+                row = [time.time(), int(2 ** s.point[0]), int(s.point[1])]
+                if len(s.point) > 2:
+                    row.append(int(s.point[2]))
+                csv.writer(f).writerow(row + [f"{s.score:.1f}"])
         except OSError as e:
             log.warning("autotune log write failed: %s", e)
 
@@ -333,8 +359,10 @@ class BenchmarkAutotuner:
 
     def summary(self) -> str:
         state = "converged" if self.done else "tuning"
+        fused = (f" fused_opt={int(self.pm.fused_optimizer)}"
+                 if self.pm.tune_fused else "")
         return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
-                f"overlap={self.pm.overlap_buckets} "
+                f"overlap={self.pm.overlap_buckets}{fused} "
                 f"({self.pm._samples_done} samples)")
 
 
@@ -368,8 +396,16 @@ class AutotunedStep:
     0's choice, and discards the first (compile-polluted) region after
     every rebuild.
 
+    With ``HVDT_AUTOTUNE_FUSED_OPTIMIZER=1`` the search space gains a
+    fused-vs-unfused optimizer dimension (ops/optim_kernels): a builder
+    that accepts a ``fused`` keyword is rebuilt as
+    ``builder(threshold_bytes, fused=bool)`` at each knob change, so the
+    GP prices the update-side kernels jointly with the comm bucketing.
+    Builders without the keyword keep the old call shape.
+
     Args:
-      builder: ``builder(threshold_bytes | None) -> step_callable``.
+      builder: ``builder(threshold_bytes | None) -> step_callable``
+        (optionally also accepting ``fused=bool``).
       tree_example: gradient-sized pytree for the bytes/sec score; when
         None, the first positional arg of the first call is used.
       enabled: force on/off; None (default) reads ``HVDT_AUTOTUNE``.
@@ -379,11 +415,29 @@ class AutotunedStep:
                  enabled: Optional[bool] = None,
                  steps_per_sample: Optional[int] = None,
                  control_plane=None):
+        import inspect
+
         if enabled is None:
             enabled = config.get_bool("HVDT_AUTOTUNE")
         self.enabled = bool(enabled)
         self._builder = builder
-        self._step = builder(None)
+        try:
+            sig = inspect.signature(builder).parameters
+            self._accepts_fused = ("fused" in sig or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.values()))
+        except (TypeError, ValueError):
+            self._accepts_fused = False
+        if (self.enabled and self._accepts_fused
+                and config.get_bool("HVDT_AUTOTUNE_FUSED_OPTIMIZER")):
+            # Pin the fused dimension's starting leg at build 0 so the
+            # opt-state structure established before tuning matches
+            # every later rebuild (the fused transformations keep one
+            # state tree across both legs — ops/optim_kernels).
+            self._step = builder(
+                None, fused=config.get_bool("HVDT_FUSED_OPTIMIZER"))
+        else:
+            self._step = builder(None)
         self._tree_example = tree_example
         self._steps_per_sample = steps_per_sample
         self._cp = control_plane
@@ -404,6 +458,15 @@ class AutotunedStep:
         if not self.enabled:
             return "autotune disabled (HVDT_AUTOTUNE not set)"
         return self._tuner.summary() if self._tuner else "no samples yet"
+
+    def _rebuild(self):
+        """Re-jit at the tuner's current knob point (fused dimension
+        forwarded only when both the tuner and the builder carry it)."""
+        pm = self._tuner.pm
+        if pm.tune_fused and self._accepts_fused:
+            return self._builder(self._tuner.bucket_bytes,
+                                 fused=pm.fused_optimizer)
+        return self._builder(self._tuner.bucket_bytes)
 
     @staticmethod
     def _fetch(out) -> None:
@@ -443,7 +506,7 @@ class AutotunedStep:
                 # new point's score — discard, measure the next region.
                 self._skip_sample = False
             elif self._tuner.record(dt, steps=self._pending):
-                self._step = self._builder(self._tuner.bucket_bytes)
+                self._step = self._rebuild()
                 self._skip_sample = True
                 log.info("autotune applied: bucket=%d MiB",
                          self._tuner.bucket_bytes // 2 ** 20)

@@ -183,6 +183,13 @@ def init(
             log.debug("init() called twice; ignoring")
             return
 
+        # Persistent XLA compilation cache (HVDT_COMPILATION_CACHE):
+        # engage before anything compiles, so launcher-forwarded env
+        # (hvdtrun --compilation-cache-dir) takes effect in every worker.
+        from ..step_pipeline import enable_compilation_cache
+
+        enable_compilation_cache()
+
         env_size = config.get_int("HVDT_SIZE")
         env_rank = config.get_int("HVDT_RANK")
         coord = coordinator_address or config.get_str("HVDT_COORDINATOR_ADDR")

@@ -68,6 +68,11 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_AUTOTUNE_STEPS_PER_SAMPLE", 10, int, "Steps per autotune sample."),
         _k("HVDT_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20, int, "Max BO samples."),
         _k("HVDT_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8, float, "GP noise alpha."),
+        _k("HVDT_AUTOTUNE_FUSED_OPTIMIZER", False, _parse_bool,
+           "Add a fused-vs-unfused optimizer dimension (0/1) to the "
+           "autotune search space; the step builder is then rebuilt "
+           "with fused=... at each knob change (autotune.AutotunedStep). "
+           "Starting point comes from HVDT_FUSED_OPTIMIZER."),
         # --- timeline (ref: HOROVOD_TIMELINE common.h:110) ---
         _k("HVDT_TIMELINE", "", str,
            "Write per-tensor Chrome-tracing timeline JSON to this path."),
@@ -118,6 +123,21 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_RING_PALLAS", False, _parse_bool,
            "Run ring attention's per-step block update and backward "
            "through the Pallas kernels (when shapes tile)."),
+        _k("HVDT_FUSED_OPTIMIZER", False, _parse_bool,
+           "Route optimizer updates through the fused Pallas kernels "
+           "(ops/optim_kernels.fused_adam/fused_sgd) where leaves are "
+           "tile-eligible; ineligible leaves fall back to the identical "
+           "XLA math.  Default OFF pending the TPU A/B (bench.py "
+           "--fused-optimizer exports this; the autotuner's fused "
+           "dimension reads it as the starting point)."),
+        # --- step pipeline ---
+        _k("HVDT_COMPILATION_CACHE", "", str,
+           "Directory for JAX's persistent XLA compilation cache "
+           "(step_pipeline.enable_compilation_cache; engaged inside "
+           "hvd.init() and by bench.py).  Empty/off = disabled."),
+        _k("HVDT_COMPILATION_CACHE_MIN_COMPILE_SECS", 1.0, float,
+           "Only persist compilations at least this expensive — keeps "
+           "the multi-second train steps, skips trivial helper jits."),
         # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
         #     LibType selection env_parser.cc) ---
         _k("HVDT_CPU_OPERATIONS", "xla", str,

@@ -212,14 +212,26 @@ def _batch_norm(x, p, s, cfg: ResNetConfig, train: bool):
     return x * a + b, new_s
 
 
-def _fused_1x1_eligible(w, stride, cfg) -> bool:
+def _fused_1x1_eligible(w, stride, cfg, x=None) -> bool:
     """HVDT_FUSED_CONV1X1 gate: fused Pallas conv+BN for 1x1 stride-1
     convs with 128-lane-tiling output channels.  SyncBN (cfg.bn_axis)
     is supported — the kernel's per-device stat partials are psum'd
-    over the axis (ops/conv_fused.conv1x1_bn_train(axis=...))."""
+    over the axis (ops/conv_fused.conv1x1_bn_train(axis=...)).
+
+    When ``x`` is given, also gate on the matmul's M = B*H*W rows
+    tiling: the kernel's row blocks must clear the per-dtype sublane
+    floor (8 rows f32 / 16 bf16 / 32 one-byte), so an M whose largest
+    power-of-2 divisor is smaller (e.g. batch 1 at 14x14 → M=196 → 4)
+    falls back to the XLA conv path instead of crashing in
+    ops/conv_fused._fit_block at trace time (ADVICE r5)."""
     from ..common import config
 
     kh, kw, cin, cout = w.shape
+    if x is not None:
+        m = x.shape[0] * x.shape[1] * x.shape[2]
+        floor = {4: 8, 2: 16, 1: 32}.get(jnp.dtype(x.dtype).itemsize, 8)
+        if (m & -m) < floor:     # largest power-of-2 divisor of M
+            return False
     # cin gate too: K=64 lane tiles (stage-0 blocks, 64->256) are
     # outside every probe-validated shape — keep them on XLA until a
     # probe shape covers them.
@@ -230,8 +242,12 @@ def _fused_1x1_eligible(w, stride, cfg) -> bool:
 def _conv_bn(x, w, bn_p, bn_s, cfg, train, *, stride=1, relu=False):
     """conv + BN (+ReLU) — one call site shape for both the XLA path
     and the fused Pallas path (ops/conv_fused.py), so the A/B differs
-    ONLY in lowering.  Returns (y, new_bn_stats)."""
-    if _fused_1x1_eligible(w, stride, cfg):
+    only in lowering.  One documented exception to exact gradient
+    equality: the fused kernel uses relu'(0)=0 while jnp.maximum's
+    autodiff splits the tie at 0.5, so units with EXACTLY zero
+    pre-activation (measure zero under random inputs) get different
+    subgradients.  Returns (y, new_bn_stats)."""
+    if _fused_1x1_eligible(w, stride, cfg, x):
         from ..ops.conv_fused import conv1x1_bn_relu, conv1x1_bn_train
 
         w2 = w.reshape(w.shape[2], w.shape[3]).astype(x.dtype)

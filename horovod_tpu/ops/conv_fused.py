@@ -49,8 +49,11 @@ def _ct_to_primal_vma(ct, primal):
     (a replicated weight meeting sharded activations): custom_vjp must
     return cotangents with the primal's vma — the same psum XLA's
     autodiff inserts when transposing the implicit broadcast."""
-    extra = tuple(set(getattr(jax.typeof(ct), "vma", frozenset()))
-                  - set(getattr(jax.typeof(primal), "vma", frozenset())))
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:      # JAX without vma tracking: nothing to reduce
+        return ct
+    extra = tuple(set(getattr(typeof(ct), "vma", frozenset()))
+                  - set(getattr(typeof(primal), "vma", frozenset())))
     return jax.lax.psum(ct, extra) if extra else ct
 
 

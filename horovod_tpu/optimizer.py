@@ -232,6 +232,12 @@ def DistributedOptimizer(optimizer,
         opt = hvd.DistributedOptimizer(optax.adam(1e-3))
         updates, opt_state = opt.update(grads, opt_state, params)
 
+    The update side composes with the fused Pallas optimizer kernels
+    unchanged — ``hvd.DistributedOptimizer(hvd.fused_adam(1e-3))`` runs
+    the comm chain into a single-HBM-pass Adam update
+    (ops/optim_kernels.py; ineligible leaves fall back to identical XLA
+    math automatically).
+
     Args:
       optimizer: the optax GradientTransformation to wrap.
       axis: mesh axis to reduce over (data-parallel axis).

@@ -122,8 +122,11 @@ def pcast_to_union(x, *operands, extra=()):
     import jax
     from jax import lax
 
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:      # JAX without vma tracking: nothing to align
+        return x
     want = set(extra)
     for op in operands:
-        want |= set(getattr(jax.typeof(op), "vma", frozenset()))
-    missing = tuple(want - set(getattr(jax.typeof(x), "vma", frozenset())))
+        want |= set(getattr(typeof(op), "vma", frozenset()))
+    missing = tuple(want - set(getattr(typeof(x), "vma", frozenset())))
     return lax.pcast(x, missing, to="varying") if missing else x

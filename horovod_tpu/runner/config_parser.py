@@ -37,6 +37,7 @@ YAML shape (mirrors the reference's config sections)::
     library_options:
       cpu_operations: tcp
       tcp_port_stride: 128
+      compilation_cache_dir: /var/cache/hvdt-xla
     logging:
       level: info
       hide_timestamp: false
@@ -128,6 +129,12 @@ KNOB_FLAGS: List[_Flag] = [
     _Flag("--cpu-operations", "cpu_operations", "HVDT_CPU_OPERATIONS",
           "library_options", "cpu_operations",
           "Host-collective data plane: xla | tcp."),
+    _Flag("--compilation-cache-dir", "compilation_cache_dir",
+          "HVDT_COMPILATION_CACHE", "library_options",
+          "compilation_cache_dir",
+          "Persistent XLA compilation-cache directory for every worker "
+          "(engaged inside hvd.init(); amortizes the multi-second step "
+          "compile across runs)."),
     _Flag("--tcp-port-stride", "tcp_port_stride",
           "HVDT_TCP_SET_PORT_STRIDE", "library_options", "tcp_port_stride",
           "Port stride between process sets' TCP meshes.", type=int),

@@ -205,7 +205,11 @@ class TestResNet:
 class TestFusedConv1x1:
     """HVDT_FUSED_CONV1X1: the fused Pallas conv+BN route must be a
     pure lowering change — forward, grads, and running-stat updates
-    identical to the XLA path (models/resnet.py _conv_bn)."""
+    matching the XLA path (models/resnet.py _conv_bn) to numerical
+    tolerance.  One documented gradient-convention exception: the
+    fused kernel takes relu'(0)=0 where jnp.maximum's autodiff splits
+    the tie at 0.5 — exactly-zero pre-activations (measure zero under
+    the random inputs here) would differ."""
 
     def _bottleneck_setup(self):
         from horovod_tpu.models import resnet as rn
@@ -285,8 +289,40 @@ class TestFusedConv1x1:
         # stage-0 shapes (Cin=64) are outside the probe-validated set
         assert not rn._fused_1x1_eligible(
             jnp.zeros((1, 1, 64, 256)), 1, cfg_ok)
+        # M = B*H*W tiling gate (ADVICE r5): batch 1 at 14x14 → M=196,
+        # largest power-of-2 divisor 4 < the f32 sublane floor (8) —
+        # must fall back to the XLA path instead of crashing at trace.
+        assert not rn._fused_1x1_eligible(
+            w, 1, cfg_ok, jnp.zeros((1, 14, 14, 128), jnp.float32))
+        # bf16 floor is 16 rows: M=8·8·2=... use B2 H8 W8 → M=128, ok.
+        assert rn._fused_1x1_eligible(
+            w, 1, cfg_ok, jnp.zeros((2, 8, 8, 128), jnp.bfloat16))
+        # ...but M=8 (B2 H2 W2) tiles only to 8 < 16 for bf16.
+        assert not rn._fused_1x1_eligible(
+            w, 1, cfg_ok, jnp.zeros((2, 2, 2, 128), jnp.bfloat16))
         monkeypatch.delenv("HVDT_FUSED_CONV1X1")
         assert not rn._fused_1x1_eligible(w, 1, cfg_ok)
+
+    def test_odd_spatial_falls_back_not_crashes(self, monkeypatch):
+        """Batch 1 at 14x14 (M=196) with the flag ON must route through
+        the XLA conv path (ADVICE r5) — not raise at trace time."""
+        from horovod_tpu.models import resnet as rn
+
+        cfg = rn.ResNetConfig(num_classes=4, dtype=jnp.float32)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        w = rn._conv_init(k1, 1, 1, 128, 128, cfg.dtype)
+        p, s = rn._bn_init(128, cfg.dtype), rn._bn_stats(128)
+        x = jax.random.normal(k2, (1, 14, 14, 128), cfg.dtype)
+
+        monkeypatch.delenv("HVDT_FUSED_CONV1X1", raising=False)
+        y_ref, s_ref = rn._conv_bn(x, w, p, s, cfg, True, relu=True)
+        monkeypatch.setenv("HVDT_FUSED_CONV1X1", "1")
+        y, s_new = rn._conv_bn(x, w, p, s, cfg, True, relu=True)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(s_new["mean"]),
+                                   np.asarray(s_ref["mean"]),
+                                   rtol=1e-5, atol=1e-6)
 
     def test_sync_bn_fused_matches_unfused(self, monkeypatch):
         """SyncBN under dp2 shard_map: the fused kernel's psum'd stat

@@ -106,8 +106,107 @@ def _parse_args(argv=None):
                          "optax — one HBM pass per eligible parameter. "
                          "Default off pending the TPU A/B; the leg is "
                          "kept out of the last-good headline cache.")
+    ap.add_argument("--serve", action="store_true",
+                    help="Serving micro-benchmark instead of training: "
+                         "an in-process ModelServer (MLP, shape-bucketed "
+                         "engine + dynamic batcher) hammered over HTTP by "
+                         "--serve-threads clients; emits latency_p50_ms / "
+                         "latency_p99_ms / throughput_rps JSON alongside "
+                         "the training numbers.")
+    ap.add_argument("--serve-duration", type=float, default=5.0,
+                    help="Seconds of sustained client fire for --serve.")
+    ap.add_argument("--serve-threads", type=int, default=8,
+                    help="Concurrent HTTP client threads for --serve.")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
+
+
+def _run_serve_child(args) -> None:
+    """Serving micro-bench (child process): in-process ModelServer over
+    the example MLP, N concurrent HTTP clients firing mixed-size batches
+    for --serve-duration seconds.  Prints one JSON line with the serving
+    SLO metrics (p50/p99 latency, throughput, steady-state compiles)."""
+    import http.client
+    import threading
+
+    import jax
+    import numpy as np
+
+    from horovod_tpu.models.mlp import mlp_apply, mlp_init
+    from horovod_tpu.serve import InferenceEngine, ModelServer
+
+    dev = jax.devices()[0]
+    print(f"serve bench on {dev.platform}:{dev.device_kind}",
+          file=sys.stderr)
+    sizes = (784, 256, 128, 10)
+    buckets = (1, 8, 32)
+    params = mlp_init(jax.random.PRNGKey(0), sizes)
+    engine = InferenceEngine(mlp_apply, params, buckets=buckets)
+    server = ModelServer(engine, host="127.0.0.1", port=0,
+                         max_delay_ms=2.0, max_queue_depth=4096)
+    port = server.start()
+    engine.warmup((sizes[0],))
+    warm_compiles = engine.compile_count()
+
+    stop = threading.Event()
+    counts = [0] * args.serve_threads
+    errors = [0] * args.serve_threads
+
+    def client(i):
+        rng = np.random.default_rng(i)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        while not stop.is_set():
+            rows = 1 + (i + counts[i]) % 4
+            x = rng.normal(size=(rows, sizes[0])).astype(np.float32)
+            try:
+                conn.request("POST", "/predict",
+                             json.dumps({"inputs": x.tolist()}),
+                             {"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                if r.status == 200:
+                    counts[i] += 1
+                else:
+                    errors[i] += 1
+            except Exception:
+                errors[i] += 1
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=30)
+        conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(args.serve_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(args.serve_duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    dt = time.perf_counter() - t0
+    lat = server.metrics.summary("serve_request_latency_ms_predict")
+    pct = lat.percentiles()
+    ok = sum(counts)
+    server.stop()
+    print(json.dumps({
+        "metric": "serve_throughput_rps",
+        "value": round(ok / dt, 2),
+        "unit": "req/s",
+        "throughput_rps": round(ok / dt, 2),
+        "latency_p50_ms": (round(pct[0.5], 3)
+                           if pct[0.5] is not None else None),
+        "latency_p99_ms": (round(pct[0.99], 3)
+                           if pct[0.99] is not None else None),
+        "requests_ok": ok,
+        "requests_failed": sum(errors),
+        "clients": args.serve_threads,
+        "duration_s": round(dt, 2),
+        "buckets": list(buckets),
+        "steady_state_compiles": engine.compile_count() - warm_compiles,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+    }))
 
 
 def _run_child(args) -> None:
@@ -366,7 +465,30 @@ def _spawn(child_args, timeout_s, cpu_only=False):
 def main() -> None:
     args = _parse_args()
     if args._child:
-        _run_child(args)
+        if args.serve:
+            _run_serve_child(args)
+        else:
+            _run_child(args)
+        return
+
+    if args.serve:
+        # Serving micro-mode: one accelerator attempt, then a scrubbed
+        # CPU fallback.  Never touches the training last-good cache —
+        # different metric, different workload.
+        serve_args = ["--serve",
+                      "--serve-duration", str(args.serve_duration),
+                      "--serve-threads", str(args.serve_threads)]
+        timeout = int(os.environ.get("HVDT_BENCH_SERVE_TIMEOUT", "300"))
+        ok, line, note = _spawn(serve_args, timeout)
+        if not ok or not line:
+            print(f"serve bench attempt failed: {note}", file=sys.stderr)
+            ok, line, note = _spawn(serve_args, timeout, cpu_only=True)
+        if ok and line:
+            print(line)
+        else:
+            print(json.dumps({"metric": "serve_throughput_rps",
+                              "value": 0.0, "unit": "req/s",
+                              "error": note}))
         return
 
     base = ["--batch-size", str(args.batch_size),

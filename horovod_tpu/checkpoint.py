@@ -167,14 +167,31 @@ class CheckpointManager:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:012d}")
 
+    def step_path(self, step: int) -> str:
+        """Directory a given step is (or would be) stored at — the
+        discovery contract the serve-side reload watcher restores from
+        (serve/reload.py)."""
+        return self._step_dir(step)
+
     def all_steps(self):
+        """Sorted steps present on disk.  Only ``step_N`` *directories*
+        count: stray files, foreign names, and Orbax's in-progress tmp
+        dirs (``step_N.orbax-checkpoint-tmp-*`` et al. — anything whose
+        suffix isn't a bare int) are skipped, so a watcher polling during
+        a save never discovers a half-written checkpoint."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
         out = []
-        for name in os.listdir(self.directory):
+        for name in names:
             if name.startswith("step_"):
                 try:
-                    out.append(int(name[5:]))
+                    step = int(name[5:])
                 except ValueError:
-                    pass
+                    continue
+                if os.path.isdir(os.path.join(self.directory, name)):
+                    out.append(step)
         return sorted(out)
 
     def should_save(self, step: int) -> bool:

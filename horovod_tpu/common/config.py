@@ -138,6 +138,30 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_COMPILATION_CACHE_MIN_COMPILE_SECS", 1.0, float,
            "Only persist compilations at least this expensive — keeps "
            "the multi-second train steps, skips trivial helper jits."),
+        # --- serving (horovod_tpu/serve: engine, batcher, HTTP front end,
+        #     hot reload — no reference analog; the inference workload) ---
+        _k("HVDT_SERVE_HOST", "127.0.0.1", str,
+           "Bind address for the serving HTTP front end."),
+        _k("HVDT_SERVE_PORT", 8000, int,
+           "Bind port for the serving HTTP front end (0 = ephemeral)."),
+        _k("HVDT_SERVE_BUCKETS", "1,8,32", str,
+           "Comma ladder of batch-size shape buckets the engine jits; "
+           "requests are padded up to the smallest admitting bucket so "
+           "steady-state traffic never recompiles."),
+        _k("HVDT_SERVE_MAX_BATCH_SIZE", 32, int,
+           "Max rows the dynamic batcher coalesces into one dispatch."),
+        _k("HVDT_SERVE_MAX_DELAY_MS", 5.0, float,
+           "Max linger (ms) the batcher waits for a fuller batch after "
+           "the first request arrives — the batching latency budget."),
+        _k("HVDT_SERVE_MAX_QUEUE_DEPTH", 256, int,
+           "Admission-control bound (rows queued but not dispatched); "
+           "past it /predict sheds load with HTTP 503 instead of "
+           "growing the queue into an OOM."),
+        _k("HVDT_SERVE_REQUEST_TIMEOUT_S", 30.0, float,
+           "Per-request deadline inside the server (504 past it)."),
+        _k("HVDT_SERVE_RELOAD_INTERVAL_S", 10.0, float,
+           "Seconds between checkpoint-directory polls for hot weight "
+           "reload (serve/reload.py CheckpointWatcher)."),
         # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
         #     LibType selection env_parser.cc) ---
         _k("HVDT_CPU_OPERATIONS", "xla", str,
@@ -174,6 +198,13 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_MESH_AXES", "", str,
            "Comma list of axis=size pairs for the default mesh, e.g. "
            "'dp=4,tp=2'. Empty = 1-D data-parallel mesh over all devices."),
+        # --- persistence safety ---
+        _k("HVDT_MLPARAMS_ALLOW_PREFIXES", "horovod_tpu.", str,
+           "Comma list of module prefixes orchestrate/ml_params.load() "
+           "may import classes from (metadata.json 'class' field); a "
+           "non-allowlisted class is rejected BEFORE any unpickling. "
+           "Extend when persisting your own MLParams subclasses, e.g. "
+           "'horovod_tpu.,myproject.models.'."),
         # --- numerics ---
         _k("HVDT_ALLREDUCE_DTYPE", "", str,
            "Force wire dtype for allreduce ('bfloat16' for compression-"

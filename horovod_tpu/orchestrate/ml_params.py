@@ -276,15 +276,46 @@ class _MLReader:
         return self._cls.load(path)
 
 
+def _allowed_class_prefixes() -> List[str]:
+    """Module prefixes load() may import classes from (the
+    HVDT_MLPARAMS_ALLOW_PREFIXES knob; default: this framework only)."""
+    from ..common import config
+
+    raw = config.get_str("HVDT_MLPARAMS_ALLOW_PREFIXES")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+def _check_class_allowed(class_path: str) -> None:
+    """Reject a metadata.json class outside the allowlist BEFORE any
+    import or unpickling happens.  ``horovod_tpu.`` (trailing dot) also
+    admits the bare ``horovod_tpu`` module — a prefix names a package
+    subtree, not a string accident."""
+    prefixes = _allowed_class_prefixes()
+    for p in prefixes:
+        if class_path.startswith(p) or class_path == p.rstrip("."):
+            return
+    raise ValueError(
+        f"refusing to load class {class_path!r}: its module is not under "
+        f"the allowlisted prefixes {prefixes} (loading runs that class's "
+        "code and unpickles attacker-controlled state — extend "
+        "HVDT_MLPARAMS_ALLOW_PREFIXES only for artifacts you trust)")
+
+
 def load(path: str) -> MLParams:
     """Load any saved estimator/model/pipeline by its recorded class.
 
     Pickle-based (cloudpickle of the param map, like the reference's
-    base64-codec params): only load artifacts you trust."""
+    base64-codec params): only load artifacts you trust.  As a guardrail
+    the recorded class must live under an allowlisted module prefix
+    (default ``horovod_tpu.``; extend via HVDT_MLPARAMS_ALLOW_PREFIXES)
+    — checked before the class import and before ``state.pkl`` is
+    unpickled, so a foreign artifact is rejected with zero of its code
+    executed."""
     import cloudpickle
 
     with open(os.path.join(path, _METADATA)) as f:
         meta = json.load(f)
+    _check_class_allowed(meta["class"])
     module, _, qualname = meta["class"].rpartition(".")
     import importlib
 

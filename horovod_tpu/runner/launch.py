@@ -361,6 +361,15 @@ def run_static(args) -> int:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # `hvdtrun serve ...` — the serving front end (one replica per
+        # invocation; scale-out is N invocations behind a load
+        # balancer).  Flags after `serve` are the serve CLI's (see
+        # horovod_tpu/serve/__main__.py).
+        from ..serve import main as serve_main
+
+        return serve_main(argv[1:])
     args = parse_args(argv)
     if args.version or args.check_build:
         _print_check_build()

@@ -1,0 +1,41 @@
+"""Online inference serving: turn a checkpointed pytree into an endpoint.
+
+The subsystem the training stack feeds (ROADMAP: "serves heavy traffic
+from millions of users").  Layering, bottom up:
+
+* :mod:`~horovod_tpu.serve.metrics` — Prometheus-text counters / gauges /
+  latency summaries (no new dependencies);
+* :mod:`~horovod_tpu.serve.engine`  — :class:`InferenceEngine`: jit per
+  shape bucket, pad-to-bucket, persistent-compile-cache reuse, hot
+  weight swap, optional mesh sharding;
+* :mod:`~horovod_tpu.serve.batcher` — :class:`DynamicBatcher`: bounded
+  admission queue + linger-based micro-batching ahead of the engine;
+* :mod:`~horovod_tpu.serve.reload`  — :class:`CheckpointWatcher`: polls a
+  ``CheckpointManager`` directory and hot-swaps newer steps;
+* :mod:`~horovod_tpu.serve.server`  — :class:`ModelServer`: stdlib HTTP
+  front end (``/predict``, ``/healthz``, ``/metrics``) with 503
+  backpressure.
+
+Entry points: ``python -m horovod_tpu.serve`` and ``hvdtrun serve``
+(:func:`main`); in-process embedding via :class:`ModelServer` directly
+(the test rig and bench.py --serve do this).
+"""
+
+from .batcher import BackpressureError, DynamicBatcher  # noqa: F401
+from .engine import InferenceEngine, parse_buckets  # noqa: F401
+from .metrics import MetricsRegistry  # noqa: F401
+from .reload import CheckpointWatcher  # noqa: F401
+from .server import ModelServer  # noqa: F401
+
+__all__ = [
+    "InferenceEngine", "DynamicBatcher", "BackpressureError",
+    "CheckpointWatcher", "ModelServer", "MetricsRegistry",
+    "parse_buckets", "main",
+]
+
+
+def main(argv=None) -> int:
+    """CLI entry (``python -m horovod_tpu.serve`` / ``hvdtrun serve``)."""
+    from .__main__ import main as _main
+
+    return _main(argv)

@@ -1,0 +1,145 @@
+"""``python -m horovod_tpu.serve`` / ``hvdtrun serve`` — serve a
+checkpointed model over HTTP.
+
+Minimal deploy::
+
+    python -m horovod_tpu.serve --checkpoint /ckpts --model mlp \
+        --mlp-sizes 784,256,128,10 --port 8000
+
+The checkpoint directory is a ``CheckpointManager`` tree (``step_NNN/``
+subdirectories, as written by training); the newest step is loaded at
+startup and newer steps are hot-swapped in while serving (--reload-interval).
+Flag defaults come from the ``HVDT_SERVE_*`` knobs, so a launcher can
+configure a fleet purely through the env contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+__all__ = ["main", "parse_args", "build_server"]
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serve",
+        description="Serve a checkpointed model over HTTP "
+                    "(/predict, /healthz, /metrics).")
+    p.add_argument("--checkpoint", required=True,
+                   help="CheckpointManager directory (holds step_NNN/ "
+                        "subdirectories).")
+    p.add_argument("--model", choices=("mlp", "transformer"), default="mlp")
+    p.add_argument("--mlp-sizes", default="784,256,128,10",
+                   help="Comma layer sizes for --model mlp.")
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=2048)
+    p.add_argument("--seq", type=int, default=128,
+                   help="Serving sequence length for --model transformer.")
+    p.add_argument("--host", default=None,
+                   help="Bind address (default: HVDT_SERVE_HOST).")
+    p.add_argument("--port", type=int, default=None,
+                   help="Bind port, 0 = ephemeral (default: "
+                        "HVDT_SERVE_PORT).")
+    p.add_argument("--buckets", default=None,
+                   help="Comma batch-size bucket ladder (default: "
+                        "HVDT_SERVE_BUCKETS).")
+    p.add_argument("--max-batch-size", type=int, default=None)
+    p.add_argument("--max-delay-ms", type=float, default=None)
+    p.add_argument("--max-queue-depth", type=int, default=None)
+    p.add_argument("--reload-interval", type=float, default=None,
+                   help="Seconds between checkpoint polls (default: "
+                        "HVDT_SERVE_RELOAD_INTERVAL_S).")
+    p.add_argument("--compilation-cache-dir", default=None,
+                   help="Persistent XLA compile cache (restart reuses "
+                        "compiled buckets).")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="Skip pre-compiling every bucket at startup.")
+    return p.parse_args(argv)
+
+
+def build_server(args):
+    """Assemble (server, feature_shape) from parsed args — split out so
+    tests and bench.py can drive the exact CLI path in-process."""
+    import jax
+    import numpy as np
+
+    from .engine import InferenceEngine, parse_buckets
+    from .server import ModelServer
+
+    buckets = parse_buckets(args.buckets)
+    if args.model == "mlp":
+        from ..models.mlp import mlp_apply, mlp_init
+
+        sizes = [int(s) for s in args.mlp_sizes.split(",")]
+        template = mlp_init(jax.random.PRNGKey(0), sizes)
+        apply_fn, feat_shape = mlp_apply, (sizes[0],)
+        input_dtype = np.float32
+    else:
+        from ..models.transformer import (TransformerConfig,
+                                          transformer_apply,
+                                          transformer_init)
+
+        cfg = TransformerConfig(vocab=args.vocab, layers=args.layers,
+                                d_model=args.d_model, heads=args.heads,
+                                kv_heads=args.heads, d_ff=args.d_ff,
+                                max_seq=args.seq)
+        template = transformer_init(jax.random.PRNGKey(0), cfg)
+        apply_fn = lambda p, x: transformer_apply(p, x, cfg)  # noqa: E731
+        feat_shape = (args.seq,)
+        input_dtype = np.int32
+
+    engine = InferenceEngine(apply_fn, template, buckets=buckets,
+                             compile_cache=args.compilation_cache_dir)
+    server = ModelServer(
+        engine, host=args.host, port=args.port,
+        checkpoint_dir=args.checkpoint, template=template,
+        max_batch_size=args.max_batch_size,
+        max_delay_ms=args.max_delay_ms,
+        max_queue_depth=args.max_queue_depth,
+        input_dtype=input_dtype)
+    if server.watcher is not None and args.reload_interval is not None:
+        server.watcher.poll_interval_s = float(args.reload_interval)
+    return server, feat_shape
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    server, feat_shape = build_server(args)
+    # Load the newest checkpoint BEFORE binding: a replica that cannot
+    # find weights should say so immediately, then (deliberately) still
+    # come up on the init template — a smoke deploy with an empty
+    # directory is a supported first-run path.
+    loaded = server.watcher.check_once() if server.watcher else None
+    if loaded is None and (server.watcher is None
+                           or server.watcher.current_step is None):
+        print(f"serve: no checkpoint under {args.checkpoint!r} yet — "
+              "serving freshly-initialized weights until one appears",
+              file=sys.stderr)
+    if not args.no_warmup:
+        dtype = server.input_dtype
+        import numpy as np
+
+        server.engine.warmup(feat_shape, dtype=np.dtype(dtype))
+    port = server.start()
+    print(f"serving {args.model} on http://{server.host}:{port} "
+          f"(buckets={list(server.engine.buckets)}, "
+          f"checkpoint={args.checkpoint})", file=sys.stderr)
+    try:
+        while True:
+            import time
+
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Shape-bucketed inference engine: jit-per-bucket, pad-to-bucket, hot swap.
+
+The TPU-concurrency study (arXiv:2011.03641) is blunt about what kills
+served-model latency on XLA backends: it is not the chip, it is the host —
+every novel input shape triggers a full XLA recompile (seconds) and
+host-side dispatch of a program the compile cache has never seen.  A
+request path whose batch size floats freely (real traffic) therefore
+recompiles forever.  The engine's contract engineers that away:
+
+* **Shape buckets** — the apply fn is jitted once per bucket size from a
+  small fixed ladder (default 1/8/32, knob ``HVDT_SERVE_BUCKETS``); every
+  batch is padded up to the smallest admitting bucket.  Steady-state
+  traffic touches only warm buckets ⇒ zero steady-state compiles, and the
+  ``serve_compiles_total`` counter is the regression alarm.
+* **Persistent compile cache** — bucket compiles also go through
+  ``step_pipeline.enable_compilation_cache``, so a server *restart* reuses
+  the previous process's XLA programs (the PR-1 substrate).
+* **Hot weight swap** — :meth:`swap_params` replaces the param pytree
+  between batches under the engine lock.  In-flight batches keep the
+  reference they captured; nothing is dropped mid-request.  jitted
+  programs are keyed by shape/dtype only, so a swap never recompiles.
+* **Mesh sharding** — given a mesh (``parallel/sharding.py``), params are
+  replicated across it and batches whose bucket divides the mesh are
+  split over the data axes, so one engine drives a multi-chip slice.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common import config
+from ..common.logging_util import get_logger
+from .metrics import MetricsRegistry
+
+__all__ = ["InferenceEngine", "parse_buckets"]
+
+log = get_logger(__name__)
+
+
+def parse_buckets(spec: Optional[str] = None) -> Tuple[int, ...]:
+    """Bucket ladder from a comma list (default: the HVDT_SERVE_BUCKETS
+    knob).  Sorted ascending, deduplicated, all >= 1."""
+    if spec is None:
+        spec = config.get_str("HVDT_SERVE_BUCKETS")
+    sizes = sorted({int(s) for s in str(spec).split(",") if s.strip()})
+    if not sizes or sizes[0] < 1:
+        raise ValueError(f"invalid bucket spec {spec!r}: need sizes >= 1")
+    return tuple(sizes)
+
+
+class InferenceEngine:
+    """Serve ``apply_fn(params, x) -> y`` with bucketed batch shapes.
+
+    ``apply_fn`` must be shape-polymorphic over the leading (batch) dim of
+    ``x`` — exactly the contract of ``models.mlp.mlp_apply`` and
+    ``models.transformer.transformer_apply`` — and pure (jit-able).
+
+    The engine is thread-safe: any number of threads may call
+    :meth:`infer` while another calls :meth:`swap_params`.  Compiled
+    programs are cached by ``(bucket, feature shape, dtype)``; only cache
+    misses compile (counted in ``serve_compiles_total``).
+    """
+
+    def __init__(self, apply_fn: Callable[[Any, Any], Any], params: Any, *,
+                 buckets: Optional[Sequence[int]] = None,
+                 mesh: Optional[Any] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 compile_cache: Optional[str] = None):
+        from ..step_pipeline import enable_compilation_cache
+
+        enable_compilation_cache(compile_cache)
+        self._apply_fn = apply_fn
+        self.buckets = parse_buckets(",".join(map(str, buckets))
+                                     if buckets is not None else None)
+        self.mesh = mesh
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._compiles = self.metrics.counter(
+            "serve_compiles_total",
+            "XLA compilations triggered by inference (flat after warmup "
+            "means the shape buckets are doing their job)")
+        self._infers = self.metrics.counter(
+            "serve_engine_batches_total", "Batches executed by the engine")
+        self._pad_rows = self.metrics.counter(
+            "serve_pad_rows_total",
+            "Padding rows added to reach bucket sizes (wasted compute)")
+        self._lock = threading.Lock()
+        self._jitted = {}            # (bucket, feat_shape, dtype) -> fn
+        self._params = self._place_params(params)
+        self._version = 0
+
+    # ---- params ---------------------------------------------------------
+    def _place_params(self, params: Any) -> Any:
+        """Device placement: replicate over the mesh when one is given
+        (weights live on every chip; the batch dim carries parallelism),
+        plain device_put otherwise."""
+        import jax
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(self.mesh, PartitionSpec())
+            return jax.tree.map(
+                lambda l: jax.device_put(l, sharding), params)
+        return jax.device_put(params)
+
+    def swap_params(self, params: Any) -> int:
+        """Atomically replace the served weights; returns the new version.
+
+        In-flight :meth:`infer` calls finish on the params reference they
+        captured — the swap only changes what *subsequent* batches see, so
+        a reload never fails a request.
+        """
+        placed = self._place_params(params)
+        with self._lock:
+            self._params = placed
+            self._version += 1
+            return self._version
+
+    @property
+    def params_version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # ---- inference ------------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket admitting ``n`` rows (the largest bucket when
+        ``n`` exceeds the ladder — callers then chunk)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _jitted_for(self, bucket: int, feat_shape: Tuple[int, ...],
+                    dtype) -> Callable:
+        import jax
+
+        key = (bucket, feat_shape, str(dtype))
+        with self._lock:
+            fn = self._jitted.get(key)
+        if fn is not None:
+            return fn
+        jfn = jax.jit(self._apply_fn)
+        with self._lock:
+            # Double-checked: a racing thread may have built it first.
+            fn = self._jitted.get(key)
+            if fn is None:
+                fn = jfn
+                self._jitted[key] = fn
+                self._compiles.inc()
+                log.info("serve: compiling bucket=%d feat=%s dtype=%s",
+                         bucket, feat_shape, dtype)
+        return fn
+
+    def _batch_sharding(self, bucket: int):
+        """NamedSharding for the padded batch under the mesh: the batch
+        dim splits over the data-parallel axes (dp/fsdp — the
+        ``parallel/sharding.py`` rule table, same as training inputs)
+        when the bucket divides them, else replicated (correct, just not
+        parallel).  Param-sharding axes (tp/…) never split the batch."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.sharding import batch_spec, transformer_rules
+
+        spec = batch_spec(self.mesh, rules=transformer_rules(fsdp=True))
+        axes = spec[0] if len(spec) else None
+        if axes:
+            if isinstance(axes, str):
+                axes = (axes,)
+            total = int(np.prod([self.mesh.shape[a] for a in axes]))
+            if total > 1 and bucket % total == 0:
+                return NamedSharding(self.mesh, PartitionSpec(axes))
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def _run_bucket(self, x: np.ndarray) -> np.ndarray:
+        """One padded-bucket execution; returns host outputs for the
+        un-padded prefix."""
+        import jax
+
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        if n < bucket:
+            pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
+            xb = np.concatenate([x, pad], axis=0)
+            self._pad_rows.inc(bucket - n)
+        else:
+            xb = x
+        with self._lock:
+            params = self._params
+        sharding = self._batch_sharding(bucket)
+        if sharding is not None:
+            xb = jax.device_put(xb, sharding)
+        fn = self._jitted_for(bucket, x.shape[1:], x.dtype)
+        y = fn(params, xb)
+        self._infers.inc()
+        return np.asarray(jax.device_get(y))[:n]
+
+    def infer(self, x) -> np.ndarray:
+        """Run a batch of ``n`` rows; rows past the largest bucket are
+        chunked through it.  Returns host numpy of shape [n, ...]."""
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] == 0:
+            raise ValueError(f"infer needs a non-empty batch, got shape "
+                             f"{x.shape}")
+        top = self.buckets[-1]
+        if x.shape[0] <= top:
+            return self._run_bucket(x)
+        outs = [self._run_bucket(x[i:i + top])
+                for i in range(0, x.shape[0], top)]
+        return np.concatenate(outs, axis=0)
+
+    def warmup(self, feat_shape: Tuple[int, ...],
+               dtype=np.float32) -> None:
+        """Pre-compile every bucket for one feature shape so the first
+        real request never pays a compile."""
+        for b in self.buckets:
+            self._run_bucket(np.zeros((b,) + tuple(feat_shape), dtype))
+
+    def compile_count(self) -> int:
+        return int(self._compiles.value())

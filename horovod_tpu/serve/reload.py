@@ -1,0 +1,130 @@
+"""Hot checkpoint reload: watch a ``CheckpointManager`` directory, swap
+weights into a live engine between batches.
+
+A production endpoint cannot restart to pick up a new model — a restart
+drops every in-flight request and repays every XLA compile.  The watcher
+closes the training→serving loop instead: training keeps writing
+``step_NNN`` checkpoints with :class:`~horovod_tpu.checkpoint.
+CheckpointManager`; the serving process polls the same directory
+(``CheckpointManager.latest_step()`` discovery), restores any newer step
+with ``broadcast=False`` (a serving replica is its own process — no
+training collective to ride), and hands the tree to
+``InferenceEngine.swap_params``.  The swap is a reference flip under the
+engine lock: batches already dispatched finish on the weights they
+captured, the next batch sees the new ones, and because jitted programs
+key on shapes — not weights — a reload triggers **zero** recompiles.
+
+Failure policy: a half-written or corrupt checkpoint must never kill the
+serving loop.  Restore errors are logged, counted
+(``serve_reload_failures_total``), and retried at the next poll; the
+engine keeps serving the previous weights.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Union
+
+from ..checkpoint import CheckpointManager, restore_checkpoint
+from ..common import config
+from ..common.logging_util import get_logger
+from .metrics import MetricsRegistry
+
+__all__ = ["CheckpointWatcher"]
+
+log = get_logger(__name__)
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint directory; hot-swap newer steps into the engine.
+
+    ``directory`` may be a path or an existing
+    :class:`~horovod_tpu.checkpoint.CheckpointManager`.  ``template``
+    supplies the restore tree structure (typically the params the engine
+    was constructed with).  ``on_reload(tree, step)`` — by default the
+    engine's ``swap_params`` — may be any callable, so the watcher also
+    drives non-engine consumers (e.g. an eval worker).
+    """
+
+    def __init__(self, directory: Union[str, CheckpointManager],
+                 engine: Optional[Any] = None, template: Any = None, *,
+                 poll_interval_s: Optional[float] = None,
+                 on_reload: Optional[Callable[[Any, int], None]] = None,
+                 metrics: Optional[MetricsRegistry] = None):
+        if isinstance(directory, CheckpointManager):
+            self.manager = directory
+        else:
+            self.manager = CheckpointManager(directory)
+        if on_reload is None:
+            if engine is None:
+                raise ValueError("need an engine or an on_reload callback")
+            on_reload = lambda tree, step: engine.swap_params(tree)  # noqa: E731
+        self._on_reload = on_reload
+        self._template = template
+        self.poll_interval_s = float(
+            poll_interval_s if poll_interval_s is not None
+            else config.get_float("HVDT_SERVE_RELOAD_INTERVAL_S"))
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._reloads = self.metrics.counter(
+            "serve_reloads_total", "Successful hot weight reloads")
+        self._failures = self.metrics.counter(
+            "serve_reload_failures_total",
+            "Reload attempts that failed (serving continues on the "
+            "previous weights)")
+        self._step_gauge = self.metrics.gauge(
+            "serve_checkpoint_step", "Step of the currently served weights")
+        self.current_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> Optional[int]:
+        """One poll: reload if a newer step exists.  Returns the step
+        loaded, or None when already current / nothing to load / the
+        restore failed (failure is counted and logged, never raised —
+        the polling loop and the serving path share this method)."""
+        try:
+            latest = self.manager.latest_step()
+        except OSError as e:
+            log.warning("serve reload: cannot list %s: %r",
+                        self.manager.directory, e)
+            return None
+        if latest is None or (self.current_step is not None
+                              and latest <= self.current_step):
+            return None
+        path = self.manager.step_path(latest)
+        try:
+            tree, step = restore_checkpoint(path, self._template,
+                                            broadcast=False)
+            self._on_reload(tree, latest)
+        except Exception as e:
+            self._failures.inc()
+            log.warning("serve reload of %s failed (still serving step "
+                        "%s): %r", path, self.current_step, e)
+            return None
+        self.current_step = latest
+        self._step_gauge.set(latest)
+        self._reloads.inc()
+        log.info("serve: hot-reloaded weights from step %d", latest)
+        return latest
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            self.check_once()
+
+    def start(self, load_initial: bool = False) -> "CheckpointWatcher":
+        """Start the polling thread (idempotent).  ``load_initial`` does a
+        synchronous first check before the thread spins up, so callers can
+        fail fast when the directory holds nothing loadable."""
+        if load_initial:
+            self.check_once()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="hvdt-serve-reload", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)

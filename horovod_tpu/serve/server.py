@@ -1,0 +1,235 @@
+"""HTTP serving front end: /predict, /healthz, /metrics, 503 backpressure.
+
+Stdlib-only (``http.server.ThreadingHTTPServer``) — the serving plane
+must not grow dependencies the training container doesn't have, and a
+thread-per-connection front end is exactly right for this architecture:
+handler threads only parse JSON and park on a batcher future; the real
+concurrency problem (coalescing requests into chip-shaped batches) is the
+batcher's, and admission control is enforced *before* any memory is
+committed to a request's batch slot.
+
+Routes:
+
+* ``POST /predict``  — body ``{"inputs": [[...], ...]}`` (one row per
+  inner list).  200 → ``{"outputs": [...], "model_version": N}``;
+  503 + ``Retry-After`` when admission control sheds the request;
+  400 on malformed bodies; 504 when a request exceeds its deadline.
+* ``GET /healthz``   — liveness/readiness: 200 once the engine has
+  weights, with the served checkpoint step and params version.
+* ``GET /metrics``   — Prometheus text (latency summaries per route,
+  queue depth, batch fill, compile / reload counters).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+import numpy as np
+
+from ..common import config
+from ..common.logging_util import get_logger
+from .batcher import BackpressureError, DynamicBatcher
+from .engine import InferenceEngine
+from .metrics import MetricsRegistry
+from .reload import CheckpointWatcher
+
+__all__ = ["ModelServer"]
+
+log = get_logger(__name__)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by ModelServer on the subclass it builds.
+    server_ref: "ModelServer"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route access logs to our logger
+        log.debug("serve http: " + fmt, *args)
+
+    # ---- helpers --------------------------------------------------------
+    def _reply(self, status: int, payload: Any,
+               content_type: str = "application/json",
+               extra_headers: Optional[dict] = None) -> None:
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _observe(self, route: str, t0: float, status: int) -> None:
+        ms = (time.perf_counter() - t0) * 1000.0
+        srv = self.server_ref
+        srv.metrics.summary(
+            f"serve_request_latency_ms_{route}",
+            f"End-to-end {route} handler latency (ms)").observe(ms)
+        srv.metrics.counter(
+            "serve_http_responses_total",
+            "HTTP responses by route and status").inc(
+                route=route, status=str(status))
+
+    # ---- routes ---------------------------------------------------------
+    def do_GET(self):
+        srv = self.server_ref
+        t0 = time.perf_counter()
+        if self.path.split("?")[0] == "/healthz":
+            payload = {
+                "status": "ok",
+                "model_version": srv.engine.params_version,
+                "checkpoint_step": (srv.watcher.current_step
+                                    if srv.watcher else None),
+                "buckets": list(srv.engine.buckets),
+            }
+            self._reply(200, payload)
+            self._observe("healthz", t0, 200)
+        elif self.path.split("?")[0] == "/metrics":
+            self._reply(200, srv.metrics.render().encode(),
+                        content_type="text/plain; version=0.0.4")
+            self._observe("metrics", t0, 200)
+        else:
+            self._reply(404, {"error": f"no route {self.path!r}"})
+
+    def do_POST(self):
+        if self.path.split("?")[0] != "/predict":
+            self._reply(404, {"error": f"no route {self.path!r}"})
+            return
+        srv = self.server_ref
+        t0 = time.perf_counter()
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length))
+            inputs = np.asarray(doc["inputs"], dtype=srv.input_dtype)
+            if inputs.ndim < 1 or inputs.shape[0] == 0:
+                raise ValueError("inputs must hold >= 1 rows")
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            self._observe("predict", t0, 400)
+            return
+        try:
+            version = srv.engine.params_version
+            future = srv.batcher.submit(inputs)
+        except BackpressureError as e:
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "1"})
+            self._observe("predict", t0, 503)
+            return
+        try:
+            outputs = future.result(timeout=srv.request_timeout_s)
+        except (concurrent.futures.TimeoutError, TimeoutError):
+            future.cancel()
+            self._reply(504, {"error": "deadline exceeded"})
+            self._observe("predict", t0, 504)
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"inference failed: {e}"})
+            self._observe("predict", t0, 500)
+            return
+        self._reply(200, {"outputs": np.asarray(outputs).tolist(),
+                          "model_version": version})
+        self._observe("predict", t0, 200)
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # Default listen(5) drops connections the moment a traffic burst
+    # outruns accept() — the kernel backlog must cover the concurrency
+    # the admission queue is sized for (503s are OUR backpressure signal;
+    # an RST from the TCP layer is just an outage).
+    request_queue_size = 256
+
+
+class ModelServer:
+    """The assembled serving stack: engine + batcher + watcher + HTTP.
+
+    ::
+
+        engine = InferenceEngine(mlp_apply, params, buckets=(1, 8, 32))
+        srv = ModelServer(engine, checkpoint_dir="/ckpts")
+        port = srv.start()          # in-process, returns the bound port
+        ...
+        srv.stop()
+
+    All sizing parameters default to the ``HVDT_SERVE_*`` knobs.  Pass
+    ``port=0`` (default knob value) to bind an ephemeral port — the test
+    rig and multi-replica launches both need collision-free binds.
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 host: Optional[str] = None, port: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 template: Any = None,
+                 max_batch_size: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 max_queue_depth: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 input_dtype=np.float32,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.engine = engine
+        self.metrics = metrics if metrics is not None else engine.metrics
+        self.host = host if host is not None \
+            else config.get_str("HVDT_SERVE_HOST")
+        self.port = int(port if port is not None
+                        else config.get_int("HVDT_SERVE_PORT"))
+        self.request_timeout_s = float(
+            request_timeout_s if request_timeout_s is not None
+            else config.get_float("HVDT_SERVE_REQUEST_TIMEOUT_S"))
+        self.input_dtype = np.dtype(input_dtype)
+        self.batcher = DynamicBatcher(
+            engine.infer, max_batch_size=max_batch_size,
+            max_delay_ms=max_delay_ms, max_queue_depth=max_queue_depth,
+            metrics=self.metrics)
+        self.watcher: Optional[CheckpointWatcher] = None
+        if checkpoint_dir is not None:
+            self.watcher = CheckpointWatcher(
+                checkpoint_dir, engine, template, metrics=self.metrics)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        """Bind + serve in a daemon thread; starts the reload watcher.
+        Returns the bound port."""
+        handler = type("Handler", (_Handler,), {"server_ref": self})
+        self._httpd = _HTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvdt-serve-http",
+            daemon=True)
+        self._thread.start()
+        if self.watcher is not None:
+            self.watcher.start(load_initial=True)
+        log.info("serving on http://%s:%d (buckets=%s)", self.host,
+                 self.port, list(self.engine.buckets))
+        return self.port
+
+    def stop(self) -> None:
+        """Graceful teardown: stop admitting, drain the batcher, stop the
+        watcher and the HTTP listener."""
+        if self.watcher is not None:
+            self.watcher.stop()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def serve_forever(self) -> None:
+        """start() + block until KeyboardInterrupt (the CLI entry path)."""
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()

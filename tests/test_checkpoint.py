@@ -73,6 +73,49 @@ class TestCheckpointManager:
         assert mgr.latest_step() == 7
 
 
+class TestDiscoveryHelpers:
+    """all_steps()/latest_step()/step_path() — the discovery contract the
+    serve-side reload watcher builds on (serve/reload.py)."""
+
+    def test_all_steps_sorted_and_complete(self, hvd, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "d"), max_to_keep=10)
+        for s in (30, 5, 12):
+            mgr.save(s, {"x": jnp.ones(2)}, force=True)
+        assert mgr.all_steps() == [5, 12, 30]
+        assert mgr.latest_step() == 30
+
+    def test_all_steps_ignores_foreign_entries(self, hvd, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "d"))
+        mgr.save(7, {"x": jnp.ones(2)}, force=True)
+        # Stray file, non-step dir, malformed suffix, and an Orbax-style
+        # in-progress tmp dir must all be invisible to discovery.
+        open(os.path.join(mgr.directory, "step_000000000099"), "w").close()
+        os.makedirs(os.path.join(mgr.directory, "notes"))
+        os.makedirs(os.path.join(mgr.directory, "step_abc"))
+        os.makedirs(os.path.join(
+            mgr.directory, "step_000000000008.orbax-checkpoint-tmp-123"))
+        assert mgr.all_steps() == [7]
+        assert mgr.latest_step() == 7
+
+    def test_empty_and_missing_directory(self, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "fresh"))
+        assert mgr.all_steps() == []
+        assert mgr.latest_step() is None
+        # A directory deleted out from under the manager lists as empty,
+        # not as a crash (the watcher polls unconditionally).
+        os.rmdir(mgr.directory)
+        assert mgr.all_steps() == []
+
+    def test_step_path_matches_save_layout(self, hvd, tmp_path):
+        mgr = CheckpointManager(os.path.join(tmp_path, "d"))
+        mgr.save(42, {"x": jnp.ones(2)}, force=True)
+        path = mgr.step_path(42)
+        assert os.path.isdir(path)
+        assert os.path.basename(path) == "step_000000000042"
+        restored, step = restore_checkpoint(path, {"x": jnp.zeros(2)})
+        assert step == 42
+
+
 def test_named_dtype_covers_ml_dtypes():
     """Leaf dtype metadata travels by name; ml_dtypes names must resolve
     (np.dtype('bfloat16') alone raises TypeError)."""

@@ -92,6 +92,77 @@ class TestParamsSurface:
         assert clone2.getBatchSize() == 8
 
 
+class TestLoadAllowlist:
+    """load()/load_ml() must reject classes outside the allowlisted
+    module prefixes BEFORE importing them or unpickling state.pkl
+    (ADVICE r5: arbitrary-class import + cloudpickle load is arbitrary
+    code execution on untrusted artifacts)."""
+
+    @staticmethod
+    def _forge(path, class_name, state_bytes):
+        import json as _json
+
+        os.makedirs(path)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            _json.dump({"class": class_name, "params": {}}, f)
+        with open(os.path.join(path, "state.pkl"), "wb") as f:
+            f.write(state_bytes)
+
+    def test_foreign_class_rejected_before_unpickling(self, tmp_path):
+        import pickle
+
+        flag = str(tmp_path / "pwned-side-effect")
+
+        class Boom:
+            """Unpickling this executes os.mkdir(flag) — the canary that
+            state.pkl was never opened."""
+
+            def __reduce__(self):
+                return (os.mkdir, (flag,))
+
+        path = str(tmp_path / "evil")
+        self._forge(path, "some_attacker_pkg.payload.Evil",
+                    pickle.dumps(Boom()))
+        with pytest.raises(ValueError, match="allowlisted prefixes"):
+            load_ml(path)
+        assert not os.path.exists(flag), \
+            "state.pkl was unpickled despite the allowlist rejection"
+
+    def test_stdlib_class_rejected(self, tmp_path):
+        path = str(tmp_path / "os")
+        self._forge(path, "os.path.join", b"not-a-pickle")
+        with pytest.raises(ValueError, match="allowlisted prefixes"):
+            load_ml(path)
+
+    def test_knob_extends_allowlist(self, tmp_path, monkeypatch):
+        # A non-framework prefix becomes loadable only when the operator
+        # opts in via HVDT_MLPARAMS_ALLOW_PREFIXES...
+        path = str(tmp_path / "ours")
+        self._forge(path, "my_company.models.Net", b"garbage")
+        with pytest.raises(ValueError, match="allowlisted prefixes"):
+            load_ml(path)
+        monkeypatch.setenv("HVDT_MLPARAMS_ALLOW_PREFIXES",
+                           "horovod_tpu.,my_company.")
+        # ...past the allowlist now: the next failure is the (expected)
+        # import of the module itself, not the policy gate.
+        with pytest.raises(ModuleNotFoundError):
+            load_ml(path)
+
+    def test_knob_can_revoke_default(self, tmp_path, monkeypatch):
+        model = JaxModel({"w": np.zeros(2)}, _lin_predict)
+        path = str(tmp_path / "model")
+        model.save(path)
+        monkeypatch.setenv("HVDT_MLPARAMS_ALLOW_PREFIXES", "nothing_at_all.")
+        with pytest.raises(ValueError, match="allowlisted prefixes"):
+            load_ml(path)
+
+    def test_framework_classes_still_load(self, tmp_path):
+        model = JaxModel({"w": np.array([1.0, 2.0])}, _lin_predict)
+        path = str(tmp_path / "model")
+        model.save(path)
+        assert isinstance(load_ml(path), JaxModel)
+
+
 class TestPersistence:
     def test_estimator_roundtrip_then_fit(self, tmp_path):
         est = _declarative_est(epochs=40, batch_size=32)

@@ -19,6 +19,14 @@ Paths measured:
   (``hvd.allreduce``), measuring the full controller+data-plane
   round trip per op (the reference's per-op latency analog).
 
+``--wire {f32,bf16,fp16,int8}`` selects the wire format of the jit leg:
+dtype casts around the psum for bf16/fp16 (``Compression.bf16/.fp16``),
+or the block-scaled quantized two-stage collective for int8
+(``Compression.int8`` — horovod_tpu/quant).  Non-f32 wires also time
+the f32 leg and report ``speedup_vs_f32``; ``--json-out FILE`` writes
+the sweep (bytes_on_wire, GB/s, speedup) as a JSON result file for the
+BENCH trajectory, like bench.py does.
+
 Runs anywhere: 8-device CPU sim for correctness/CI, a TPU slice for real
 numbers.  Prints one human line per size and a final JSON summary line.
 """
@@ -39,9 +47,35 @@ def _fmt_bytes(n: int) -> str:
     return f"{n:.0f}TiB"
 
 
+def _shard_map():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map  # older jax
+
+    return shard_map
+
+
+def wire_payload_bytes(count: int, dtype, wire: str) -> int:
+    """Bytes one allreduce message occupies in the selected wire format
+    (the compression accounting the JSON result file carries)."""
+    import jax.numpy as jnp
+
+    if wire in ("bf16", "fp16"):
+        return count * 2
+    if wire == "int8":
+        from horovod_tpu.quant import wire_bytes
+
+        return wire_bytes(count)
+    return count * jnp.dtype(dtype).itemsize
+
+
 def bench_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
-              warmup: int):
-    """Per-op seconds for a chained psum allreduce of ``nbytes``."""
+              warmup: int, wire: str = "f32"):
+    """Per-op seconds for a chained allreduce of ``nbytes`` over the
+    selected wire format."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -52,20 +86,33 @@ def bench_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
     x = jax.device_put(
         jnp.ones((n, count), dtype),
         NamedSharding(mesh, P("dp")))
+    cast_to = {"bf16": jnp.bfloat16, "fp16": jnp.float16}.get(wire)
+    pcast = getattr(lax, "pcast", None)
 
     def body(xl):
         # inner chained allreduces per call amortize dispatch overhead;
         # the 1/n rescale keeps values bounded AND makes each iteration
         # depend on the last (no overlap/elision).
         def one(_, acc):
-            red = lax.psum(acc, "dp") * (1.0 / n)
+            if wire == "int8":
+                from horovod_tpu.common.types import ReduceOp
+                from horovod_tpu.quant import quantized_allreduce_flat
+
+                red = quantized_allreduce_flat(
+                    acc.reshape(-1), "dp",
+                    op=ReduceOp.AVERAGE).reshape(acc.shape)
+            else:
+                w = acc.astype(cast_to) if cast_to is not None else acc
+                red = (lax.psum(w, "dp") * (1.0 / n)).astype(acc.dtype)
             # psum output is replicated; pcast back to varying so the
-            # fori_loop carry type is stable.
-            return lax.pcast(red, ("dp",), to="varying")
+            # fori_loop carry type is stable (no-op pre-vma-tracking
+            # JAX builds, which have no pcast).
+            return (pcast(red, ("dp",), to="varying")
+                    if pcast is not None else red)
         return lax.fori_loop(0, inner, one, xl)
 
-    f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
-                              out_specs=P("dp")))
+    f = jax.jit(_shard_map()(body, mesh=mesh, in_specs=P("dp"),
+                             out_specs=P("dp")))
 
     def run_and_wait():
         # Force completion with a host fetch of a scalar that data-depends
@@ -158,6 +205,15 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--eager", action="store_true",
                     help="also measure the negotiated eager path")
+    ap.add_argument("--wire", choices=("f32", "bf16", "fp16", "int8"),
+                    default="f32",
+                    help="wire format for the jit leg (int8 = the "
+                         "block-scaled quantized collective, "
+                         "horovod_tpu/quant; non-f32 also times the "
+                         "f32 leg for speedup_vs_f32)")
+    ap.add_argument("--json-out", default="",
+                    help="also write the sweep JSON to this file "
+                         "(bytes_on_wire / GB/s / speedup_vs_f32 rows)")
     ap.add_argument("--np", type=int, default=0,
                     help="measure the eager path across N real worker "
                          "processes (launched via the programmatic runner)")
@@ -184,10 +240,19 @@ def main() -> None:
     factor = 2.0 * (n - 1) / n if n > 1 else 1.0
     while size <= args.max_bytes:
         t_jit = bench_jit(mesh, size, args.dtype, args.inner, args.iters,
-                          args.warmup)
+                          args.warmup, wire=args.wire)
+        count = max(1, size // np.dtype(args.dtype).itemsize)
+        on_wire = wire_payload_bytes(count, args.dtype, args.wire)
         row = {"bytes": size, "jit_algbw_gbps": size / t_jit / 1e9,
                "jit_busbw_gbps": size / t_jit * factor / 1e9,
-               "jit_us": t_jit * 1e6}
+               "jit_us": t_jit * 1e6,
+               "wire": args.wire, "bytes_on_wire": on_wire,
+               "wire_gbps": on_wire / t_jit / 1e9}
+        if args.wire != "f32":
+            t_f32 = bench_jit(mesh, size, args.dtype, args.inner,
+                              args.iters, args.warmup, wire="f32")
+            row["f32_us"] = t_f32 * 1e6
+            row["speedup_vs_f32"] = t_f32 / t_jit
         if args.eager:
             t_e = bench_eager(hvd, size, args.dtype,
                               max(3, args.iters // 2), 1)
@@ -197,6 +262,9 @@ def main() -> None:
         msg = (f"{_fmt_bytes(size):>8}  jit {row['jit_us']:>10.1f}us "
                f"algbw {row['jit_algbw_gbps']:>8.2f} GB/s "
                f"busbw {row['jit_busbw_gbps']:>8.2f} GB/s")
+        if args.wire != "f32":
+            msg += (f"   wire={args.wire} {_fmt_bytes(on_wire):>8} "
+                    f"speedup {row['speedup_vs_f32']:>5.2f}x")
         if args.eager:
             msg += (f"   eager {row['eager_us']:>10.1f}us "
                     f"algbw {row['eager_algbw_gbps']:>8.2f} GB/s")
@@ -204,15 +272,23 @@ def main() -> None:
         size *= 4
 
     peak = max(rows, key=lambda r: r["jit_busbw_gbps"])
-    print(json.dumps({
+    summary = {
         "metric": "allreduce_peak_busbw_gbps",
         "value": round(peak["jit_busbw_gbps"], 3),
         "unit": "GB/s",
         "n_devices": n,
         "platform": dev.platform,
         "at_bytes": peak["bytes"],
+        "wire": args.wire,
         "rows": rows,
-    }))
+    }
+    if args.wire != "f32":
+        summary["speedup_vs_f32_at_peak"] = round(
+            peak["speedup_vs_f32"], 3)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

@@ -4,8 +4,8 @@
 # Buildkite pipeline the same way).  Usage: ci/gen-matrix.sh | sh -x
 #
 #   ci/gen-matrix.sh --smoke   emit only the fast smoke service
-#       (compileall + optimizer-kernel + serving-subsystem tests on
-#       CPU) — the pre-merge gate.
+#       (compileall + optimizer-kernel + serving-subsystem +
+#       quantized-collective tests on CPU) — the pre-merge gate.
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

@@ -163,6 +163,7 @@ class ParameterManager:
     LOG2_BUCKET_CANDIDATES = tuple(range(20, 29))     # 1 MiB .. 256 MiB
     OVERLAP_CANDIDATES = (1, 2, 4)
     FUSED_OPTIMIZER_CANDIDATES = (0.0, 1.0)
+    QUANT_CANDIDATES = (0.0, 1.0)
 
     def __init__(self,
                  warmup_samples: Optional[int] = None,
@@ -170,7 +171,8 @@ class ParameterManager:
                  max_samples: Optional[int] = None,
                  log_file: Optional[str] = None,
                  noise: Optional[float] = None,
-                 tune_fused_optimizer: Optional[bool] = None):
+                 tune_fused_optimizer: Optional[bool] = None,
+                 tune_quant: Optional[bool] = None):
         self.warmup = (warmup_samples if warmup_samples is not None
                        else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
         self.steps_per_sample = (
@@ -189,18 +191,29 @@ class ParameterManager:
         self.tune_fused = (
             tune_fused_optimizer if tune_fused_optimizer is not None
             else config.get_bool("HVDT_AUTOTUNE_FUSED_OPTIMIZER"))
+        # Optional fourth dimension: int8-vs-f32 gradient wire
+        # (horovod_tpu/quant) — comm bytes and step time trade against
+        # quantize/dequantize compute, so the GP prices the wire jointly
+        # with the bucketing it directly interacts with.
+        self.tune_quant = (tune_quant if tune_quant is not None
+                           else config.get_bool("HVDT_AUTOTUNE_QUANT"))
+        # Column layout: [log2_bucket, overlap] (+fused) (+quant).
+        self._quant_col = (2 + int(self.tune_fused)) if self.tune_quant \
+            else None
+        import itertools
+
+        dims = [self.LOG2_BUCKET_CANDIDATES, self.OVERLAP_CANDIDATES]
         if self.tune_fused:
-            grid = np.array(
-                [[b, o, f] for b in self.LOG2_BUCKET_CANDIDATES
-                 for o in self.OVERLAP_CANDIDATES
-                 for f in self.FUSED_OPTIMIZER_CANDIDATES], float)
-        else:
-            grid = np.array([[b, o] for b in self.LOG2_BUCKET_CANDIDATES
-                             for o in self.OVERLAP_CANDIDATES], float)
+            dims.append(self.FUSED_OPTIMIZER_CANDIDATES)
+        if self.tune_quant:
+            dims.append(self.QUANT_CANDIDATES)
+        grid = np.array(list(itertools.product(*dims)), float)
         self._bo = BayesianOptimizer(grid, noise=noise)
         start = [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0]
         if self.tune_fused:
             start.append(float(config.get_bool("HVDT_FUSED_OPTIMIZER")))
+        if self.tune_quant:
+            start.append(float(_env_quant_wire()))
         self._current = np.array(start)
         self._sample = _Sample(self._current)
         self._samples_done = 0
@@ -224,6 +237,14 @@ class ParameterManager:
         if self.tune_fused:
             return bool(self._current[2] >= 0.5)
         return config.get_bool("HVDT_FUSED_OPTIMIZER")
+
+    @property
+    def quant_wire(self) -> bool:
+        """Current int8-vs-f32 wire choice; outside the tuned dimension
+        it reports the HVDT_QUANT / HVDT_COMPRESSION env default."""
+        if self.tune_quant:
+            return bool(self._current[self._quant_col] >= 0.5)
+        return _env_quant_wire()
 
     @property
     def tuning_complete(self) -> bool:
@@ -271,11 +292,18 @@ class ParameterManager:
         try:
             with open(self._log_file, "a", newline="") as f:
                 row = [time.time(), int(2 ** s.point[0]), int(s.point[1])]
-                if len(s.point) > 2:
-                    row.append(int(s.point[2]))
+                for extra in s.point[2:]:    # fused / quant dimensions
+                    row.append(int(extra))
                 csv.writer(f).writerow(row + [f"{s.score:.1f}"])
         except OSError as e:
             log.warning("autotune log write failed: %s", e)
+
+
+def _env_quant_wire() -> bool:
+    """The environment's int8-wire default (the quant dimension's
+    starting leg): HVDT_QUANT, or HVDT_COMPRESSION=int8."""
+    return (config.get_bool("HVDT_QUANT")
+            or config.get_str("HVDT_COMPRESSION").strip().lower() == "int8")
 
 
 class BenchmarkAutotuner:
@@ -361,8 +389,10 @@ class BenchmarkAutotuner:
         state = "converged" if self.done else "tuning"
         fused = (f" fused_opt={int(self.pm.fused_optimizer)}"
                  if self.pm.tune_fused else "")
+        quant = (f" wire={'int8' if self.pm.quant_wire else 'f32'}"
+                 if self.pm.tune_quant else "")
         return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
-                f"overlap={self.pm.overlap_buckets}{fused} "
+                f"overlap={self.pm.overlap_buckets}{fused}{quant} "
                 f"({self.pm._samples_done} samples)")
 
 
@@ -403,6 +433,15 @@ class AutotunedStep:
     GP prices the update-side kernels jointly with the comm bucketing.
     Builders without the keyword keep the old call shape.
 
+    With ``HVDT_AUTOTUNE_QUANT=1`` the space likewise gains an
+    int8-vs-f32 *wire* dimension (horovod_tpu/quant): builders accepting
+    a ``quant`` keyword are rebuilt as ``builder(threshold_bytes,
+    quant=bool)`` — hot-swappable mid-run because both wire legs keep
+    one optimizer state tree (build the chain with
+    ``quant.with_error_feedback(..., enabled=quant)`` and switch
+    ``compression=`` between ``Compression.int8`` and
+    ``Compression.none``; tests/test_quant.py pins the contract).
+
     Args:
       builder: ``builder(threshold_bytes | None) -> step_callable``
         (optionally also accepting ``fused=bool``).
@@ -423,21 +462,26 @@ class AutotunedStep:
         self._builder = builder
         try:
             sig = inspect.signature(builder).parameters
-            self._accepts_fused = ("fused" in sig or any(
-                p.kind is inspect.Parameter.VAR_KEYWORD
-                for p in sig.values()))
+            var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in sig.values())
+            self._accepts_fused = "fused" in sig or var_kw
+            self._accepts_quant = "quant" in sig or var_kw
         except (TypeError, ValueError):
             self._accepts_fused = False
+            self._accepts_quant = False
+        # Pin every tuned A/B dimension's starting leg at build 0 so the
+        # opt-state structure established before tuning matches every
+        # later rebuild (both fused legs keep one state tree —
+        # ops/optim_kernels; both wire legs too —
+        # quant.with_error_feedback(enabled=...)).
+        build_kw = {}
         if (self.enabled and self._accepts_fused
                 and config.get_bool("HVDT_AUTOTUNE_FUSED_OPTIMIZER")):
-            # Pin the fused dimension's starting leg at build 0 so the
-            # opt-state structure established before tuning matches
-            # every later rebuild (the fused transformations keep one
-            # state tree across both legs — ops/optim_kernels).
-            self._step = builder(
-                None, fused=config.get_bool("HVDT_FUSED_OPTIMIZER"))
-        else:
-            self._step = builder(None)
+            build_kw["fused"] = config.get_bool("HVDT_FUSED_OPTIMIZER")
+        if (self.enabled and self._accepts_quant
+                and config.get_bool("HVDT_AUTOTUNE_QUANT")):
+            build_kw["quant"] = _env_quant_wire()
+        self._step = builder(None, **build_kw)
         self._tree_example = tree_example
         self._steps_per_sample = steps_per_sample
         self._cp = control_plane
@@ -460,13 +504,16 @@ class AutotunedStep:
         return self._tuner.summary() if self._tuner else "no samples yet"
 
     def _rebuild(self):
-        """Re-jit at the tuner's current knob point (fused dimension
-        forwarded only when both the tuner and the builder carry it)."""
+        """Re-jit at the tuner's current knob point (fused/quant
+        dimensions forwarded only when both the tuner and the builder
+        carry them)."""
         pm = self._tuner.pm
+        kw = {}
         if pm.tune_fused and self._accepts_fused:
-            return self._builder(self._tuner.bucket_bytes,
-                                 fused=pm.fused_optimizer)
-        return self._builder(self._tuner.bucket_bytes)
+            kw["fused"] = pm.fused_optimizer
+        if pm.tune_quant and self._accepts_quant:
+            kw["quant"] = pm.quant_wire
+        return self._builder(self._tuner.bucket_bytes, **kw)
 
     @staticmethod
     def _fetch(out) -> None:

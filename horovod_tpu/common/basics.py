@@ -190,6 +190,16 @@ def init(
 
         enable_compilation_cache()
 
+        # Wire-compression env selection (HVDT_COMPRESSION / HVDT_QUANT):
+        # resolve NOW so an unknown name fails at init with the valid
+        # list, not at the first optimizer step on some worker.
+        from ..ops.compression import Compression
+
+        _env_comp = Compression.from_env()
+        if _env_comp is not Compression.none:
+            log.info("gradient wire compression from env: %s",
+                     _env_comp.__name__)
+
         env_size = config.get_int("HVDT_SIZE")
         env_rank = config.get_int("HVDT_RANK")
         coord = coordinator_address or config.get_str("HVDT_COORDINATOR_ADDR")

@@ -209,6 +209,36 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_ALLREDUCE_DTYPE", "", str,
            "Force wire dtype for allreduce ('bfloat16' for compression-"
            "on-the-wire; empty = tensor dtype)."),
+        # --- quantized wire (horovod_tpu/quant: block-scaled int8
+        #     collectives with error feedback) ---
+        _k("HVDT_COMPRESSION", "", str,
+           "Gradient wire compressor by name: none|bf16|fp16|int8 "
+           "(empty = none).  Consumed by hvd.init() and by "
+           "DistributedOptimizer wrappers when compression= is unset; "
+           "unknown names raise with the valid list.  The launcher "
+           "forwards --compression."),
+        _k("HVDT_QUANT", False, _parse_bool,
+           "Shorthand for HVDT_COMPRESSION=int8 (wins over it): route "
+           "gradient collectives over the block-scaled int8 wire "
+           "(quant/collectives two-stage quantized allreduce).  Pair "
+           "with quant.with_error_feedback for f32-parity convergence."),
+        _k("HVDT_QUANT_BLOCK", 256, int,
+           "Block size (elements) for int8 wire quantization: one f32 "
+           "absmax scale per block.  256 default = 1.6% scale overhead; "
+           "must be a multiple of 128 for the Pallas lowering (other "
+           "values fall back to identical-math XLA)."),
+        _k("HVDT_QUANT_KERNELS", "auto", str,
+           "Quantize/dequantize lowering: auto (Pallas on TPU, XLA "
+           "elsewhere), on (force Pallas — interpret mode off-TPU, the "
+           "kernel-equivalence test path), off (XLA everywhere).  Both "
+           "lowerings share the same block math."),
+        _k("HVDT_AUTOTUNE_QUANT", False, _parse_bool,
+           "Add an int8-vs-f32 wire dimension (0/1) to the autotune "
+           "search space; the step builder is rebuilt with quant=... at "
+           "each knob change (autotune.AutotunedStep), hot-swappable "
+           "because both legs keep one optimizer state tree (see "
+           "quant.with_error_feedback(enabled=...)).  Starting point "
+           "comes from HVDT_QUANT / HVDT_COMPRESSION."),
     ]
 }
 

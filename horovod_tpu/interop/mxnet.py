@@ -300,11 +300,12 @@ def _trainer_cls():
         optional wire compression)."""
 
         def __init__(self, params, optimizer, optimizer_params=None,
-                     compression=Compression.none,
+                     compression=None,
                      gradient_predivide_factor: float = 1.0,
                      prefix: Optional[str] = None, num_groups: int = 0,
                      process_set=None):
-            self._compression = compression
+            # None -> environment selection (HVDT_COMPRESSION/HVDT_QUANT)
+            self._compression = compression or Compression.from_env()
             self._process_set = process_set or global_process_set()
             if isinstance(optimizer, _optimizer_cls()):
                 optimizer = optimizer._optimizer

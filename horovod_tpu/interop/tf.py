@@ -170,7 +170,8 @@ class DistributedGradientTape:
 
         self._tape = tape
         self._op = op
-        self._compression = compression or Compression.none
+        # None -> environment selection (HVDT_COMPRESSION / HVDT_QUANT)
+        self._compression = compression or Compression.from_env()
         self._process_set = process_set
         self._sparse_as_dense = sparse_as_dense
 
@@ -308,7 +309,8 @@ def _wrap_optimizer_class(cls, op=None, compression=None, process_set=None,
     from ..ops import eager
     from ..ops.compression import Compression
 
-    comp = compression or Compression.none
+    # None -> environment selection (HVDT_COMPRESSION / HVDT_QUANT)
+    comp = compression or Compression.from_env()
 
     class _DistributedOptimizer(cls):
         _hvd_wrapped = True

@@ -47,7 +47,9 @@ class _Hooks:
         self.op = op
         self.process_set = process_set
         self.k = max(1, int(backward_passes_per_step))
-        self.compression = compression or Compression.none
+        # None → environment selection (HVDT_COMPRESSION / HVDT_QUANT);
+        # int8 here means on-grid host values (see Int8Compressor).
+        self.compression = compression or Compression.from_env()
         self.predivide = float(gradient_predivide_factor)
         if self.predivide != 1.0 and op != ReduceOp.AVERAGE:
             raise ValueError(

@@ -110,7 +110,7 @@ def adasum_allreduce(x, axis: str = "dp"):
     from .device import invariant_allgather_shards
 
     def _one(t):
-        n = lax.axis_size(axis)
+        n = _axis_size_static(axis)
         if n & (n - 1):
             raise ValueError(f"Adasum requires power-of-2 ranks, got {n}")
         orig_shape = t.shape

@@ -11,6 +11,15 @@ path the framework consumes only ``wire_dtype`` — the cast target of the
 fused collective (optimizer.py → fused_allreduce).  ``compress``/
 ``decompress`` mirror the reference's optimizer-level API for user code
 that wants explicit round-trip casts around eager ops.
+
+Beyond the reference: ``Compression.int8`` selects the block-scaled
+quantized wire (horovod_tpu/quant/ — EQuARX-style int8 payload + f32
+block scales, with the two-stage quantized collective on the jit path).
+Compressors are also selectable by NAME from the environment
+(``HVDT_COMPRESSION=none|bf16|fp16|int8``, or ``HVDT_QUANT=1`` as the
+int8 shorthand) via :meth:`Compression.from_env`, consumed by
+``hvd.init()`` and the optimizer wrappers when no explicit
+``compression=`` is passed; the launcher forwards ``--compression``.
 """
 
 from __future__ import annotations
@@ -20,7 +29,7 @@ from typing import Any, Optional, Tuple
 import numpy as np
 
 __all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
-           "BF16Compressor", "Compression"]
+           "BF16Compressor", "Int8Compressor", "Compression"]
 
 
 class Compressor:
@@ -97,9 +106,100 @@ class BF16Compressor(_CastCompressor):
         return tensor
 
 
+class Int8Compressor(Compressor):
+    """Block-scaled symmetric int8 wire (horovod_tpu/quant/).
+
+    jit path: ``wire_dtype`` is the :data:`~..quant.collectives.INT8_WIRE`
+    sentinel — ``fused_allreduce`` routes each float bucket through the
+    two-stage quantized collective (real int8 payloads + f32 block
+    scales on the wire, f32 accumulation in the middle).
+
+    Host/eager path (``compress``/``decompress`` — the torch grad-hook
+    and tf/mxnet binding route): the negotiated collective reduces one
+    homogeneous buffer, so ``compress`` returns the gradient *snapped to
+    the int8 grid* (quantize→dequantize) in its original dtype — the
+    exact value the real wire would deliver, so convergence behaviour
+    (and error-feedback residuals) match the jit path, while the bytes
+    ride the negotiated transport uncompressed.  For true host wire
+    compression use ``quant.eager_quantized_allreduce`` (packed
+    allgather; wins for small world sizes)."""
+
+    wire_dtype = "int8_blockwise"   # == quant.collectives.INT8_WIRE
+
+    @classmethod
+    def compress(cls, tensor) -> Tuple[Any, Any]:
+        dtype = getattr(tensor, "dtype", None)
+        if dtype is None or np.dtype(dtype).kind != "f":
+            return tensor, None
+        if type(tensor).__module__.startswith("jax"):
+            from ..quant import kernels as _qk
+
+            return _qk.quantize_dequantize(tensor), None
+        # numpy path — jax-free on purpose (same rationale as
+        # BF16Compressor: host-side users must not trigger an
+        # accelerator backend init to compress a gradient).
+        return cls._np_quantize_dequantize(np.asarray(tensor)), None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        del ctx  # on-grid values ARE the decompressed representation
+        return tensor
+
+    @staticmethod
+    def _np_quantize_dequantize(arr: np.ndarray) -> np.ndarray:
+        """Numpy mirror of quant.kernels.quantize_dequantize (identical
+        block math; np.rint and jnp.round are both round-half-even)."""
+        from ..common import config
+
+        block = config.get_int("HVDT_QUANT_BLOCK")
+        block = block if block > 0 else 256
+        shape, dtype = arr.shape, arr.dtype
+        flat = arr.astype(np.float32).ravel()
+        pad = (-flat.size) % block
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+        x2 = flat.reshape(-1, block)
+        scale = np.max(np.abs(x2), axis=1, keepdims=True) * (1.0 / 127.0)
+        inv = np.where(scale > 0,
+                       1.0 / np.where(scale > 0, scale, 1.0), 0.0)
+        q = np.clip(np.rint(x2 * inv), -127, 127)
+        out = (q * scale).reshape(-1)
+        if pad:
+            out = out[:-pad]
+        return out.reshape(shape).astype(dtype)
+
+
 class Compression:
     """Option enum-style holder (ref: compression.py Compression)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+
+    _BY_NAME = {"none": NoneCompressor, "fp16": FP16Compressor,
+                "bf16": BF16Compressor, "int8": Int8Compressor}
+
+    @classmethod
+    def by_name(cls, name: str) -> type:
+        """Resolve a compressor by name; unknown names raise with the
+        valid list (the env-selection contract)."""
+        key = (name or "none").strip().lower()
+        try:
+            return cls._BY_NAME[key]
+        except KeyError:
+            raise ValueError(
+                f"unknown compression {name!r}; valid: "
+                f"{sorted(cls._BY_NAME)}") from None
+
+    @classmethod
+    def from_env(cls) -> type:
+        """The environment-selected compressor: ``HVDT_QUANT=1`` forces
+        int8, else ``HVDT_COMPRESSION`` by name (empty = none).
+        Consumed by ``hvd.init()`` (early validation) and by every
+        optimizer wrapper whose ``compression=`` is left unset."""
+        from ..common import config
+
+        if config.get_bool("HVDT_QUANT"):
+            return Int8Compressor
+        return cls.by_name(config.get_str("HVDT_COMPRESSION") or "none")

@@ -51,11 +51,23 @@ def axis_rank(axis: AxisName) -> jax.Array:
 
 def axis_size(axis: AxisName) -> int:
     """Number of shards along ``axis`` (ref: horovod_size)."""
-    return lax.axis_size(axis)
+    return _axis_size_static(axis)
 
 
 def _axes_tuple(axis: AxisName) -> Tuple[str, ...]:
     return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def _axis_size_static(axis: AxisName) -> int:
+    """Static size of the bound mesh axis/axes.  Guarded for JAX builds
+    without ``lax.axis_size`` (<= 0.4.x): ``lax.psum`` of the literal 1
+    is constant-folded to a python int under shard_map on every JAX.
+    Raises (NameError) when ``axis`` is not bound, like axis_size."""
+    size_fn = getattr(lax, "axis_size", None)
+    n = 1
+    for a in _axes_tuple(axis):
+        n *= int(size_fn(a)) if size_fn is not None else int(lax.psum(1, a))
+    return n
 
 
 def _vma_tracking_active(axis: AxisName) -> bool:
@@ -125,7 +137,7 @@ def allreduce(x, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE,
     if leaves and all(not is_varying(t, axis) for t in leaves):
         n = 1
         for a in _axes_tuple(axis):
-            n *= lax.axis_size(a)
+            n *= _axis_size_static(a)
         if op == ReduceOp.SUM:
             out = jax.tree.map(lambda t: t * n, x)
         elif op in (ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
@@ -142,7 +154,7 @@ def allreduce(x, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE,
     if op in (ReduceOp.AVERAGE, ReduceOp.SUM):
         out = lax.psum(x, axis)
         if op == ReduceOp.AVERAGE:
-            n = lax.psum(1, axis) if not isinstance(axis, str) else lax.axis_size(axis)
+            n = _axis_size_static(axis)
             out = jax.tree.map(lambda t: t / n, out)
     elif op == ReduceOp.MIN:
         out = lax.pmin(x, axis)
@@ -197,9 +209,9 @@ def reduce_scatter(x, axis: AxisName = "dp", scatter_axis: int = 0,
             out = lax.psum_scatter(t, axis, scatter_dimension=scatter_axis,
                                    tiled=True)
             if op == ReduceOp.AVERAGE:
-                out = out / lax.axis_size(axis)
+                out = out / _axis_size_static(axis)
             return out
-        n = lax.axis_size(axis)
+        n = _axis_size_static(axis)
         if t.shape[scatter_axis] % n:
             raise ValueError(
                 f"reduce_scatter dim {scatter_axis} ({t.shape[scatter_axis]}) "
@@ -241,7 +253,7 @@ def allgather_ragged(x, sizes: Sequence[int], axis: AxisName = "dp"):
     ``invariant_allgather_shards`` for the equal-shard case).
     """
     sizes = [int(s) for s in sizes]
-    n = lax.axis_size(axis)
+    n = _axis_size_static(axis)
     if len(sizes) != n:
         raise ValueError(f"len(sizes)={len(sizes)} != axis size {n}")
     maxpad = max(sizes)
@@ -291,7 +303,7 @@ def alltoall_uneven(x, send_splits: Sequence[Sequence[int]],
     grossly skewed splits pay padding bandwidth.
     """
     M = [[int(v) for v in row] for row in send_splits]
-    n = lax.axis_size(axis)
+    n = _axis_size_static(axis)
     if len(M) != n or any(len(row) != n for row in M):
         raise ValueError(f"send_splits must be {n}x{n}")
     row_tot = {sum(row) for row in M}
@@ -425,11 +437,21 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
 
     ``wire_dtype`` optionally casts buckets for the reduction (bf16 wire
     compression — ref: tensorflow/compression.py:141) and casts back.
+    The sentinel ``"int8_blockwise"`` (``Compression.int8.wire_dtype``,
+    == quant.collectives.INT8_WIRE) instead routes each float bucket
+    through the two-stage block-scaled quantized allreduce — real int8
+    payloads on the wire, f32 accumulation in the middle; non-float
+    buckets keep the exact path.
     """
     from ..common import config
 
     if threshold_bytes is None:
         threshold_bytes = config.get_int("HVDT_FUSION_THRESHOLD")
+
+    quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
+        "int8", "int8_blockwise")
+    if quant_wire:
+        wire_dtype = None  # the quantized path owns the wire format
 
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
@@ -450,8 +472,16 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         # reference's NVTX op ranges; buckets appear as
         # hvdt.fused_allreduce.bN in XPlane/profiler output.
         with jax.named_scope(f"hvdt.fused_allreduce.b{bi}"):
-            red = allreduce(flat, axis, op, prescale_factor,
-                            postscale_factor)
+            if quant_wire and jnp.issubdtype(orig_dtype, jnp.floating):
+                from ..quant.collectives import quantized_allreduce_flat
+
+                red = quantized_allreduce_flat(
+                    flat, axis, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+            else:
+                red = allreduce(flat, axis, op, prescale_factor,
+                                postscale_factor)
         if red.dtype != orig_dtype:
             red = red.astype(orig_dtype)
         offset = 0
@@ -472,7 +502,7 @@ def invariant_allgather_shards(shard, axis: AxisName):
     this fuses the gather and the invariance restoration into one
     allreduce instead of all_gather + identity pmean.
     shard: [chunk, ...]; returns [axis_size*chunk, ...]."""
-    n = lax.axis_size(axis)
+    n = _axis_size_static(axis)
     idx = lax.axis_index(axis)
     chunk = shard.shape[0]
     full = jnp.zeros((n * chunk,) + shard.shape[1:], shard.dtype)
@@ -500,7 +530,7 @@ def hierarchical_allreduce(x, inner_axis: AxisName = "ici",
         raise ValueError(f"hierarchical_allreduce supports SUM/AVERAGE, got {op}")
 
     def _one(t):
-        ni = lax.axis_size(inner_axis)
+        ni = _axis_size_static(inner_axis)
         shape, dtype = t.shape, t.dtype
         flat = jnp.ravel(t)
         if prescale_factor != 1.0:
@@ -514,7 +544,7 @@ def hierarchical_allreduce(x, inner_axis: AxisName = "ici",
         if pad:
             full = full[:-pad]
         if op == ReduceOp.AVERAGE:
-            full = full / (ni * lax.axis_size(outer_axis))
+            full = full / (ni * _axis_size_static(outer_axis))
         if postscale_factor != 1.0:
             full = full * jnp.asarray(postscale_factor, full.dtype)
         return full.reshape(shape).astype(dtype)

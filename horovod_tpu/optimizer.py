@@ -104,13 +104,13 @@ def _axis_bound(axis) -> bool:
     """True when ``axis`` is a bound manual mesh axis (i.e. we are inside a
     shard_map body).  Under plain auto-sharded jit/pjit there are no bound
     axes — gradients there are already globally correct and the comm link
-    must be the identity."""
-    from jax import lax
-
+    must be the identity.  Probed through the guarded size helper so JAX
+    builds without ``lax.axis_size`` (<= 0.4.x) still detect bound axes
+    instead of silently skipping the collective."""
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     try:
         for a in axes:
-            lax.axis_size(a)
+            dev._axis_size_static(a)
         return True
     except Exception:
         return False
@@ -146,14 +146,13 @@ def allreduce_gradients(grads, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
         return grads
 
     import jax
-    from jax import lax  # noqa: F811
 
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
         return grads
     n = 1
     for a in ((axis,) if isinstance(axis, str) else tuple(axis)):
-        n *= lax.axis_size(a)
+        n *= dev._axis_size_static(a)
 
     varying_idx = [i for i, l in enumerate(leaves) if dev.is_varying(l, axis)]
     unvarying_idx = [i for i in range(len(leaves)) if i not in set(varying_idx)]
@@ -217,7 +216,7 @@ def DistributedOptimizer(optimizer,
                          *,
                          axis="dp",
                          op: ReduceOp = ReduceOp.AVERAGE,
-                         compression: Compressor = Compression.none,
+                         compression: Optional[Compressor] = None,
                          backward_passes_per_step: int = 1,
                          threshold_bytes: Optional[int] = None,
                          prescale_factor: float = 1.0,
@@ -243,12 +242,18 @@ def DistributedOptimizer(optimizer,
       axis: mesh axis to reduce over (data-parallel axis).
       op: Average (default), Sum, or Adasum.
       compression: Compression.none / .bf16 / .fp16 — wire dtype for the
-        fused collectives.
+        fused collectives — or Compression.int8 for the block-scaled
+        quantized wire (horovod_tpu/quant; pair with
+        ``hvd.quant.with_error_feedback`` for f32-parity convergence).
+        None (default) resolves from the environment
+        (``HVDT_COMPRESSION`` / ``HVDT_QUANT`` — Compression.from_env).
       backward_passes_per_step: accumulate this many micro-batch gradients
         locally between collectives (ref: gradient_aggregation.py).
     """
     import optax
 
+    if compression is None:
+        compression = Compression.from_env()
     comm = DistributedGradientTransformation(
         axis=axis, op=op, compression=compression,
         threshold_bytes=threshold_bytes, prescale_factor=prescale_factor,

@@ -45,7 +45,7 @@ def moe_dispatch_combine(tokens: jax.Array,
     Returns (combined ``[T, D]``, MoEAux).
     """
     t, d = tokens.shape
-    ep = lax.axis_size(axis)
+    ep = _axis_size_static(axis)
     e_total = ep * experts_per_rank
     if router_logits.shape[-1] != e_total:
         raise ValueError(

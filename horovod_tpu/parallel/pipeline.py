@@ -44,7 +44,7 @@ def pipeline_spmd(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     Returns ``[M, mb, ...]`` outputs of the final stage.
     """
-    p = lax.axis_size(axis)
+    p = _axis_size_static(axis)
     me = lax.axis_index(axis)
     m = microbatches.shape[0]
     ticks = m + p - 1

@@ -174,7 +174,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _ring_forward(q, k, v, axis, causal, scale, segment_ids, use_pallas):
     """Forward ring pass; returns (out, lse [B,H,Lq])."""
     b, lq, h, d = q.shape
-    sp = lax.axis_size(axis)
+    sp = _axis_size_static(axis)
     my = lax.axis_index(axis)
     lk = k.shape[1]
 
@@ -276,7 +276,7 @@ def _ring_diff_bwd(axis, causal, scale, use_pallas, res, do):
     b, lq, h, d = q.shape
     lk, hkv = k.shape[1], k.shape[2]
     group = h // hkv
-    sp = lax.axis_size(axis)
+    sp = _axis_size_static(axis)
     my = lax.axis_index(axis)
     fwd = [(i, (i + 1) % sp) for i in range(sp)]
     f32 = jnp.float32

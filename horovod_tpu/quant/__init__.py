@@ -1,0 +1,67 @@
+"""Quantized collective communication — block-scaled int8 wire format.
+
+The gradient wire path's third compression tier (after bf16/fp16
+casts, ops/compression.py): EQuARX-style (arxiv 2506.17615)
+block-scaled symmetric int8 with per-block f32 absmax scales, reduced
+in two quantized hops (reduce-scatter in wire format → f32
+dequant-accumulate → requantize → allgather), with optax-compatible
+error feedback so convergence matches the f32 wire.
+
+Layout:
+
+* :mod:`.kernels` — quantize/dequantize as Pallas kernels (one
+  VMEM-resident pass, interpret-mode off-TPU) with an identical-math
+  pure-XLA fallback; ``HVDT_QUANT_BLOCK`` / ``HVDT_QUANT_KERNELS``.
+* :mod:`.collectives` — the two-stage quantized allreduce for the jit
+  path (wired into ``fused_allreduce`` as the ``Compression.int8``
+  wire mode) plus an eager/host variant for the torch grad-hook route.
+* :mod:`.error_feedback` — ``with_error_feedback(tx)`` residual
+  accumulator carrying quantization error into the next step.
+
+Selection: ``DistributedOptimizer(compression=hvd.Compression.int8)``,
+or env-wide via ``HVDT_COMPRESSION=int8`` / ``HVDT_QUANT=1``; the
+autotuner can A/B the wire online with ``HVDT_AUTOTUNE_QUANT=1``
+(state-compatible hot-swap legs).
+"""
+
+from __future__ import annotations
+
+from .kernels import (  # noqa: F401
+    quant_block_size,
+    quant_kernel_eligible,
+    quantize_flat,
+    dequantize_flat,
+    quantize_dequantize,
+    wire_bytes,
+)
+from .collectives import (  # noqa: F401
+    INT8_WIRE,
+    quantized_allreduce,
+    quantized_allreduce_flat,
+    eager_quantized_allreduce,
+)
+from .error_feedback import (  # noqa: F401
+    ErrorFeedbackState,
+    with_error_feedback,
+    tile_residual,
+    stack_residual,
+    unstack_residual,
+)
+
+__all__ = [
+    "quant_block_size",
+    "quant_kernel_eligible",
+    "quantize_flat",
+    "dequantize_flat",
+    "quantize_dequantize",
+    "wire_bytes",
+    "INT8_WIRE",
+    "quantized_allreduce",
+    "quantized_allreduce_flat",
+    "eager_quantized_allreduce",
+    "ErrorFeedbackState",
+    "with_error_feedback",
+    "tile_residual",
+    "stack_residual",
+    "unstack_residual",
+]

@@ -1,0 +1,223 @@
+"""Two-stage quantized allreduce — int8 wire format end to end.
+
+The EQuARX schedule (arxiv 2506.17615), expressed with XLA named-axis
+collectives so GSPMD/Mosaic can overlap it like any other program:
+
+1. each rank quantizes its full local vector (block-scaled int8,
+   quant/kernels);
+2. **reduce-scatter in wire format**: an ``all_to_all`` moves every
+   rank's copy of shard *j* (int8 payload + f32 block scales) to rank
+   *j* — the bandwidth-heavy hop crosses the wire at ~1 B/element;
+3. each rank dequantize-accumulates its shard in f32 (the reduction
+   itself is never done in int8 — accumulating in wire precision would
+   overflow and compound rounding);
+4. the reduced shard is **requantized** and reassembled in wire format
+   (zero-embed + int8 psum, disjoint regions — the psum-family terminal
+   op keeps the result type *replicated*, which P() out_specs and
+   optax.MultiSteps require) — the second hop also rides int8;
+5. final dequantize to the requested dtype.
+
+Wire bytes per rank ≈ ``3 (n-1)/n · size · (1 + 4/block)`` vs
+``8 (n-1)/n · size`` for the f32 ring — a ~2.7x reduction at the
+default block 256 (:func:`quant.kernels.wire_bytes` is the per-message
+payload accounting).
+
+Error model: stage-1 error is bounded by each rank's block scale / 2
+and is what :mod:`..quant.error_feedback` carries into the next step;
+stage-4 requantization error is bounded by the *reduced* shard's block
+scale / 2.  Values already on the grid survive both stages exactly.
+
+Old-JAX guard (container jax 0.4.37): axis size is resolved through
+``lax.psum(1, axis)`` — static under shard_map on every JAX — instead
+of ``lax.axis_size`` (absent there); no ``jax.typeof``/``lax.pcast``
+needed anywhere on this path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.types import ReduceOp
+from . import kernels as qk
+
+__all__ = ["quantized_allreduce_flat", "quantized_allreduce",
+           "eager_quantized_allreduce", "INT8_WIRE"]
+
+# Sentinel a Compressor exposes as ``wire_dtype`` to select this path in
+# fused_allreduce (a string on purpose: never mistakable for a dtype).
+INT8_WIRE = "int8_blockwise"
+
+
+def _single_axis(axis) -> str:
+    if isinstance(axis, str):
+        return axis
+    axes = tuple(axis)
+    if len(axes) == 1:
+        return axes[0]
+    raise ValueError(
+        f"quantized (int8-wire) allreduce reduces over ONE mesh axis, "
+        f"got {axes}; reduce hierarchically or pick a single axis")
+
+
+def _axis_size_static(axis: str) -> int:
+    size_fn = getattr(lax, "axis_size", None)
+    return int(size_fn(axis)) if size_fn is not None else int(
+        lax.psum(1, axis))
+
+
+def quantized_allreduce_flat(flat, axis="dp",
+                             op: ReduceOp = ReduceOp.AVERAGE,
+                             block_size: Optional[int] = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0):
+    """Allreduce one flat float vector over ``axis`` with the int8 wire
+    (the bucket-level primitive ``fused_allreduce`` routes to).  Valid
+    inside shard_map where ``axis`` is bound; SUM/AVERAGE only (MIN/MAX
+    etc. have no meaningful block-rescaled accumulation).  Returns the
+    reduced vector in the input dtype, replicated across ``axis``."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"quantized allreduce supports SUM/AVERAGE, got {op}")
+    ax = _single_axis(axis)
+    block = block_size or qk.quant_block_size()
+    n = _axis_size_static(ax)
+    dtype = flat.dtype
+    size = flat.shape[0]
+
+    x = flat.astype(jnp.float32)
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    # Pad so the vector splits into n equal, block-aligned rank shards.
+    shard = -(-size // (n * block)) * block
+    total = shard * n
+    if total != size:
+        x = jnp.concatenate([x, jnp.zeros((total - size,), jnp.float32)])
+
+    # Stage 1-2: quantize locally, reduce-scatter the wire format.
+    q, scales = qk.quantize_flat(x, block)
+    q_rows = q.reshape(n, shard)
+    s_rows = scales.reshape(n, shard // block)
+    q_recv = lax.all_to_all(q_rows, ax, split_axis=0, concat_axis=0,
+                            tiled=True)
+    s_recv = lax.all_to_all(s_rows, ax, split_axis=0, concat_axis=0,
+                            tiled=True)
+
+    # Stage 3: dequantize-accumulate this rank's shard in f32.
+    contrib = (q_recv.reshape(n, shard // block, block).astype(jnp.float32)
+               * s_recv[:, :, None])
+    acc = jnp.sum(contrib, axis=0).reshape(-1)
+    if op == ReduceOp.AVERAGE:
+        acc = acc * (1.0 / n)
+
+    # Stage 4-5: requantize, reassemble in wire format, final dequantize.
+    # Reassembly is zero-embed + psum rather than all_gather: the
+    # psum-family terminal op restores the *replicated* result type every
+    # consumer of an allreduce expects (P() out_specs, optax.MultiSteps
+    # cond-type stability — see device.invariant_allgather_shards for
+    # the idiom), and the embedded regions are disjoint so the int8 sum
+    # cannot overflow.  Costs 2(n-1)/n int8 bytes on this hop vs the
+    # allgather's (n-1)/n — total wire still ~2.7x under the f32 ring.
+    q_out, s_out = qk.quantize_flat(acc, block)
+    idx = lax.axis_index(ax)
+    q_full = lax.psum(
+        lax.dynamic_update_slice_in_dim(
+            jnp.zeros((total,), jnp.int8), q_out, idx * shard, axis=0),
+        ax)
+    s_full = lax.psum(
+        lax.dynamic_update_slice_in_dim(
+            jnp.zeros((total // block,), jnp.float32), s_out,
+            idx * (shard // block), axis=0),
+        ax)
+    out = qk.dequantize_flat(q_full, s_full, block)
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    if total != size:
+        out = out[:size]
+    return out.astype(dtype)
+
+
+def quantized_allreduce(tree, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
+                        block_size: Optional[int] = None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0):
+    """Pytree convenience wrapper: every float leaf rides
+    :func:`quantized_allreduce_flat` (flattened per leaf — for the
+    bucketed hot path use ``ops.device.fused_allreduce`` with
+    ``Compression.int8``, which concatenates leaves first); non-float
+    leaves take the exact ``ops.device.allreduce``."""
+    from ..ops import device as dev
+
+    def _one(leaf):
+        if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+            flat = jnp.ravel(leaf)
+            red = quantized_allreduce_flat(
+                flat, axis, op, block_size, prescale_factor,
+                postscale_factor)
+            return red.reshape(leaf.shape)
+        return dev.allreduce(leaf, axis, op, prescale_factor,
+                             postscale_factor)
+
+    return jax.tree.map(_one, tree)
+
+
+def eager_quantized_allreduce(tensor, name: Optional[str] = None,
+                              op: ReduceOp = ReduceOp.AVERAGE,
+                              block_size: Optional[int] = None,
+                              process_set=None):
+    """Host/eager-path quantized allreduce for the negotiated route (the
+    torch grad-hook optimizer's data plane).
+
+    The negotiated eager collective reduces ONE homogeneous buffer, so
+    true mixed int8+f32 payloads cannot ride a single ``allreduce``;
+    instead the wire carries an ``allgather`` of the packed per-rank
+    wire bytes (int8 payload ‖ f32 scales) and each rank
+    dequantize-accumulates locally — per-rank traffic
+    ``(n-1)·size·(1+4/block)`` bytes, which beats the f32 ring's
+    ``2(n-1)/n·4·size`` whenever n ≤ ~7 (past that, prefer
+    ``Compression.int8``'s on-grid f32 simulation on the host path; the
+    jit path always wins).  Returns a float ndarray like
+    ``hvd.allreduce``."""
+    import numpy as np
+
+    from ..ops import eager
+
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"quantized allreduce supports SUM/AVERAGE, got {op}")
+    block = block_size or qk.quant_block_size()
+    arr = np.asarray(tensor)
+    shape, dtype = arr.shape, arr.dtype
+    flat = arr.astype(np.float32).ravel()
+    pad = (-flat.size) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    x2 = flat.reshape(-1, block)
+    absmax = np.max(np.abs(x2), axis=1, keepdims=True)
+    scale = absmax * (1.0 / 127.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        inv = np.where(scale > 0, 1.0 / np.where(scale > 0, scale, 1.0), 0.0)
+    q = np.clip(np.rint(x2 * inv), -127, 127).astype(np.int8)
+    # Pack payload ‖ scale bytes into one uint8 wire buffer per rank.
+    packed = np.concatenate(
+        [q.reshape(-1).view(np.uint8),
+         scale[:, 0].astype(np.float32).view(np.uint8)])
+    gathered = eager.allgather(packed, name=name and f"{name}.q8",
+                               process_set=process_set)
+    per_rank = np.asarray(gathered).reshape(-1, packed.size)
+    n = per_rank.shape[0]
+    nblocks = x2.shape[0]
+    acc = np.zeros(nblocks * block, np.float32)
+    for r in range(n):
+        payload = per_rank[r, :nblocks * block].view(np.int8)
+        scales_r = per_rank[r, nblocks * block:].view(np.float32)
+        acc += (payload.reshape(nblocks, block).astype(np.float32)
+                * scales_r[:, None]).reshape(-1)
+    if op == ReduceOp.AVERAGE:
+        acc /= n
+    if pad:
+        acc = acc[:-pad]
+    return acc.reshape(shape).astype(dtype)

@@ -1,0 +1,124 @@
+"""Error feedback for quantized gradient communication.
+
+1-bit SGD / EF-SGD lineage (Seide et al.; Karimireddy et al.): when the
+wire carries a lossy gradient, add the quantization error back into the
+NEXT step's gradient instead of dropping it.  The compressed sequence
+then converges like the exact one — the error is carried, not
+compounded — which is what lets the int8 wire match the f32-wire loss
+trajectory (tests/test_quant.py proves the 200-step MLP parity).
+
+Mechanics per step, per leaf (f32 residual state):
+
+    e        = grad + residual          # error-compensated gradient
+    sent     = Q(e)                     # on-grid value the wire carries
+    residual = e - sent                 # local quantization error
+    inner.update(sent, ...)             # comm chain + optimizer see `sent`
+
+``sent`` is computed with :func:`..quant.kernels.quantize_dequantize` —
+exactly the stage-1 wire value, so the first collective hop
+(reduce-scatter of the already-on-grid payload) is lossless; only the
+post-reduction requantize in stage 4 contributes fresh error, bounded
+by the *reduced* gradient's block scale.
+
+``enabled=False`` keeps the identical state tree (residual stays all
+zeros and ``sent = e``) — that is what makes the autotuner's int8/f32
+wire legs hot-swappable mid-run with one optimizer state
+(``AutotunedStep``'s ``quant=`` dimension relies on it, the same
+state-compatibility contract as ops/optim_kernels' ``use_kernels``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import kernels as qk
+
+__all__ = ["ErrorFeedbackState", "with_error_feedback",
+           "tile_residual", "stack_residual", "unstack_residual"]
+
+
+class ErrorFeedbackState(NamedTuple):
+    residual: Any   # f32 pytree of carried quantization error
+    inner: Any      # wrapped transformation's state
+
+
+# The residual is PER-RANK state (each worker carries its own
+# quantization error), while ``inner`` stays replicated (it only sees
+# post-collective values).  Under shard_map that means the residual
+# crosses the boundary stacked over the dp axis — in_specs/out_specs
+# P(axis) on the residual, P() on everything else.  These helpers
+# implement the pattern (docs/performance.md shows the full loop):
+
+
+def tile_residual(state: ErrorFeedbackState, n: int) -> ErrorFeedbackState:
+    """Prepare a freshly init'd state for an ``n``-rank shard_map carry:
+    residual leaves gain a leading [n] axis (identical zero copies)."""
+    return state._replace(residual=jax.tree.map(
+        lambda t: jnp.tile(t[None], (n,) + (1,) * t.ndim),
+        state.residual))
+
+
+def unstack_residual(state: ErrorFeedbackState) -> ErrorFeedbackState:
+    """Inside the shard_map body: drop this rank's leading [1] axis."""
+    return state._replace(
+        residual=jax.tree.map(lambda t: t[0], state.residual))
+
+
+def stack_residual(state: ErrorFeedbackState) -> ErrorFeedbackState:
+    """Inside the shard_map body: re-add the leading [1] axis so the
+    residual exits through a P(axis) out_spec."""
+    return state._replace(
+        residual=jax.tree.map(lambda t: jnp.asarray(t)[None],
+                              state.residual))
+
+
+def with_error_feedback(inner, block_size: Optional[int] = None,
+                        enabled: bool = True):
+    """Wrap an optax ``GradientTransformation`` (typically the whole
+    ``DistributedOptimizer(..., compression=Compression.int8)`` chain)
+    with a quantization-error residual accumulator::
+
+        tx = hvd.quant.with_error_feedback(
+            hvd.DistributedOptimizer(optax.adam(1e-3),
+                                     compression=hvd.Compression.int8))
+        state = tx.init(params)
+        updates, state = tx.update(grads, state, params)
+
+    Args:
+      inner: the transformation receiving the on-grid gradients.
+      block_size: wire block size (default ``HVDT_QUANT_BLOCK``).
+      enabled: with False, gradients pass through untouched and the
+        residual stays zero — same state STRUCTURE, exact math; the
+        f32-wire leg of a quant A/B.
+    """
+    import optax
+
+    def init_fn(params):
+        residual = jax.tree.map(
+            lambda t: jnp.zeros(t.shape, jnp.float32), params)
+        return ErrorFeedbackState(residual=residual,
+                                  inner=inner.init(params))
+
+    def update_fn(updates, state, params=None):
+        def compensated(g, r):
+            return g.astype(jnp.float32) + r
+
+        e = jax.tree.map(compensated, updates, state.residual)
+        if enabled:
+            sent = jax.tree.map(
+                lambda t: qk.quantize_dequantize(t, block_size), e)
+            residual = jax.tree.map(jnp.subtract, e, sent)
+        else:
+            sent = e
+            residual = state.residual  # already zeros; keep the leaves
+        # Inner chain sees the wire values in the gradients' own dtype.
+        sent = jax.tree.map(
+            lambda s, g: s.astype(jnp.result_type(g)), sent, updates)
+        new_updates, inner_state = inner.update(sent, state.inner, params)
+        return new_updates, ErrorFeedbackState(residual=residual,
+                                               inner=inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -150,6 +150,13 @@ KNOB_FLAGS: List[_Flag] = [
           "params", "allreduce_dtype",
           "Wire dtype for allreduce (e.g. bfloat16 for on-the-wire "
           "compression)."),
+    _Flag("--compression", "compression", "HVDT_COMPRESSION",
+          "params", "compression",
+          "Gradient wire compressor by name: none|bf16|fp16|int8 "
+          "(int8 = block-scaled quantized collectives, horovod_tpu/"
+          "quant).  Workers resolve it in hvd.init()/"
+          "DistributedOptimizer; unknown names fail init with the "
+          "valid list."),
     # --- mesh ---
     _Flag("--mesh-axes", "mesh_axes", "HVDT_MESH_AXES", "params",
           "mesh_axes", "Default mesh axes, e.g. 'dp=4,tp=2'."),

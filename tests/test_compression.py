@@ -1,0 +1,236 @@
+"""ops/compression.py round-trips + env selection, and stall.py —
+both previously under-tested."""
+
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.compression import (BF16Compressor, Compression,
+                                         FP16Compressor, Int8Compressor,
+                                         NoneCompressor)
+from horovod_tpu.stall import StallInspector
+
+
+# ---------------------------------------------------------------------------
+# cast compressors: numpy and jax round trips, non-float passthrough
+# ---------------------------------------------------------------------------
+
+
+class TestCastCompressors:
+    def test_none_is_identity(self):
+        x = np.arange(5, dtype=np.float32)
+        c, ctx = NoneCompressor.compress(x)
+        assert c is x and ctx is None
+        assert NoneCompressor.decompress(c, ctx) is x
+
+    def test_fp16_numpy_roundtrip(self):
+        x = np.linspace(-4, 4, 64, dtype=np.float32)
+        c, ctx = FP16Compressor.compress(x)
+        assert c.dtype == np.float16 and ctx == np.float32
+        out = FP16Compressor.decompress(c, ctx)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, x, atol=1e-3)
+
+    def test_fp16_jax_roundtrip(self):
+        import jax.numpy as jnp
+
+        x = jnp.linspace(-4, 4, 64, dtype=jnp.float32)
+        c, ctx = FP16Compressor.compress(x)
+        assert c.dtype == jnp.float16
+        out = FP16Compressor.decompress(c, ctx)
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   atol=1e-3)
+
+    def test_bf16_numpy_path_uses_ml_dtypes(self):
+        import ml_dtypes
+
+        x = np.linspace(-4, 4, 64, dtype=np.float32)
+        c, ctx = BF16Compressor.compress(x)
+        assert c.dtype == np.dtype(ml_dtypes.bfloat16)
+        out = BF16Compressor.decompress(c, ctx)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, x, atol=0.05)
+
+    def test_bf16_jax_path(self):
+        import jax.numpy as jnp
+
+        x = jnp.linspace(-4, 4, 64, dtype=jnp.float32)
+        c, ctx = BF16Compressor.compress(x)
+        assert c.dtype == jnp.bfloat16
+        out = BF16Compressor.decompress(c, ctx)
+        assert out.dtype == jnp.float32
+
+    @pytest.mark.parametrize("comp", [FP16Compressor, BF16Compressor,
+                                      Int8Compressor])
+    def test_non_float_passthrough(self, comp):
+        x = np.arange(6, dtype=np.int32)
+        c, ctx = comp.compress(x)
+        assert ctx is None
+        np.testing.assert_array_equal(np.asarray(c), x)
+        np.testing.assert_array_equal(
+            np.asarray(comp.decompress(c, ctx)), x)
+
+    def test_f64_roundtrip_restores_dtype(self):
+        x = np.linspace(-1, 1, 32, dtype=np.float64)
+        c, ctx = FP16Compressor.compress(x)
+        out = FP16Compressor.decompress(c, ctx)
+        assert out.dtype == np.float64
+
+
+class TestInt8HostCompressor:
+    def test_error_bounded_and_on_grid(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1000).astype(np.float32) * 2.0
+        c, ctx = Int8Compressor.compress(x)
+        assert ctx is None and c.dtype == np.float32
+        # bound: per-block scale/2 (block 256 default)
+        flat = np.concatenate([x, np.zeros((-len(x)) % 256, np.float32)])
+        scales = np.abs(flat.reshape(-1, 256)).max(1) / 127.0
+        bound = np.repeat(scales, 256)[:1000] * 0.5 + 1e-6
+        assert np.all(np.abs(c - x) <= bound)
+        # idempotent: on-grid values are a fixed point
+        c2, _ = Int8Compressor.compress(c)
+        np.testing.assert_array_equal(c, c2)
+
+    def test_jax_array_path_matches_numpy(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(512).astype(np.float32)
+        c_np, _ = Int8Compressor.compress(x)
+        c_jx, _ = Int8Compressor.compress(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(c_jx), c_np, rtol=1e-6)
+
+    def test_block_knob_respected(self, monkeypatch):
+        monkeypatch.setenv("HVDT_QUANT_BLOCK", "128")
+        x = np.zeros(128, np.float32)
+        x[0] = 1.0
+        c, _ = Int8Compressor.compress(x)
+        assert c[0] == pytest.approx(1.0)
+
+
+class TestSelection:
+    def test_by_name_valid(self):
+        assert Compression.by_name("none") is NoneCompressor
+        assert Compression.by_name("BF16") is BF16Compressor
+        assert Compression.by_name("fp16") is FP16Compressor
+        assert Compression.by_name("int8") is Int8Compressor
+        assert Compression.by_name("") is NoneCompressor
+
+    def test_by_name_unknown_lists_valid(self):
+        with pytest.raises(ValueError) as ei:
+            Compression.by_name("zstd")
+        for name in ("none", "bf16", "fp16", "int8"):
+            assert name in str(ei.value)
+
+    def test_from_env_default_none(self, monkeypatch):
+        monkeypatch.delenv("HVDT_COMPRESSION", raising=False)
+        monkeypatch.delenv("HVDT_QUANT", raising=False)
+        assert Compression.from_env() is NoneCompressor
+
+    def test_from_env_name(self, monkeypatch):
+        monkeypatch.setenv("HVDT_COMPRESSION", "bf16")
+        assert Compression.from_env() is BF16Compressor
+
+    def test_holder_attributes(self):
+        assert Compression.int8 is Int8Compressor
+        assert Compression.none is NoneCompressor
+
+
+# ---------------------------------------------------------------------------
+# stall.py — the coordinator-side stall inspector
+# ---------------------------------------------------------------------------
+
+
+class TestStallInspector:
+    def _insp(self, **kw):
+        kw.setdefault("warn_seconds", 0)
+        kw.setdefault("shutdown_seconds", 0)
+        return StallInspector(world_size=4, **kw)
+
+    def test_partial_submission_warns_with_missing_ranks(self):
+        import logging
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        # logging_util's "horovod_tpu" logger does not propagate to the
+        # root logger, so attach a capture handler directly.
+        hvdt_logger = logging.getLogger("horovod_tpu")
+        cap = _Capture(level=logging.WARNING)
+        hvdt_logger.addHandler(cap)
+        try:
+            insp = self._insp()
+            insp.record("grad.w", 0)
+            insp.record("grad.w", 2)
+            stalled = insp.check()
+        finally:
+            hvdt_logger.removeHandler(cap)
+        assert stalled == ["grad.w"]
+        text = "\n".join(records)
+        assert "grad.w" in text
+        assert "[ready ranks: [0, 2]]" in text
+        assert "[missing ranks: [1, 3]]" in text
+
+    def test_below_threshold_no_warn(self):
+        insp = self._insp(warn_seconds=3600)
+        insp.record("grad.w", 0)
+        assert insp.check() == []
+
+    def test_check_throttled_to_one_hz(self):
+        insp = self._insp()
+        insp.record("a", 0)
+        assert insp.check() == ["a"]
+        insp.record("b", 0)
+        # immediate second check is rate-limited (1s between sweeps)
+        assert insp.check() == []
+
+    def test_warns_once_until_resolved(self):
+        insp = self._insp()
+        insp.record("a", 0)
+        assert insp.check() == ["a"]
+        insp._last_check = 0.0          # defeat the 1 Hz throttle
+        assert insp.check() == []       # already warned, no repeat
+        insp.resolve("a")
+        insp.record("a", 1)             # stalls again after resolve
+        insp._last_check = 0.0
+        assert insp.check() == ["a"]
+        assert insp.warned_ever == {"a"}
+
+    def test_resolve_clears_pending(self):
+        insp = self._insp()
+        insp.record("a", 0)
+        insp.resolve("a")
+        assert insp.check() == []
+        assert insp.warned_ever == set()
+
+    def test_shutdown_callback_fires(self):
+        msgs = []
+        insp = self._insp(shutdown_seconds=1e-9,
+                          on_shutdown=msgs.append)
+        insp.record("a", 0)
+        time.sleep(0.01)
+        insp.check()
+        assert len(msgs) == 1 and "a" in msgs[0]
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("HVDT_STALL_CHECK_DISABLE", "1")
+        insp = StallInspector(world_size=2, warn_seconds=0)
+        insp.record("a", 0)
+        assert not insp.enabled
+        assert insp.check() == []
+
+    def test_all_ranks_ready_still_pending_until_resolved(self):
+        # The inspector tracks submission, not completion: the caller
+        # resolves a name once the collective finishes — until then a
+        # fully-submitted op that never completes still warns.
+        insp = self._insp()
+        for r in range(4):
+            insp.record("a", r)
+        stalled = insp.check()
+        assert stalled == ["a"]

@@ -47,9 +47,11 @@ def _save_last_good(line: str) -> None:
         d = json.loads(line)
         if d.get("platform") in (None, "cpu"):
             return
-        if d.get("steps_per_call") or d.get("fused_optimizer"):
-            # A/B probe variants are not the headline metric — caching
-            # one would contaminate the outage-fallback evidence.
+        if d.get("steps_per_call") or d.get("fused_optimizer") \
+                or d.get("fault_plan"):
+            # A/B probe variants and chaos runs are not the headline
+            # metric — caching one would contaminate the outage-fallback
+            # evidence.
             return
         if os.environ.get("HVDT_BENCH_NO_CACHE", "") not in ("", "0"):
             # Experimental-config A/B legs (e.g. HVDT_FUSED_CONV1X1=1)
@@ -325,10 +327,32 @@ def _run_child(args) -> None:
     float(loss)
     print(f"warmup: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
+    # Chaos-audit mode: with HVDT_FAULT_PLAN set, the step loop carries
+    # the 'step' injection point and a preemption guard, and the output
+    # JSON reports how many injected faults the loop absorbed — so
+    # resilience overhead and recovery behavior are auditable straight
+    # from bench output.  Without a plan this is a no-op (inj is None).
+    from horovod_tpu.resilience import faults as _faults
+    from horovod_tpu.resilience.preempt import PreemptionGuard
+
+    inj = _faults.get_injector()
+    recovered_faults = 0
+    guard = PreemptionGuard().install() if inj is not None else None
+
     rates = []
+    step_idx = 0
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
+            if inj is not None:
+                step_idx += 1
+                try:
+                    inj.fire("step", step=step_idx)
+                except _faults.InjectedFault as e:
+                    print(f"bench: recovered injected fault: {e}",
+                          file=sys.stderr)
+                    recovered_faults += 1
+                guard.check(step=step_idx)
             params, stats, opt_state, loss = compiled(
                 params, stats, opt_state, images, labels)
         float(loss)
@@ -400,6 +424,11 @@ def _run_child(args) -> None:
         **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
            if args.steps_per_call != 1 else {}),
+        **({"fault_plan": os.environ.get("HVDT_FAULT_PLAN", ""),
+            "recovered_faults": recovered_faults,
+            "injected_faults": inj.fired_total(),
+            "emergency_checkpoints": PreemptionGuard.emergency_checkpoints}
+           if inj is not None else {}),
     }))
 
 

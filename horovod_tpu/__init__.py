@@ -128,7 +128,7 @@ def __getattr__(name):
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
                     "data", "native", "orchestrate", "interop",
-                    "step_pipeline", "serve", "quant"):
+                    "step_pipeline", "serve", "quant", "resilience"):
             import importlib
 
             return importlib.import_module(f".{name}", __name__)

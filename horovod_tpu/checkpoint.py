@@ -15,16 +15,29 @@ user expects):
 * ``restore_checkpoint`` — rank 0 reads, then the tree is broadcast to
   all ranks (multi-host consistency without shared storage).
 * ``CheckpointManager`` — keep-N/interval policy around the above
-  (ref: keras BestModelCheckpoint's save-frequency role).
+  (ref: keras BestModelCheckpoint's save-frequency role), hardened for
+  production failure modes: every save writes a per-step SHA-256
+  manifest and atomically advances a ``LAST_GOOD`` pointer;
+  ``restore_latest`` verifies the manifest and falls back step-by-step
+  to the newest intact checkpoint on corruption (counted, logged, never
+  a crash).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import shutil
 from typing import Any, Optional
 
+from .common.logging_util import get_logger
+
 __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager"]
+
+log = get_logger(__name__)
+
+_LAST_GOOD = "LAST_GOOD"
 
 
 def _named_dtype(name: str):
@@ -162,6 +175,9 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         self.save_interval_steps = max(1, save_interval_steps)
         self.max_to_keep = max_to_keep
+        # Audit counters for the resilience story: corrupt checkpoints
+        # detected-and-skipped during restore fallback (never a crash).
+        self.corrupt_detected = 0
         os.makedirs(self.directory, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
@@ -197,17 +213,108 @@ class CheckpointManager:
     def should_save(self, step: int) -> bool:
         return step % self.save_interval_steps == 0
 
+    # -- integrity manifest / last-good pointer ---------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        # Sibling of the step dir, not inside it: Orbax owns the dir's
+        # contents, and ``all_steps`` already skips non-integer suffixes
+        # so manifests are invisible to discovery.
+        return self._step_dir(step) + ".manifest.json"
+
+    @staticmethod
+    def _hash_file(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def _write_manifest(self, step: int) -> None:
+        """Checksum every file of a just-written step (atomic rename, so
+        a crash mid-write leaves no half manifest)."""
+        root = self._step_dir(step)
+        files = {}
+        for dirpath, _dirs, names in os.walk(root):
+            for name in names:
+                p = os.path.join(dirpath, name)
+                rel = os.path.relpath(p, root)
+                files[rel] = [os.path.getsize(p), self._hash_file(p)]
+        tmp = f"{self._manifest_path(step)}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "files": files}, f)
+        os.replace(tmp, self._manifest_path(step))
+
+    def verify_step(self, step: int) -> bool:
+        """True when the step's files match its manifest.  A step without
+        a manifest (pre-hardening checkpoint) passes — integrity checking
+        must not strand old checkpoints."""
+        root = self._step_dir(step)
+        if not os.path.isdir(root):
+            return False
+        try:
+            with open(self._manifest_path(step)) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            log.debug("checkpoint step %d has no manifest; accepting", step)
+            return True
+        except (OSError, ValueError) as e:
+            log.warning("checkpoint step %d manifest unreadable: %r", step, e)
+            return False
+        for rel, (size, digest) in manifest.get("files", {}).items():
+            p = os.path.join(root, rel)
+            try:
+                if os.path.getsize(p) != size or self._hash_file(p) != digest:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def _advance_last_good(self, step: int) -> None:
+        tmp = os.path.join(self.directory, f".{_LAST_GOOD}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(str(step))
+        os.replace(tmp, os.path.join(self.directory, _LAST_GOOD))
+
+    def last_good_step(self) -> Optional[int]:
+        """Newest step whose save fully completed (manifest written and
+        pointer atomically advanced).  Falls back to the newest on-disk
+        step still present when the pointed-at one was pruned."""
+        try:
+            with open(os.path.join(self.directory, _LAST_GOOD)) as f:
+                step = int(f.read().strip())
+        except (OSError, ValueError):
+            return self.latest_step()
+        if os.path.isdir(self._step_dir(step)):
+            return step
+        steps = [s for s in self.all_steps() if s < step]
+        return (steps[-1] if steps else self.latest_step())
+
     def save(self, step: int, tree: Any, force: bool = False) -> bool:
         """Save if the interval says so (or force); prunes old steps.
-        Returns True when a checkpoint was written."""
+        Returns True when a checkpoint was written.  On rank 0 the save
+        additionally writes the integrity manifest and — only after both
+        are durable — advances the ``LAST_GOOD`` pointer, so a crash at
+        any moment leaves the pointer on a fully verified step."""
         if not force and not self.should_save(step):
             return False
         save_checkpoint(self._step_dir(step), tree, step=step)
         rank, _ = _rank_size()
         if rank == 0:
+            self._write_manifest(step)
+            from .resilience import faults
+
+            inj = faults.get_injector()
+            if inj is not None:
+                inj.fire("checkpoint.save", step=step,
+                         path=self._step_dir(step))
+            self._advance_last_good(step)
             steps = self.all_steps()
             for old in steps[:-self.max_to_keep]:
                 shutil.rmtree(self._step_dir(old), ignore_errors=True)
+                try:
+                    os.remove(self._manifest_path(old))
+                except OSError:
+                    pass
         return True
 
     def latest_step(self) -> Optional[int]:
@@ -215,9 +322,51 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def restore_latest(self, template: Any, broadcast: bool = True):
-        """(tree, step) of the newest checkpoint, or (None, None)."""
-        step = self.latest_step()
-        if step is None:
-            return None, None
-        return restore_checkpoint(self._step_dir(step), template,
-                                  broadcast=broadcast)
+        """(tree, step) of the newest *intact* checkpoint, or
+        (None, None).
+
+        Corruption policy: a checkpoint failing manifest verification (or
+        whose restore raises) is counted (``corrupt_detected``), logged,
+        and skipped — the manager falls back step-by-step to the newest
+        checkpoint that restores cleanly instead of crashing the job.  In
+        multi-rank broadcast mode rank 0 picks the step and the choice is
+        broadcast, so ranks with skewed filesystem views cannot diverge.
+        """
+        rank, size = _rank_size()
+        collective = broadcast and size > 1
+        if collective:
+            # Rank 0 verifies and chooses; everyone restores that step
+            # through the usual broadcast path.
+            step = None
+            if rank == 0:
+                for cand in reversed(self.all_steps()):
+                    if self.verify_step(cand):
+                        step = cand
+                        break
+                    self.corrupt_detected += 1
+                    log.warning("checkpoint step %d failed verification; "
+                                "falling back", cand)
+            from .functions import broadcast_object
+
+            step = broadcast_object(step, root_rank=0, name="ckpt_step_pick")
+            if step is None:
+                return None, None
+            return restore_checkpoint(self._step_dir(step), template,
+                                      broadcast=True)
+        for cand in reversed(self.all_steps()):
+            if not self.verify_step(cand):
+                self.corrupt_detected += 1
+                log.warning("checkpoint step %d failed verification; "
+                            "falling back", cand)
+                continue
+            try:
+                return restore_checkpoint(self._step_dir(cand), template,
+                                          broadcast=broadcast)
+            except Exception as e:
+                # Manifest passed but the restore still failed (legacy
+                # checkpoint without a manifest, or reader-level rot):
+                # same policy — count, log, keep walking back.
+                self.corrupt_detected += 1
+                log.warning("checkpoint step %d restore failed (%r); "
+                            "falling back", cand, e)
+        return None, None

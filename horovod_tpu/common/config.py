@@ -84,6 +84,47 @@ KNOBS: Dict[str, Knob] = {
            "Warn when a tensor is ready on some-but-not-all ranks this long."),
         _k("HVDT_STALL_SHUTDOWN_TIME_SECONDS", 0, int,
            "Abort after this long stalled (0 = never)."),
+        _k("HVDT_STALL_ABORT_TIME_SECONDS", 0, int,
+           "Stall-escalation abort rung (resilience/escalation.py): past "
+           "this age the coordinator aborts the stalled negotiation with "
+           "an error response, so waiters raise HorovodInternalError and "
+           "the elastic retry loop recovers instead of hanging forever. "
+           "0 = disabled (warn-only, the seed behavior)."),
+        _k("HVDT_STALL_RESET_TIME_SECONDS", 0, int,
+           "Stall-escalation reset rung: past this age a worker "
+           "additionally publishes READY to the elastic driver's "
+           "registry, requesting a full re-rendezvous.  0 = disabled."),
+        # --- resilience: fault injection + failure detection ---
+        _k("HVDT_FAULT_PLAN", "", str,
+           "Declarative chaos-testing fault plan (resilience/faults.py), "
+           "e.g. 'crash@step=12:rank=1,hang@step=30:secs=20,"
+           "corrupt_ckpt@step=40,kv_drop@p=0.1'.  Empty (default) "
+           "compiles every injection point to a no-op."),
+        _k("HVDT_FAULT_SEED", 0, int,
+           "RNG seed for probabilistic fault-plan entries (kv_drop@p=...) "
+           "so chaos runs are reproducible."),
+        _k("HVDT_FAULT_JOURNAL", "", str,
+           "Path prefix for the fired-fault journal (per rank: "
+           "<path>.rank<N>).  Elastic recovery respawns processes; the "
+           "journal carries each fault's fired count across restarts so "
+           "'times' bounds fires per JOB, not per process life.  Empty "
+           "= per-process counting."),
+        _k("HVDT_CONTROL_PLANE_TIMEOUT_S", 300.0, float,
+           "Coordination-service gather/broadcast timeout — the failure-"
+           "detection latency bound: a dead peer surfaces as this timeout "
+           "firing, converted to HorovodInternalError for the elastic "
+           "retry loop.  Chaos tests shrink it to recover in seconds."),
+        _k("HVDT_ELASTIC_BLACKLIST_COOLDOWN_S", 0.0, float,
+           "Blacklist cooldown for failed hosts in elastic discovery: 0 "
+           "(default) = permanent blacklist; >0 = the host re-enters "
+           "discovery after the cooldown, doubling per repeated failure "
+           "(capped 8x).  Set on preemptible fleets where a crash rarely "
+           "means a bad machine — and for single-host chaos runs, where "
+           "a permanent blacklist would strand the job."),
+        _k("HVDT_TCP_CONNECT_RETRIES", 3, int,
+           "Socket-mesh bootstrap attempts for the native TCP data plane "
+           "(shared exponential backoff between tries): peers of a "
+           "restarted rank come up at different times."),
         # --- logging (ref: HOROVOD_LOG_LEVEL) ---
         _k("HVDT_LOG_LEVEL", "warning", str,
            "trace|debug|info|warning|error|fatal"),

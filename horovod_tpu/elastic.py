@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, List, Optional
 from .common.basics import is_initialized, rank
 from .common.exceptions import (HorovodInternalError, HostsUpdatedInterrupt)
 from .common.logging_util import get_logger
+from .resilience import faults
 
 log = get_logger(__name__)
 
@@ -69,7 +70,25 @@ class State:
         """Snapshot + check for pending host updates
         (ref: common/elastic.py:60-71 commit/check_host_updates)."""
         self.save()
+        self._resilience_check()
         self.check_host_updates()
+
+    def _resilience_check(self) -> None:
+        """Commit-point hook for the resilience machinery: fire the
+        ``step`` fault-injection point (chaos runs kill/hang/fault the
+        worker here) and poll the preemption guard (SIGTERM since the
+        last commit → emergency persist + clean exit).  Both are
+        None-checks when idle — zero work without a fault plan or
+        guard."""
+        step = getattr(self, "batch", None)
+        if not isinstance(step, int):
+            step = None
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.fire("step", step=step)
+        guard = getattr(self, "_preempt_guard", None)
+        if guard is not None:
+            guard.check(step=step)
 
     def check_host_updates(self) -> None:
         if self._notification_manager is None:
@@ -175,6 +194,9 @@ class JaxState(ObjectState):
     def commit(self) -> None:
         self.save()
         self.persist()
+        # After persist: an injected crash or a preemption exit at the
+        # commit point leaves this commit restorable on disk.
+        self._resilience_check()
         self.check_host_updates()
 
     def _split(self, payload: Dict[str, Any]):
@@ -236,6 +258,7 @@ def run(func: Callable) -> Callable:
 
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
+        _install_preemption_guard(state)
         skip_sync = False
         while True:
             if not skip_sync:
@@ -258,6 +281,31 @@ def run(func: Callable) -> Callable:
     return wrapper
 
 
+def _install_preemption_guard(state: State):
+    """Under the elastic launcher, arm a SIGTERM/SIGINT preemption guard
+    for the worker: the grace window becomes an emergency
+    save+persist and a clean PREEMPT_EXIT_CODE exit that the driver
+    treats as host removal, not failure (resilience/preempt.py).  Plain
+    (non-launcher) runs keep default signal semantics."""
+    if not _launcher_managed():
+        return None
+    from .resilience.preempt import PreemptionGuard
+
+    def emergency():
+        state.save()
+        persist = getattr(state, "persist", None)
+        if persist is not None:
+            persist()
+
+    guard = PreemptionGuard(on_preempt=emergency)
+    try:
+        guard.install()
+    except ValueError:      # not the main thread — guard unavailable
+        return None
+    state._preempt_guard = guard
+    return guard
+
+
 def _launcher_managed() -> bool:
     """True under `hvdtrun --elastic`: the driver owns worker lifecycles
     and re-rendezvous means PROCESS RESTART (the driver respawns every
@@ -278,7 +326,15 @@ def _exit_for_respawn(state: State) -> None:
     if persist is not None:
         persist()
     log.info("exiting for respawn under the new generation")
-    sys.exit(RESTART_EXIT_CODE)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # os._exit, not sys.exit: interpreter teardown runs the JAX
+    # distributed client's shutdown barrier, which waits on every peer —
+    # and on the collective-failure path a peer is DEAD, so the barrier
+    # blocks until its ~100s heartbeat timeout and then aborts the
+    # process, turning a clean restart into a failure exit.  The commit
+    # is already persisted; the process is being replaced, not torn down.
+    os._exit(RESTART_EXIT_CODE)
 
 
 def _reset(state: State) -> None:

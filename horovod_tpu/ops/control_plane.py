@@ -84,10 +84,20 @@ class CoordServiceControlPlane(ControlPlane):
     opportunistically a few cycles later.
     """
 
-    def __init__(self, namespace: str = "ctl", timeout_s: float = 300.0):
+    def __init__(self, namespace: str = "ctl",
+                 timeout_s: Optional[float] = None):
         import jax
 
         from jax._src import distributed as _dist
+
+        if timeout_s is None:
+            # Failure-detection latency bound: a dead peer surfaces as
+            # this timeout expiring in gather/broadcast, which the
+            # controller converts into HorovodInternalError → elastic
+            # recovery.  Chaos tests shrink it to recover in seconds.
+            from ..common import config
+
+            timeout_s = config.get_float("HVDT_CONTROL_PLANE_TIMEOUT_S")
 
         client = getattr(_dist.global_state, "client", None)
         if client is None:

@@ -153,9 +153,17 @@ class EagerController:
         self._local_join_handles: Dict[int, int] = {}  # ps_id -> handle
         self._cycle = 0
         self._running = True
+        from ..resilience.escalation import EscalationPolicy, Escalator
         from ..stall import StallInspector
 
-        self._stall = StallInspector(self.cp.size())
+        # Stall policy ladder (warn → abort collective → request elastic
+        # reset).  Only built when an escalation rung is configured, so
+        # the default path keeps the plain warn-only inspector.
+        policy = EscalationPolicy.from_env()
+        self._escalator = (Escalator(policy)
+                           if (policy.abort_s or policy.reset_s) else None)
+        self._stall = StallInspector(self.cp.size(),
+                                     escalator=self._escalator)
         from ..timeline import get_timeline
 
         get_timeline()  # trigger env auto-start once
@@ -358,7 +366,36 @@ class EagerController:
                 del self._joined[ps_id]
 
         self._stall.check()
+        responses.extend(self._abort_escalated_stalls())
         return self._fuse_responses(responses)
+
+    def _abort_escalated_stalls(self) -> List[Response]:
+        """Consume the escalation ladder (coordinator side): tensors past
+        the abort threshold get an error response — every waiting rank's
+        synchronize() then raises HorovodInternalError and the elastic
+        retry loop takes over, instead of the job hanging on one wedged
+        rank.  A reset-rung crossing additionally asks the elastic driver
+        for a re-rendezvous (best-effort, elastic launches only)."""
+        if self._escalator is None:
+            return []
+        out: List[Response] = []
+        names = self._escalator.drain_aborts()
+        if names:
+            for key in [k for k in list(self._message_table.pending)
+                        if k[1] in names]:
+                req = next(iter(self._message_table.pending.pop(key).values()))
+                self._stall.resolve(key[1])
+                out.append(Response(
+                    req.request_type, [key[1]], process_set_id=key[0],
+                    error_message=(
+                        f"collective {key[1]} aborted: stalled past "
+                        f"HVDT_STALL_ABORT_TIME_SECONDS (missing ranks "
+                        f"never submitted)")))
+        if self._escalator.reset_requested():
+            from ..resilience.escalation import request_elastic_reset
+
+            request_elastic_reset("stalled collective escalation")
+        return out
 
     def _construct_response(self, key: Tuple[int, str],
                             by_rank: Dict[int, Request]) -> Response:

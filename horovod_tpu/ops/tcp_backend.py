@@ -56,8 +56,15 @@ def group_for(process_set):
     The socket-mesh bootstrap happens OUTSIDE the cache lock — every
     member must be connecting concurrently for the mesh to form (in
     production one process is one rank; in tests several rank threads
-    share the process, hence also the (set, rank) cache key)."""
-    from ..native import TcpProcessGroup
+    share the process, hence also the (set, rank) cache key).
+
+    Bootstrap is retried with the shared exponential backoff
+    (``HVDT_TCP_CONNECT_RETRIES`` attempts): peers of a restarted or
+    freshly scheduled rank come up at different times, and a one-shot
+    connect turns that skew into a job failure."""
+    from ..native import NativeError, TcpProcessGroup
+    from ..resilience import faults
+    from ..resilience.retry import Backoff, retry
 
     key = (process_set.id, process_set.rank())
     with _lock:
@@ -71,8 +78,20 @@ def group_for(process_set):
     for r in process_set.ranks:
         host, port = addrs_all[r].rsplit(":", 1)
         member_addrs.append(f"{host}:{int(port) + offset}")
-    g = TcpProcessGroup(process_set.rank(), process_set.size(), member_addrs,
-                        timeout_ms=config.get_int("HVDT_TCP_TIMEOUT_MS"))
+
+    def _connect():
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.fire("tcp.connect", rank=process_set.rank())
+        return TcpProcessGroup(process_set.rank(), process_set.size(),
+                               member_addrs,
+                               timeout_ms=config.get_int("HVDT_TCP_TIMEOUT_MS"))
+
+    g = retry(_connect,
+              attempts=max(1, config.get_int("HVDT_TCP_CONNECT_RETRIES")),
+              retry_on=(NativeError, ConnectionError, OSError),
+              backoff=Backoff(first=0.2, cap=5.0),
+              describe=f"tcp mesh bootstrap (set {process_set.id})")
     with _lock:
         existing = _groups.setdefault(key, g)
     if existing is not g:

@@ -1,0 +1,37 @@
+"""Resilience subsystem: deterministic fault injection, shared
+retry/backoff, preemption-safe shutdown, and stall escalation.
+
+The elastic scaffolding (``horovod_tpu/elastic.py``, ``runner/elastic/``)
+gives the framework its fault-tolerance *shape*; this package supplies
+the machinery that makes the shape survive real failures — and the chaos
+harness that proves it (``tests/test_resilience.py``):
+
+* :mod:`~horovod_tpu.resilience.faults` — declarative fault plans
+  (``HVDT_FAULT_PLAN``) fired at injection points threaded through the
+  elastic loop, rendezvous KV, checkpoint save, and serve reload; a
+  strict no-op when unset.
+* :mod:`~horovod_tpu.resilience.retry` — the one exponential-backoff-
+  with-jitter primitive every transient-failure path shares.
+* :mod:`~horovod_tpu.resilience.preempt` — SIGTERM/SIGINT →
+  emergency checkpoint → distinct clean exit code the elastic driver
+  treats as host removal, not failure.
+* :mod:`~horovod_tpu.resilience.escalation` — the stall ladder
+  (warn → abort collective → request elastic reset) the controller
+  consumes.
+"""
+
+from .escalation import (ABORT, RESET, WARN, EscalationPolicy, Escalator,
+                         request_elastic_reset)
+from .faults import (FaultInjector, FaultSpec, InjectedFault, configure,
+                     get_injector, instrument, parse_plan)
+from .preempt import PREEMPT_EXIT_CODE, Preempted, PreemptionGuard
+from .retry import Backoff, RetriesExhausted, retry
+
+__all__ = [
+    "FaultInjector", "FaultSpec", "InjectedFault", "parse_plan",
+    "get_injector", "configure", "instrument",
+    "Backoff", "retry", "RetriesExhausted",
+    "PreemptionGuard", "Preempted", "PREEMPT_EXIT_CODE",
+    "Escalator", "EscalationPolicy", "WARN", "ABORT", "RESET",
+    "request_elastic_reset",
+]

@@ -1,0 +1,347 @@
+"""Deterministic fault injection for chaos testing the elastic stack.
+
+Horovod's fault-tolerance claims were validated by killing workers in
+integration tests (ref: test/integration/elastic_common.py — hosts
+appear/disappear on a scripted timeline).  This module generalizes that
+into a declarative, deterministic harness: a *fault plan* names what to
+break, where, and when, and injection points threaded through the
+production code paths fire the plan without any test-only forks of the
+code under test.
+
+Plan grammar (``HVDT_FAULT_PLAN`` or programmatic)::
+
+    crash@step=12:rank=1,hang@step=30:secs=20,corrupt_ckpt@step=40,kv_drop@p=0.1
+
+i.e. comma-separated ``kind@key=value:key=value`` entries.  Kinds:
+
+* ``crash``   — ``os._exit(code)`` (default 1): a hard worker death, the
+  SIGKILL/preemption analog.  Match: ``step``/``rank``.
+* ``hang``    — block the injection point for ``secs`` (default 30): a
+  stuck worker, the stall-escalation trigger.
+* ``exc``     — raise :class:`InjectedFault` (a ``HorovodInternalError``
+  subclass, so the elastic retry loop takes its restore path).
+* ``corrupt_ckpt`` — flip bytes in a just-written checkpoint (fires at
+  the ``checkpoint.save`` point, which passes the step directory): the
+  torn-write / disk-rot case the manifest verification must catch.
+* ``kv_drop`` — raise ``ConnectionError`` from rendezvous-KV client ops
+  with probability ``p``: a flaky control network.
+
+Match keys: ``step`` (fires once at the first point whose step >= it —
+commits are periodic, so exact equality would silently never fire),
+``rank`` (default: any), ``point`` (override the kind's default
+injection point), ``p`` (probability per hit, deterministic under
+``HVDT_FAULT_SEED``), ``times`` (max fires; default 1 for step-matched
+faults, unlimited for probabilistic ones), plus per-kind params
+(``secs``, ``code``).
+
+Injection points in production code::
+
+    inj = faults.get_injector()
+    if inj is not None:
+        inj.fire("step", step=batch, rank=rank)
+
+The unset-plan path is two dict-free loads and an ``is None`` branch —
+and wrapping helpers return their argument **unchanged**
+(``instrument(fn, ...) is fn``), so an idle harness adds zero wrappers
+to hot paths (verified by test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.exceptions import HorovodInternalError
+from ..common.logging_util import get_logger
+
+__all__ = ["InjectedFault", "FaultSpec", "FaultInjector", "parse_plan",
+           "get_injector", "instrument", "configure"]
+
+log = get_logger(__name__)
+
+KINDS = ("crash", "hang", "exc", "corrupt_ckpt", "kv_drop")
+
+# Default injection point per kind (spec may override with point=).
+_DEFAULT_POINT = {
+    "crash": "step",
+    "hang": "step",
+    "exc": "step",
+    "corrupt_ckpt": "checkpoint.save",
+    "kv_drop": "kv",
+}
+
+
+class InjectedFault(HorovodInternalError):
+    """Raised by ``exc`` faults.  Subclasses ``HorovodInternalError`` so
+    the elastic run() loop treats it exactly like a real collective
+    failure (restore-from-commit), while tests can still catch the
+    injected case specifically."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    point: str
+    step: Optional[int] = None
+    rank: Optional[int] = None
+    p: Optional[float] = None
+    secs: float = 30.0
+    code: int = 1
+    times: Optional[int] = None   # None = resolved default (see __post_init__)
+    fired: int = 0
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; valid: {', '.join(KINDS)}")
+        if self.times is None:
+            self.times = 1 if self.p is None else None  # None = unlimited
+
+    def matches(self, point: str, step: Optional[int],
+                rank: Optional[int], rng: random.Random) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if point != self.point:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if self.step is not None and (step is None or step < self.step):
+            return False
+        if self.p is not None and rng.random() >= self.p:
+            return False
+        return True
+
+
+def parse_plan(plan: str) -> List[FaultSpec]:
+    """Parse the comma-separated plan grammar into specs (see module
+    docstring).  Raises ValueError on malformed entries — a silently
+    dropped fault would void the chaos run's evidence."""
+    specs: List[FaultSpec] = []
+    for entry in plan.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        kind, _, rest = entry.partition("@")
+        kind = kind.strip()
+        kwargs: Dict[str, Any] = {}
+        if rest:
+            for pair in rest.split(":"):
+                key, sep, val = pair.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"fault plan entry {entry!r}: expected key=value, "
+                        f"got {pair!r}")
+                key = key.strip()
+                val = val.strip()
+                if key in ("step", "rank", "code", "times"):
+                    kwargs[key] = int(val)
+                elif key in ("p", "secs"):
+                    kwargs[key] = float(val)
+                elif key == "point":
+                    kwargs[key] = val
+                else:
+                    raise ValueError(
+                        f"fault plan entry {entry!r}: unknown key {key!r}")
+        point = kwargs.pop("point", None) or _DEFAULT_POINT.get(kind)
+        if point is None:
+            raise ValueError(f"fault plan entry {entry!r}: unknown fault "
+                             f"kind {kind!r}; valid: {', '.join(KINDS)}")
+        specs.append(FaultSpec(kind=kind, point=point, **kwargs))
+    return specs
+
+
+def _env_rank() -> Optional[int]:
+    raw = os.environ.get("HVDT_RANK")
+    try:
+        return int(raw) if raw is not None else None
+    except ValueError:
+        return None
+
+
+class FaultInjector:
+    """Executes a fault plan at named injection points.
+
+    Deterministic: probabilistic faults draw from a seeded RNG
+    (``HVDT_FAULT_SEED``, default 0), and step-matched faults fire
+    exactly ``times`` times.  ``counters`` records every fire by kind so
+    harnesses (bench, chaos tests) can audit what actually happened.
+    """
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0,
+                 journal_path: Optional[str] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 exit_fn: Callable[[int], None] = os._exit):
+        self.specs = specs
+        self._rng = random.Random(seed)
+        self._sleep = sleep_fn
+        self._exit = exit_fn
+        self.counters: Dict[str, int] = {}
+        # Fired-fault journal: the elastic model is PROCESS RESTART, so a
+        # respawned worker builds a fresh injector — without persisted
+        # fire counts, a once-only crash@step=N would kill the worker
+        # again at its first commit past N in every generation.  The
+        # journal (one spec index per line, appended BEFORE the action so
+        # a crash is recorded) reloads each spec's fired count, making
+        # `times` a per-JOB bound.  Ranks must not share one file: the
+        # launcher contract appends .rank<N>.
+        self._journal_path = journal_path
+        if journal_path:
+            try:
+                with open(journal_path) as f:
+                    for line in f:
+                        idx = int(line)
+                        if 0 <= idx < len(specs):
+                            specs[idx].fired += 1
+            except (OSError, ValueError):
+                pass
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultInjector"]:
+        plan = os.environ.get("HVDT_FAULT_PLAN", "")
+        if not plan.strip():
+            return None
+        seed = int(os.environ.get("HVDT_FAULT_SEED", "0") or 0)
+        journal = os.environ.get("HVDT_FAULT_JOURNAL", "") or None
+        if journal:
+            rank = _env_rank()
+            if rank is not None:
+                journal = f"{journal}.rank{rank}"
+        return cls(parse_plan(plan), seed=seed, journal_path=journal)
+
+    @property
+    def active(self) -> bool:
+        return bool(self.specs)
+
+    def fired_total(self) -> int:
+        return sum(self.counters.values())
+
+    def fire(self, point: str, step: Optional[int] = None,
+             rank: Optional[int] = None, **ctx: Any) -> None:
+        """Run every armed spec matching this injection point.  ``ctx``
+        carries point-specific payload (``path=`` for checkpoint
+        corruption)."""
+        if rank is None:
+            rank = _env_rank()
+        for i, spec in enumerate(self.specs):
+            if spec.matches(point, step, rank, self._rng):
+                spec.fired += 1
+                self.counters[spec.kind] = self.counters.get(spec.kind, 0) + 1
+                self._journal(i)
+                self._execute(spec, point, step, rank, ctx)
+
+    def _journal(self, spec_index: int) -> None:
+        if not self._journal_path:
+            return
+        try:
+            with open(self._journal_path, "a") as f:
+                f.write(f"{spec_index}\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            pass
+
+    # -- fault actions -----------------------------------------------------
+
+    def _execute(self, spec: FaultSpec, point: str, step: Optional[int],
+                 rank: Optional[int], ctx: Dict[str, Any]) -> None:
+        log.warning("FAULT INJECTION: %s at point=%s step=%s rank=%s",
+                    spec.kind, point, step, rank)
+        if spec.kind == "crash":
+            # os._exit, not sys.exit: a real crash runs no finalizers, no
+            # atexit checkpointing, no graceful shutdown — that is the
+            # point.
+            self._exit(spec.code)
+        elif spec.kind == "hang":
+            self._sleep(spec.secs)
+        elif spec.kind == "exc":
+            raise InjectedFault(
+                f"injected fault at point={point} step={step} rank={rank}")
+        elif spec.kind == "corrupt_ckpt":
+            path = ctx.get("path")
+            if path:
+                corrupt_checkpoint_dir(path)
+        elif spec.kind == "kv_drop":
+            raise ConnectionError(
+                f"injected kv drop at point={point} (p={spec.p})")
+
+
+def corrupt_checkpoint_dir(path: str) -> Optional[str]:
+    """Flip bytes in the largest regular file under ``path`` (the tensor
+    payload, not metadata stubs) — returns the corrupted file, or None
+    when nothing was writable.  Shared by the injector and tests."""
+    victim, size = None, -1
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            p = os.path.join(root, name)
+            try:
+                s = os.path.getsize(p)
+            except OSError:
+                continue
+            if s > size:
+                victim, size = p, s
+    if victim is None or size <= 0:
+        return None
+    with open(victim, "r+b") as f:
+        f.seek(max(0, size // 2))
+        chunk = f.read(64) or b"\x00"
+        f.seek(max(0, size // 2))
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    log.warning("FAULT INJECTION: corrupted %d bytes of %s",
+                len(chunk), victim)
+    return victim
+
+
+# ---------------------------------------------------------------------------
+# Process-wide injector (env-configured, cached on the raw plan string)
+# ---------------------------------------------------------------------------
+
+_cached_plan: Optional[str] = None
+_cached_injector: Optional[FaultInjector] = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The env-configured injector, or None when ``HVDT_FAULT_PLAN`` is
+    unset/empty.  Cached on the raw env string so per-test monkeypatching
+    rebuilds it, while the steady-state cost is one dict lookup and a
+    string compare."""
+    global _cached_plan, _cached_injector
+    plan = os.environ.get("HVDT_FAULT_PLAN")
+    if plan != _cached_plan:
+        _cached_plan = plan
+        _cached_injector = FaultInjector.from_env()
+    return _cached_injector
+
+
+def configure(plan: Optional[str], seed: int = 0) -> Optional[FaultInjector]:
+    """Programmatic plan installation (tests, harnesses).  ``None``/empty
+    disarms.  Returns the installed injector."""
+    global _cached_plan, _cached_injector
+    _cached_plan = plan
+    _cached_injector = (FaultInjector(parse_plan(plan), seed=seed)
+                        if plan and plan.strip() else None)
+    return _cached_injector
+
+
+def instrument(fn: Callable, point: str, step_from: Optional[str] = None):
+    """Wrap ``fn`` so the injector fires at ``point`` before each call.
+
+    The zero-overhead contract: with no plan configured this returns
+    ``fn`` ITSELF (identity — no wrapper object, no indirection on the
+    hot path).  ``step_from`` optionally names a kwarg of ``fn`` to
+    forward as the fault step.
+    """
+    inj = get_injector()
+    if inj is None:
+        return fn
+
+    def wrapped(*args: Any, **kwargs: Any):
+        step = kwargs.get(step_from) if step_from else None
+        inj.fire(point, step=step)
+        return fn(*args, **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "instrumented")
+    wrapped.__wrapped__ = fn
+    return wrapped

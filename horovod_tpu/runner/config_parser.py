@@ -125,6 +125,28 @@ KNOB_FLAGS: List[_Flag] = [
           "HVDT_STALL_SHUTDOWN_TIME_SECONDS", "stall_check",
           "shutdown_time_seconds", "Stall abort threshold (0 = never).",
           type=int),
+    _Flag("--stall-abort-time-seconds", "stall_abort_time",
+          "HVDT_STALL_ABORT_TIME_SECONDS", "stall_check",
+          "abort_time_seconds",
+          "Escalation rung: abort a stalled negotiation past this age "
+          "(waiters raise, elastic retry recovers; 0 = off).", type=int),
+    _Flag("--stall-reset-time-seconds", "stall_reset_time",
+          "HVDT_STALL_RESET_TIME_SECONDS", "stall_check",
+          "reset_time_seconds",
+          "Escalation rung: request an elastic re-rendezvous past this "
+          "age (0 = off).", type=int),
+    # --- resilience / chaos ---
+    _Flag("--fault-plan", "fault_plan", "HVDT_FAULT_PLAN",
+          "resilience", "fault_plan",
+          "Deterministic fault-injection plan for chaos runs, e.g. "
+          "'crash@step=12:rank=1,kv_drop@p=0.1' "
+          "(resilience/faults.py grammar)."),
+    _Flag("--blacklist-cooldown", "blacklist_cooldown",
+          "HVDT_ELASTIC_BLACKLIST_COOLDOWN_S", "resilience",
+          "blacklist_cooldown_s",
+          "Seconds a failed host sits out of elastic discovery before "
+          "becoming eligible again (0 = permanent blacklist).",
+          type=float),
     # --- library options ---
     _Flag("--cpu-operations", "cpu_operations", "HVDT_CPU_OPERATIONS",
           "library_options", "cpu_operations",

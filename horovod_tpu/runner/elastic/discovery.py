@@ -14,26 +14,50 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
+from ...common import config
 from ..hosts import HostInfo
 
 __all__ = ["HostState", "HostManager", "DiscoveredHosts"]
 
 
 class HostState:
-    """Per-host blacklist state (ref: discovery.py HostState)."""
+    """Per-host blacklist state (ref: discovery.py HostState), with an
+    optional cooldown (ref: the reference's cooldown_range blacklisting).
 
-    def __init__(self) -> None:
-        self._blacklisted = False
+    ``HVDT_ELASTIC_BLACKLIST_COOLDOWN_S`` = 0 (default) keeps the
+    permanent blacklist.  A positive cooldown makes a failed host
+    *suspect* instead of dead: it re-enters discovery after the cooldown,
+    which doubles per repeated failure (capped at 8x) so a genuinely bad
+    host converges toward exclusion while a transient crash — the common
+    case on preemptible fleets, and the only host of a small job — can
+    rejoin."""
+
+    def __init__(self, cooldown_s: Optional[float] = None) -> None:
+        if cooldown_s is None:
+            cooldown_s = config.get_float("HVDT_ELASTIC_BLACKLIST_COOLDOWN_S")
+        self._cooldown_s = cooldown_s
+        self._failures = 0
+        self._until: Optional[float] = None   # None = not blacklisted
         self._lock = threading.Lock()
 
     def blacklist(self) -> None:
         with self._lock:
-            self._blacklisted = True
+            self._failures += 1
+            if self._cooldown_s <= 0:
+                self._until = float("inf")
+            else:
+                backoff = min(2.0 ** (self._failures - 1), 8.0)
+                self._until = time.monotonic() + self._cooldown_s * backoff
+
+    @property
+    def failures(self) -> int:
+        with self._lock:
+            return self._failures
 
     @property
     def is_blacklisted(self) -> bool:
         with self._lock:
-            return self._blacklisted
+            return self._until is not None and time.monotonic() < self._until
 
 
 class DiscoveredHosts:

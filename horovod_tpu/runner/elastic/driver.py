@@ -220,12 +220,23 @@ class ElasticDriver:
 
     def record_exit(self, slot: hosts_mod.SlotInfo, gen: int,
                     code: int) -> None:
+        from ...resilience.preempt import PREEMPT_EXIT_CODE
+
         with self._lock:
             if gen != self._generation:
                 return   # stale worker from a previous generation
         if code == RESTART_EXIT_CODE:
             # Worker observed a membership change and exited for respawn:
             # it is READY for the next rendezvous, not failed.
+            self.registry.record_ready(slot.rank)
+            return
+        if code == PREEMPT_EXIT_CODE:
+            # Clean preemption exit (resilience/preempt.py): the worker
+            # checkpointed and its host is going away.  No blacklist, no
+            # failure count — just re-rendezvous; discovery drops the
+            # host once the platform reclaims it.
+            print(f"elastic: rank {slot.rank} preempted on "
+                  f"{slot.hostname} (clean removal)", file=sys.stderr)
             self.registry.record_ready(slot.rank)
             return
         if code == 0:

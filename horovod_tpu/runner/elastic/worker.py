@@ -16,9 +16,17 @@ import time
 from typing import Optional
 
 from ...common.exceptions import HostsUpdatedInterrupt
+from ...common.logging_util import get_logger
 from ..http_kv import KVClient
 
 __all__ = ["WorkerNotificationManager"]
+
+log = get_logger(__name__)
+
+# Consecutive failed KV polls before the worker warns that it is flying
+# blind on membership changes (each poll failure is individually benign —
+# commit-point polling retries — but a long streak means rendezvous loss).
+_POLL_FAIL_WARN_STREAK = 10
 
 
 class WorkerNotificationManager:
@@ -30,6 +38,7 @@ class WorkerNotificationManager:
         self._pending = False
         self._latest: Optional[int] = None
         self._last_pending: Optional[int] = None
+        self._poll_failures = 0   # consecutive; reset on any success
 
     def init(self) -> None:
         if self._client is None and "HVDT_RENDEZVOUS_ADDR" in os.environ:
@@ -51,13 +60,24 @@ class WorkerNotificationManager:
 
     def poll(self) -> bool:
         """True when the driver published a newer generation OR a pending
-        membership change (host added/removed since our rendezvous)."""
+        membership change (host added/removed since our rendezvous).
+
+        A failed poll is individually benign (the next commit retries),
+        but a long streak means the worker is blind to membership changes
+        — warn once per streak so rendezvous loss is visible in logs."""
         if self._client is None:
             return False
         try:
             raw = self._client.get("/rendezvous/version")
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as e:
+            self._poll_failures += 1
+            if self._poll_failures == _POLL_FAIL_WARN_STREAK:
+                log.warning(
+                    "elastic: %d consecutive rendezvous-KV poll failures "
+                    "(last: %r) — membership changes are not being "
+                    "observed", self._poll_failures, e)
             return False
+        self._poll_failures = 0
         with self._lock:
             if raw is not None:
                 version = int(raw)

@@ -10,6 +10,13 @@ ref: gloo/http_store.{h,cc}).
 Security note: like the reference, requests carry an HMAC digest derived
 from a per-launch secret key (ref: common/util/secret.py, network.py:58-99
 Wire) so stray processes can't join the job.
+
+Resilience: client polls use the shared exponential-backoff-with-jitter
+primitive (``resilience.retry.Backoff``) instead of fixed-interval
+sleeps, client ops carry the ``kv`` fault-injection point
+(``HVDT_FAULT_PLAN=kv_drop@p=...``), and server shutdown is
+deterministic (socket closed before the join; a leaked serve thread is
+reported, not silently abandoned).
 """
 
 from __future__ import annotations
@@ -26,6 +33,9 @@ import threading
 import time
 import urllib.parse
 from typing import Dict, Optional, Tuple
+
+from ..resilience import faults
+from ..resilience.retry import Backoff
 
 __all__ = ["RendezvousServer", "KVClient", "new_secret"]
 
@@ -123,11 +133,23 @@ class RendezvousServer(socketserver.ThreadingMixIn, http.server.HTTPServer):
         self._thread.start()
         return self.port
 
-    def stop(self) -> None:
+    def stop(self) -> bool:
+        """Deterministic teardown: stop the serve loop, close the listen
+        socket FIRST (so no handler can block on a fresh accept), then
+        join the serve thread.  Returns False — loudly — if the thread
+        outlived the join instead of leaking it silently."""
         self.shutdown()
         self.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10)
+            if t.is_alive():
+                import sys
+
+                print("hvdt-rendezvous thread leaked past shutdown",
+                      file=sys.stderr)
+                return False
+        return True
 
     # Server-side convenience for the in-process driver.
     def put_local(self, key: str, value: bytes) -> None:
@@ -169,7 +191,14 @@ class KVClient:
         return http.client.HTTPConnection(self.addr, self.port,
                                           timeout=self.timeout)
 
+    @staticmethod
+    def _fault(point: str) -> None:
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.fire(point)
+
     def put(self, key: str, value: bytes) -> None:
+        self._fault("kv")
         c = self._conn()
         try:
             c.request("PUT", urllib.parse.quote(key), body=value,
@@ -182,6 +211,7 @@ class KVClient:
             c.close()
 
     def get(self, key: str) -> Optional[bytes]:
+        self._fault("kv")
         c = self._conn()
         try:
             c.request("GET", urllib.parse.quote(key),
@@ -206,14 +236,24 @@ class KVClient:
             c.close()
 
     def wait(self, key: str, timeout: float = 60.0,
-             poll: float = 0.1) -> bytes:
-        """Poll until the key appears (bootstrap barrier helper)."""
-        deadline = time.monotonic() + timeout
+             poll: float = 0.5) -> bytes:
+        """Poll until the key appears (bootstrap barrier helper).
+
+        Backoff-with-jitter polling, not a fixed interval: every worker
+        of a large job waits on the same bootstrap keys, and fixed-period
+        polls synchronize into request storms on the single rendezvous
+        server.  ``poll`` caps the delay between probes.  Transient
+        connection errors (server restarting, injected ``kv_drop``
+        faults) are retried within the same deadline instead of aborting
+        the bootstrap."""
+        b = Backoff(first=0.02, cap=max(poll, 0.02), deadline_s=timeout)
         while True:
-            val = self.get(key)
+            try:
+                val = self.get(key)
+            except (ConnectionError, OSError):
+                val = None
             if val is not None:
                 return val
-            if time.monotonic() >= deadline:
+            if not b.sleep():
                 raise TimeoutError(f"KV key {key!r} not published "
                                    f"within {timeout}s")
-            time.sleep(poll)

@@ -16,8 +16,11 @@ key on shapes — not weights — a reload triggers **zero** recompiles.
 
 Failure policy: a half-written or corrupt checkpoint must never kill the
 serving loop.  Restore errors are logged, counted
-(``serve_reload_failures_total``), and retried at the next poll; the
-engine keeps serving the previous weights.
+(``serve_reload_failures_total``), and retried; consecutive failures
+back the poll off exponentially (capped) instead of hammering a broken
+directory at ``poll_interval_s``, and the ``serve_last_good_step`` gauge
+exposes the training side's LAST_GOOD pointer so operators can see the
+newest checkpoint that *fully* saved next to the failure counter.
 """
 
 from __future__ import annotations
@@ -28,6 +31,8 @@ from typing import Any, Callable, Optional, Union
 from ..checkpoint import CheckpointManager, restore_checkpoint
 from ..common import config
 from ..common.logging_util import get_logger
+from ..resilience import faults
+from ..resilience.retry import Backoff
 from .metrics import MetricsRegistry
 
 __all__ = ["CheckpointWatcher"]
@@ -73,9 +78,22 @@ class CheckpointWatcher:
             "previous weights)")
         self._step_gauge = self.metrics.gauge(
             "serve_checkpoint_step", "Step of the currently served weights")
+        last_good = self.metrics.gauge(
+            "serve_last_good_step",
+            "Training-side LAST_GOOD pointer: newest step whose save "
+            "fully completed (manifest + pointer); -1 when none")
+        last_good.set_function(self._last_good_value)
         self.current_step: Optional[int] = None
+        self._fail_streak = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _last_good_value(self) -> float:
+        try:
+            step = self.manager.last_good_step()
+        except OSError:
+            step = None
+        return float(step) if step is not None else -1.0
 
     def check_once(self) -> Optional[int]:
         """One poll: reload if a newer step exists.  Returns the step
@@ -93,22 +111,43 @@ class CheckpointWatcher:
             return None
         path = self.manager.step_path(latest)
         try:
+            inj = faults.get_injector()
+            if inj is not None:
+                inj.fire("serve.reload", step=latest, path=path)
             tree, step = restore_checkpoint(path, self._template,
                                             broadcast=False)
             self._on_reload(tree, latest)
         except Exception as e:
             self._failures.inc()
+            self._fail_streak += 1
             log.warning("serve reload of %s failed (still serving step "
                         "%s): %r", path, self.current_step, e)
             return None
         self.current_step = latest
+        self._fail_streak = 0
         self._step_gauge.set(latest)
         self._reloads.inc()
         log.info("serve: hot-reloaded weights from step %d", latest)
         return latest
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+        # Healthy polling runs at poll_interval_s; consecutive reload
+        # failures back off exponentially (capped at 16x) so a broken
+        # checkpoint writer is probed, not hammered.  Any success (or a
+        # quiet no-op poll) snaps back to the base interval.
+        backoff: Optional[Backoff] = None
+        while True:
+            if self._fail_streak:
+                if backoff is None:
+                    backoff = Backoff(first=self.poll_interval_s,
+                                      cap=self.poll_interval_s * 16,
+                                      jitter=0.25)
+                delay = backoff.next_delay()
+            else:
+                backoff = None
+                delay = self.poll_interval_s
+            if self._stop.wait(delay):
+                return
             self.check_once()
 
     def start(self, load_initial: bool = False) -> "CheckpointWatcher":

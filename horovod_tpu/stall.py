@@ -26,7 +26,8 @@ class StallInspector:
     def __init__(self, world_size: int,
                  warn_seconds: Optional[int] = None,
                  shutdown_seconds: Optional[int] = None,
-                 on_shutdown: Optional[Callable[[str], None]] = None):
+                 on_shutdown: Optional[Callable[[str], None]] = None,
+                 escalator: Optional[object] = None):
         self.enabled = not config.get_bool("HVDT_STALL_CHECK_DISABLE")
         self.warn_s = (warn_seconds if warn_seconds is not None
                        else config.get_int("HVDT_STALL_CHECK_TIME_SECONDS"))
@@ -34,6 +35,11 @@ class StallInspector:
                            else config.get_int("HVDT_STALL_SHUTDOWN_TIME_SECONDS"))
         self.world_size = world_size
         self.on_shutdown = on_shutdown
+        # Optional policy ladder (resilience/escalation.Escalator): every
+        # check() feeds it pending ages; its abort/reset rungs let the
+        # consumer (the eager controller) unwedge a hung negotiation
+        # instead of warning forever.
+        self.escalator = escalator
         # tensor name -> (first_seen_ts, ranks that reported)
         self._pending: Dict[str, tuple] = {}
         self._warned: Set[str] = set()
@@ -51,6 +57,8 @@ class StallInspector:
     def resolve(self, name: str) -> None:
         self._pending.pop(name, None)
         self._warned.discard(name)
+        if self.escalator is not None:
+            self.escalator.resolve(name)
 
     def check(self) -> List[str]:
         """Run the stall check; returns names of stalled tensors
@@ -65,6 +73,8 @@ class StallInspector:
         stalled = []
         for name, (ts, ranks) in self._pending.items():
             age = now - ts
+            if self.escalator is not None:
+                self.escalator.observe(name, age)
             if age > self.warn_s and name not in self._warned:
                 missing = sorted(set(range(self.world_size)) - ranks)
                 log.warning(

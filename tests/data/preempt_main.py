@@ -1,0 +1,32 @@
+"""Preemption chaos target: a tiny training loop guarded by
+PreemptionGuard.  The test sends SIGTERM mid-loop and asserts the
+emergency checkpoint landed and the process exited with the clean
+preemption code (resilience/preempt.py PREEMPT_EXIT_CODE)."""
+
+import json
+import os
+import sys
+import time
+
+from horovod_tpu.resilience.preempt import PreemptionGuard
+
+
+def main():
+    out_path = os.environ["PREEMPT_TEST_OUT"]
+    state = {"step": 0}
+
+    def emergency():
+        with open(out_path, "w") as f:
+            json.dump({"step": state["step"], "emergency": True}, f)
+
+    guard = PreemptionGuard(on_preempt=emergency).install()
+    print("ready", flush=True)   # parent waits for this before SIGTERM
+    while state["step"] < 10_000:
+        state["step"] += 1
+        time.sleep(0.01)
+        guard.check(step=state["step"])   # exits 83 after the signal
+    return 1   # loop should never finish in the test
+
+
+if __name__ == "__main__":
+    sys.exit(main())

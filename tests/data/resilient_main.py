@@ -1,0 +1,113 @@
+"""Chaos-recovery training script for the resilience battery.
+
+Like ``elastic_main.py`` (same "rank size batch lr_milli ts_ms" log
+contract) but with the cross-rank coupling carried by rendezvous-KV
+heartbeats instead of eager collectives: the container's CPU-only jax
+cannot run multiprocess XLA computations, and the recovery machinery
+under test — fault injection at commit points, peer-death detection →
+``HorovodInternalError`` → elastic restore/respawn, cooldown blacklist,
+disk-commit resume — is identical either way.  Each rank publishes its
+batch as ``/hb/<rank>`` and waits (shared Backoff) for every peer to
+reach ``batch - 1``; a dead peer turns into a heartbeat stall, which
+raises exactly what a dead collective raises.
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+from horovod_tpu.resilience.retry import Backoff  # noqa: E402
+
+BASE_LR = 0.1
+
+
+class LocalSyncJaxState(hvd.elastic.JaxState):
+    """JaxState whose rank consistency comes from the shared disk commit
+    (all ranks resume the same ``path``) instead of a broadcast — the
+    CPU test environment has no multiprocess data plane to ride."""
+
+    def sync(self):
+        self.save()
+
+
+def _kv_client():
+    if "HVDT_RENDEZVOUS_ADDR" not in os.environ:
+        return None
+    from horovod_tpu.runner.http_kv import KVClient
+
+    return KVClient.from_env()
+
+
+def _wait_for_peers(kv, my_rank, size, need, timeout_s):
+    """Block until every peer's heartbeat reaches ``need``; a stalled
+    peer (crashed worker) surfaces as HorovodInternalError, the same
+    signal a dead collective produces."""
+    b = Backoff(first=0.05, cap=0.5, deadline_s=timeout_s)
+    while True:
+        behind = None
+        for r in range(size):
+            if r == my_rank:
+                continue
+            try:
+                raw = kv.get(f"/hb/{r}")
+            except (ConnectionError, OSError):
+                raw = None
+            if raw is None or int(raw) < need:
+                behind = r
+                break
+        if behind is None:
+            return
+        if not b.sleep():
+            raise HorovodInternalError(
+                f"peer {behind} heartbeat stalled below batch {need}")
+
+
+def main():
+    log_path = os.environ["ELASTIC_TEST_LOG"]
+    state_path = os.environ["ELASTIC_TEST_STATE"]
+    total_batches = int(os.environ.get("ELASTIC_TEST_BATCHES", "30"))
+    sleep_s = float(os.environ.get("ELASTIC_TEST_SLEEP", "0.25"))
+    hb_timeout_s = float(os.environ.get("ELASTIC_TEST_HB_TIMEOUT", "8"))
+
+    hvd.init()
+    state = LocalSyncJaxState(path=state_path,
+                              w=np.zeros(4, np.float32), batch=0)
+
+    def log_line(batch, lr):
+        with open(log_path, "a") as f:
+            f.write(f"{hvd.rank()} {hvd.size()} {batch} "
+                    f"{int(lr * 1000)} {int(time.time() * 1000)}\n")
+
+    @hvd.elastic.run
+    def train(state):
+        kv = _kv_client()
+        lr = BASE_LR * hvd.size()
+        while state.batch < total_batches:
+            state.w = state.w + lr * np.ones(4, np.float32)
+            state.batch += 1
+            log_line(state.batch, lr)
+            if kv is not None and hvd.size() > 1:
+                kv.put(f"/hb/{hvd.rank()}", str(state.batch).encode())
+                _wait_for_peers(kv, hvd.rank(), hvd.size(),
+                                state.batch - 1, hb_timeout_s)
+            if state.batch % 5 == 0:
+                state.commit()   # fault-plan 'step' point fires here
+            time.sleep(sleep_s)
+
+    train(state)
+    hvd.shutdown()
+    if int(os.environ.get("HVDT_RANK", 0)) == 0:
+        # Loss-continuity witness: each batch adds lr exactly once
+        # across crash/restore, so w0 == sum of per-batch lr.
+        print(f"final: batches={state.batch} w0={float(state.w[0]):.1f}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

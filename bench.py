@@ -48,10 +48,11 @@ def _save_last_good(line: str) -> None:
         if d.get("platform") in (None, "cpu"):
             return
         if d.get("steps_per_call") or d.get("fused_optimizer") \
-                or d.get("fault_plan"):
-            # A/B probe variants and chaos runs are not the headline
-            # metric — caching one would contaminate the outage-fallback
-            # evidence.
+                or d.get("fault_plan") or d.get("telemetry"):
+            # A/B probe variants, chaos runs, and telemetry-instrumented
+            # runs are not the headline metric — caching one would
+            # contaminate the outage-fallback evidence (telemetry adds
+            # timer + straggler-probe overhead to the measured loop).
             return
         if os.environ.get("HVDT_BENCH_NO_CACHE", "") not in ("", "0"):
             # Experimental-config A/B legs (e.g. HVDT_FUSED_CONV1X1=1)
@@ -71,23 +72,14 @@ def _load_last_good():
     except (OSError, ValueError):
         return None
 
-# bf16 peak TFLOP/s and HBM GB/s by TPU generation (device_kind substring,
-# lowercase).
-_PEAK = (
-    ("v6", 918e12, 1640e9), ("trillium", 918e12, 1640e9),
-    ("v5p", 459e12, 2765e9),
-    ("v5 lite", 197e12, 819e9), ("v5e", 197e12, 819e9),
-    ("v5litepod", 197e12, 819e9),
-    ("v4", 275e12, 1228e9), ("v3", 123e12, 900e9), ("v2", 46e12, 700e9),
-)
-
-
 def _peak_for(device_kind: str):
-    dk = device_kind.lower()
-    for sub, flops, bw in _PEAK:
-        if sub in dk:
-            return flops, bw
-    return None, None
+    """bf16 peak FLOP/s and HBM B/s by TPU generation.  The table lives
+    in telemetry/step_stats.py (one home for the MFU math); imported
+    lazily because only the CHILD may import horovod_tpu (the parent
+    never imports JAX)."""
+    from horovod_tpu.telemetry.step_stats import peak_flops_for
+
+    return peak_flops_for(device_kind)
 
 
 def _parse_args(argv=None):
@@ -312,6 +304,34 @@ def _run_child(args) -> None:
     except (KeyError, TypeError, ValueError):
         bytes_per_step = None
 
+    # Telemetry mode (HVDT_TELEMETRY=1): hvd.init() starts the /metrics
+    # exporter, a StepTimer publishes step-time percentiles / examples/s
+    # / MFU (from the cost-analysis flops above), the goodput ledger
+    # books the compile, and the straggler monitor's periodic eager
+    # allgather probe exercises the instrumented collective path — so a
+    # scrape mid-run shows nonzero bytes-on-wire counters.  The
+    # accounting happens OUTSIDE the timed regions; the run is still
+    # excluded from the last-good headline cache.
+    telemetry_timer = telemetry_ledger = None
+    from horovod_tpu.telemetry import instrument as _tinst
+
+    if _tinst.enabled():
+        import horovod_tpu as hvd
+        from horovod_tpu import telemetry as _tele
+
+        hvd.init()
+        telemetry_ledger = _tele.GoodputLedger(already_elapsed=compile_s)
+        telemetry_ledger.charge("recompile", compile_s)
+        telemetry_timer = _tele.StepTimer(
+            examples_per_step=args.batch_size,
+            flops_per_step=flops_per_step,
+            device_kind=dev.device_kind,
+            straggler=_tele.StragglerMonitor())
+        exp = _tele.get_exporter()
+        if exp is not None:
+            print(f"telemetry /metrics on port {exp.port}",
+                  file=sys.stderr)
+
     # Timing contract: end every timed region with a HOST FETCH of a scalar
     # that data-depends on the last step (float(loss)), never
     # block_until_ready.  On tunnelled/experimental PJRT backends
@@ -359,6 +379,11 @@ def _run_child(args) -> None:
         dt = time.perf_counter() - t0
         rates.append(args.batch_size * args.num_batches_per_iter
                      * args.steps_per_call / dt)
+        if telemetry_timer is not None:
+            steps_this_iter = args.num_batches_per_iter * args.steps_per_call
+            per_step = dt / steps_this_iter
+            for _ in range(steps_this_iter):
+                telemetry_timer.observe(per_step)
 
     value = float(np.mean(rates))
     peak, peak_bw = _peak_for(dev.device_kind)
@@ -401,6 +426,16 @@ def _run_child(args) -> None:
     print(f"img/sec per iter: {[round(r, 1) for r in rates]} "
           f"(+-{float(np.std(rates)):.1f}); final loss {float(loss):.3f}; "
           f"flops/step {flops_per_step:.3e}", file=sys.stderr)
+    telemetry_doc = None
+    if telemetry_timer is not None:
+        from horovod_tpu.telemetry import exporter as _texp
+
+        telemetry_doc = _texp.snapshot_dict()
+        telemetry_doc["goodput_fraction"] = round(
+            telemetry_ledger.fraction(), 4)
+        exp = _texp.get_exporter()
+        if exp is not None:
+            telemetry_doc["metrics_port"] = exp.port
     print(json.dumps({
         "metric": METRIC,
         "value": round(value, 2),
@@ -429,6 +464,7 @@ def _run_child(args) -> None:
             "injected_faults": inj.fired_total(),
             "emergency_checkpoints": PreemptionGuard.emergency_checkpoints}
            if inj is not None else {}),
+        **({"telemetry": telemetry_doc} if telemetry_doc else {}),
     }))
 
 

@@ -128,7 +128,8 @@ def __getattr__(name):
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
                     "data", "native", "orchestrate", "interop",
-                    "step_pipeline", "serve", "quant", "resilience"):
+                    "step_pipeline", "serve", "quant", "resilience",
+                    "telemetry"):
             import importlib
 
             return importlib.import_module(f".{name}", __name__)

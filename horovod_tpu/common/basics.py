@@ -256,13 +256,23 @@ def init(
         _state.initialized = True
         log.info("initialized: %s", topo)
 
+        # Telemetry exporter (HVDT_TELEMETRY=1): per-worker /metrics +
+        # /healthz on HVDT_METRICS_PORT + local_rank.  No-op when the
+        # subsystem is off; never raises (observability must not sink
+        # init).
+        from ..telemetry.exporter import maybe_start_exporter
+
+        maybe_start_exporter(topology=topo)
+
 
 def shutdown() -> None:
     """Tear down (ref: operations.cc horovod_shutdown)."""
+    from ..telemetry.exporter import stop_exporter
     from ..timeline import stop_timeline
 
     from ..ops import tcp_backend
 
+    stop_exporter()
     with _state.lock:
         if not _state.initialized:
             stop_timeline()  # a timeline may exist without init

@@ -73,6 +73,32 @@ KNOBS: Dict[str, Knob] = {
            "autotune search space; the step builder is then rebuilt "
            "with fused=... at each knob change (autotune.AutotunedStep). "
            "Starting point comes from HVDT_FUSED_OPTIMIZER."),
+        # --- telemetry (horovod_tpu/telemetry: metrics registry,
+        #     per-collective instrumentation, straggler detection,
+        #     per-worker /metrics exporter — no reference analog beyond
+        #     the Timeline; the observability subsystem) ---
+        _k("HVDT_TELEMETRY", False, _parse_bool,
+           "Enable the unified telemetry subsystem: per-collective "
+           "bytes/latency metrics, step stats (examples/s, MFU, goodput),"
+           " straggler detection, and the per-worker /metrics HTTP "
+           "exporter (started by hvd.init()).  Off (default) installs "
+           "ZERO wrapper objects on the hot paths "
+           "(telemetry.instrument.get_recorder() is None)."),
+        _k("HVDT_METRICS_PORT", 9090, int,
+           "Base port for the per-worker /metrics + /healthz exporter; "
+           "each worker binds base + local_rank (0 = ephemeral port).  "
+           "A taken slot falls back to ephemeral with a logged warning."),
+        _k("HVDT_STRAGGLER_WINDOW", 64, int,
+           "Steps between cross-rank step-duration allgathers for "
+           "straggler detection (telemetry/straggler.py).  0 disables "
+           "the cross-rank check."),
+        _k("HVDT_STRAGGLER_THRESHOLD", 2.0, float,
+           "A rank is flagged as a straggler when its mean step time "
+           "over the last window exceeds this multiple of the median."),
+        _k("HVDT_TELEMETRY_PUBLISH_S", 30.0, float,
+           "Seconds between worker snapshot publishes to the rendezvous "
+           "KV (/telemetry/<rank>) for driver-side aggregation; only "
+           "active under the elastic launcher.  0 disables publishing."),
         # --- timeline (ref: HOROVOD_TIMELINE common.h:110) ---
         _k("HVDT_TIMELINE", "", str,
            "Write per-tensor Chrome-tracing timeline JSON to this path."),

@@ -13,10 +13,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
+
+from ..common.logging_util import get_logger
 
 __all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "AsyncDataLoader",
            "prefetch_to_device"]
+
+log = get_logger(__name__)
 
 
 class BaseDataLoader:
@@ -58,34 +63,62 @@ class AsyncDataLoaderMixin:
     consumer; ``close()`` joins the thread.
     """
 
-    def __init__(self, *args, async_loader_queue_size: int = 64, **kwargs):
+    def __init__(self, *args, async_loader_queue_size: int = 64,
+                 close_timeout_s: float = 5.0, **kwargs):
         self._queue_size = async_loader_queue_size
+        self._close_timeout_s = float(close_timeout_s)
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         super().__init__(*args, **kwargs)
 
     def close(self) -> None:
-        if self._thread is not None:
-            self._stop.set()
-            # Drain so a blocked producer can observe the stop flag.
-            while self._thread.is_alive():
+        thread = self._thread
+        if thread is None:
+            return
+        self._stop.set()
+        # Two safety nets against the close-mid-iteration hang: the
+        # producer's puts are bounded (it re-checks the stop flag every
+        # timeout, so it can never stay parked on a full queue), and the
+        # drain below unblocks it immediately rather than after the put
+        # timeout.  The join is bounded too — a producer wedged inside
+        # the UPSTREAM iterator (not our queue) must not hang close();
+        # it is a daemon thread and dies with the process.
+        deadline = time.monotonic() + self._close_timeout_s
+        while thread.is_alive() and time.monotonic() < deadline:
+            if self._queue is not None:
                 try:
                     self._queue.get_nowait()
                 except queue.Empty:
                     pass
-                self._thread.join(0.01)
-            self._thread = None
+            thread.join(0.01)
+        if thread.is_alive():
+            log.warning(
+                "async loader producer did not exit within %.1fs of "
+                "close() (blocked in the upstream iterator?); abandoning "
+                "the daemon thread", self._close_timeout_s)
+        self._thread = None
+
+    def _put(self, item: Any) -> bool:
+        """Bounded put: parks at most 50 ms at a time so a producer
+        blocked on a full queue observes close()'s stop flag.  Returns
+        False when shut down instead of delivering."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _producer(self) -> None:
         try:
             for batch in super()._iterate():
-                if self._stop.is_set():
-                    break
-                self._queue.put(batch)
-            self._queue.put(_Done())
+                if self._stop.is_set() or not self._put(batch):
+                    return
+            self._put(_Done())
         except BaseException as e:  # noqa: BLE001 - re-raised in consumer
-            self._queue.put(_Raised(e))
+            self._put(_Raised(e))
 
     def _iterate(self) -> Iterator[Any]:
         if self._queue_size == 0:  # async disabled (ref contract)

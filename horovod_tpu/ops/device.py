@@ -458,6 +458,15 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         return tree
     buckets = fused_allreduce_buckets(leaves, threshold_bytes)
 
+    # Telemetry (trace time): under jit the compiled program, not this
+    # host code, executes the collectives — so jit-path counters are
+    # labelled path=jit and count traced bucket programs (the quantized
+    # branch records its own wire accounting inside
+    # quantized_allreduce_flat).
+    from ..telemetry import instrument as _ti
+
+    _rec = _ti.get_recorder()
+
     out_leaves: List[Optional[jax.Array]] = [None] * len(leaves)
     for bi, bucket in enumerate(buckets):
         parts = [leaves[i] for i in bucket]
@@ -468,6 +477,14 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         orig_dtype = flat.dtype
         if wire_dtype is not None and flat.dtype != wire_dtype:
             flat = flat.astype(wire_dtype)
+        if _rec is not None:
+            bucket_bytes = int(flat.size) * jnp.dtype(flat.dtype).itemsize
+            _rec.observe_fusion_fill(bucket_bytes / float(threshold_bytes))
+            if not (quant_wire and jnp.issubdtype(orig_dtype, jnp.floating)):
+                _rec.record_collective(
+                    "allreduce", jnp.dtype(orig_dtype).name,
+                    jnp.dtype(flat.dtype).name, bucket_bytes,
+                    count=len(parts), path="jit")
         # Named scope per fused bucket — the jit-trace analog of the
         # reference's NVTX op ranges; buckets appear as
         # hvdt.fused_allreduce.bN in XPlane/profiler output.

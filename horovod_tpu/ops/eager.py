@@ -60,7 +60,8 @@ log = get_logger(__name__)
 class _Entry:
     """Local in-flight tensor (ref: TensorTableEntry common.h:348-382)."""
 
-    __slots__ = ("request", "tensor", "handle", "enqueue_ts", "was_jax")
+    __slots__ = ("request", "tensor", "handle", "enqueue_ts", "was_jax",
+                 "announce_ts")
 
     def __init__(self, request: Request, tensor: Optional[np.ndarray],
                  handle: int, was_jax: bool):
@@ -69,6 +70,10 @@ class _Entry:
         self.handle = handle
         self.enqueue_ts = time.monotonic()
         self.was_jax = was_jax
+        # Stamped by the background cycle when the request is announced —
+        # telemetry splits enqueue->announce (queue) from
+        # announce->response (negotiate).  None when telemetry is off.
+        self.announce_ts: Optional[float] = None
 
 
 class ResponseCache:
@@ -256,9 +261,18 @@ class EagerController:
                 idle_sleep = 0.0001
 
     def _run_cycle(self) -> bool:
+        from ..telemetry import instrument as _ti
+
         with self._lock:
             to_send = self._to_announce
             self._to_announce = []
+            if to_send and _ti.get_recorder() is not None:
+                now = time.monotonic()
+                for req in to_send:
+                    e = self._entries.get(
+                        (req.process_set_id, req.tensor_name))
+                    if e is not None:
+                        e.announce_ts = now
         multi = self.cp.size() > 1
         if not multi and not to_send:
             return False
@@ -558,6 +572,27 @@ class EagerController:
                 self._timeline.end_activity(name)
                 self._timeline.start_activity(name, f"EXEC_{rt.name}",
                                               {"fused": len(resp.tensor_names)})
+        from ..telemetry import instrument as _ti
+
+        rec = _ti.get_recorder()
+        t_exec0 = time.monotonic() if rec is not None else 0.0
+        if rec is not None:
+            dtype = numpy_dtype_of_safe(resp.tensor_type)
+            nbytes = sum(
+                int(np.prod(shape)) * dtype.itemsize if shape else
+                dtype.itemsize
+                for shape in (resp.tensor_shapes or []))
+            rec.record_collective(rt.name, dtype.name, dtype.name, nbytes,
+                                  count=len(resp.tensor_names),
+                                  path="eager")
+            for entry in entries:
+                if entry is None:
+                    continue
+                if entry.announce_ts is not None:
+                    rec.observe_queue(entry.announce_ts - entry.enqueue_ts)
+                    rec.observe_negotiate(t_exec0 - entry.announce_ts)
+                else:
+                    rec.observe_negotiate(t_exec0 - entry.enqueue_ts)
         try:
             import jax
 
@@ -580,6 +615,8 @@ class EagerController:
                         Status.unknown(f"{type(e).__name__}: {e}"))
             raise
         finally:
+            if rec is not None:
+                rec.observe_execute(time.monotonic() - t_exec0)
             if self._timeline:
                 for name, shape in zip(resp.tensor_names,
                                        resp.tensor_shapes or

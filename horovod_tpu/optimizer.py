@@ -254,6 +254,20 @@ def DistributedOptimizer(optimizer,
 
     if compression is None:
         compression = Compression.from_env()
+    from .telemetry.instrument import get_recorder
+
+    _rec = get_recorder()
+    if _rec is not None:
+        # Construction-time config record: the jit-traced update can't
+        # report per-step from inside the program, but which wire format
+        # / reduce op the job trains with is the label every collective
+        # series gets joined against.
+        _rec.registry.counter(
+            "hvdt_distributed_optimizer_builds_total",
+            "DistributedOptimizer constructions, labelled op/compression"
+        ).inc(op=ReduceOp(op).name.lower(),
+              compression=getattr(compression, "__name__", "none"),
+              backward_passes=str(backward_passes_per_step))
     comm = DistributedGradientTransformation(
         axis=axis, op=op, compression=compression,
         threshold_bytes=threshold_bytes, prescale_factor=prescale_factor,

@@ -88,6 +88,17 @@ def quantized_allreduce_flat(flat, axis="dp",
     dtype = flat.dtype
     size = flat.shape[0]
 
+    # Telemetry (trace time, path=jit — the compiled program executes the
+    # wire hops): record the int8 wire-format payload this bucket's
+    # program moves per hop (qk.wire_bytes = 1 B/elem + f32 block scales).
+    from ..telemetry import instrument as _ti
+
+    _rec = _ti.get_recorder()
+    if _rec is not None:
+        _rec.record_collective("allreduce", jnp.dtype(dtype).name,
+                               INT8_WIRE, qk.wire_bytes(size, block),
+                               path="jit")
+
     x = flat.astype(jnp.float32)
     if prescale_factor != 1.0:
         x = x * prescale_factor
@@ -205,6 +216,16 @@ def eager_quantized_allreduce(tensor, name: Optional[str] = None,
     packed = np.concatenate(
         [q.reshape(-1).view(np.uint8),
          scale[:, 0].astype(np.float32).view(np.uint8)])
+    from ..telemetry import instrument as _ti
+
+    _rec = _ti.get_recorder()
+    if _rec is not None:
+        # Wire-format accounting under the quantized label; the generic
+        # eager counter also books the allgather under its own
+        # op=allgather/dtype=uint8 label (different label set, not a
+        # double count of the same series).
+        _rec.record_collective("allreduce", str(dtype), INT8_WIRE,
+                               packed.size, path="eager")
     gathered = eager.allgather(packed, name=name and f"{name}.q8",
                                process_set=process_set)
     per_rank = np.asarray(gathered).reshape(-1, packed.size)

@@ -34,6 +34,10 @@ YAML shape (mirrors the reference's config sections)::
       disabled: false
       warning_time_seconds: 60
       shutdown_time_seconds: 0
+    telemetry:
+      enabled: true
+      metrics_port: 9090
+      straggler_window: 64
     library_options:
       cpu_operations: tcp
       tcp_port_stride: 128
@@ -147,6 +151,21 @@ KNOB_FLAGS: List[_Flag] = [
           "Seconds a failed host sits out of elastic discovery before "
           "becoming eligible again (0 = permanent blacklist).",
           type=float),
+    # --- telemetry / observability ---
+    _Flag("--telemetry", "telemetry", "HVDT_TELEMETRY",
+          "telemetry", "enabled",
+          "Enable the unified telemetry subsystem on every worker: "
+          "per-collective metrics, step stats (MFU/goodput), straggler "
+          "detection, and the /metrics HTTP exporter.", is_bool=True,
+          to_env=_bool_env),
+    _Flag("--metrics-port", "metrics_port", "HVDT_METRICS_PORT",
+          "telemetry", "metrics_port",
+          "Base port for each worker's /metrics + /healthz exporter "
+          "(worker binds base + local_rank; 0 = ephemeral).", type=int),
+    _Flag("--straggler-window", "straggler_window",
+          "HVDT_STRAGGLER_WINDOW", "telemetry", "straggler_window",
+          "Steps between cross-rank straggler checks (0 = off).",
+          type=int),
     # --- library options ---
     _Flag("--cpu-operations", "cpu_operations", "HVDT_CPU_OPERATIONS",
           "library_options", "cpu_operations",

@@ -160,6 +160,19 @@ class ElasticDriver:
         collective failure recovery in its training loop)."""
         self.registry.record_ready(rank)
 
+    def telemetry_snapshots(self):
+        """Aggregate worker telemetry snapshots from the rendezvous KV
+        (workers publish /telemetry/<rank> every
+        HVDT_TELEMETRY_PUBLISH_S when HVDT_TELEMETRY is on).  Returns
+        {rank: snapshot_dict}; empty when no KV or nothing published —
+        the driver-side half of the observability subsystem
+        (telemetry/exporter.collect_driver_snapshots)."""
+        if self._kv is None:
+            return {}
+        from ...telemetry.exporter import collect_driver_snapshots
+
+        return collect_driver_snapshots(self._kv)
+
     def _notify_hosts_updated(self) -> None:
         with self._cond:
             self._cond.notify_all()

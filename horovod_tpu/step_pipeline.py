@@ -94,8 +94,15 @@ def donated_step(fn, *, donate_argnums=(0, 1), compile_cache=None,
 
     Returns the jitted callable unchanged otherwise — ``.lower()``,
     static args, shard_map bodies all work as with plain ``jax.jit``.
+    With telemetry on (``HVDT_TELEMETRY=1``) the callable is wrapped so
+    each call's dispatch duration feeds ``hvdt_step_dispatch_seconds``
+    (attribute access still forwards to the jitted fn); telemetry off
+    returns the jitted fn itself — zero wrapper objects.
     """
     import jax
 
+    from .telemetry.instrument import wrap_step
+
     enable_compilation_cache(compile_cache)
-    return jax.jit(fn, donate_argnums=donate_argnums, **jit_kwargs)
+    return wrap_step(jax.jit(fn, donate_argnums=donate_argnums,
+                             **jit_kwargs))

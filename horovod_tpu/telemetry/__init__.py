@@ -1,0 +1,69 @@
+"""Unified telemetry: metrics registry, instrumentation, straggler
+detection, and the per-worker /metrics exporter.
+
+The observability layer the training stack was missing (the serving
+plane had its own Prometheus-text metrics; training had Chrome traces
+and ad-hoc module-level ints).  Layering, bottom up:
+
+* :mod:`~horovod_tpu.telemetry.metrics` — Counter / Gauge / Summary
+  primitives + the process-wide :func:`default_registry` (promoted out
+  of ``serve/metrics.py``, which re-exports for back-compat);
+* :mod:`~horovod_tpu.telemetry.instrument` — per-collective hook points
+  threaded through the eager and jit data planes; zero-overhead identity
+  objects when ``HVDT_TELEMETRY`` is off;
+* :mod:`~horovod_tpu.telemetry.step_stats` — :class:`StepTimer`
+  (step time, examples/s, MFU) and :class:`GoodputLedger` (time lost to
+  recompiles / restores / recovered faults);
+* :mod:`~horovod_tpu.telemetry.straggler` — cross-rank step-duration
+  skew detection publishing a ``straggler_rank`` gauge;
+* :mod:`~horovod_tpu.telemetry.exporter` — per-worker ``/metrics`` +
+  ``/healthz`` HTTP endpoint (started by ``hvd.init()`` when enabled)
+  and driver-side snapshot aggregation over the rendezvous KV.
+
+Knobs: ``HVDT_TELEMETRY``, ``HVDT_METRICS_PORT``,
+``HVDT_STRAGGLER_WINDOW``, ``HVDT_STRAGGLER_THRESHOLD``,
+``HVDT_TELEMETRY_PUBLISH_S`` (common/config.py); launcher flags
+``hvdtrun --telemetry`` / ``--metrics-port``.  See docs/observability.md
+for the metric catalog and a scrape example.
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    Summary,
+    default_registry,
+    reset_default_registry,
+)
+from .instrument import (  # noqa: F401
+    CollectiveRecorder,
+    enabled,
+    get_recorder,
+    wrap_step,
+)
+from .step_stats import (  # noqa: F401
+    GoodputLedger,
+    StepTimer,
+    bind_resilience_gauges,
+    peak_flops_for,
+)
+from .straggler import StragglerMonitor  # noqa: F401
+from .exporter import (  # noqa: F401
+    MetricsExporter,
+    collect_driver_snapshots,
+    get_exporter,
+    maybe_start_exporter,
+    snapshot_dict,
+    start_exporter,
+    stop_exporter,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Summary", "MetricsRegistry",
+    "default_registry", "reset_default_registry",
+    "CollectiveRecorder", "enabled", "get_recorder", "wrap_step",
+    "StepTimer", "GoodputLedger", "bind_resilience_gauges",
+    "peak_flops_for", "StragglerMonitor",
+    "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
+    "maybe_start_exporter", "snapshot_dict", "collect_driver_snapshots",
+]

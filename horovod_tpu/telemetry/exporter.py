@@ -1,0 +1,281 @@
+"""Per-worker /metrics HTTP exporter + driver-side snapshot aggregation.
+
+Every training worker gets its own scrape endpoint (stdlib
+``ThreadingHTTPServer``, same zero-dependency stance as the serving front
+end): ``/metrics`` renders the process-wide default registry as
+Prometheus text, ``/healthz`` answers liveness with rank/step.  The bind
+port is ``HVDT_METRICS_PORT + local_rank`` (ranks on one host must not
+collide; different hosts can share the base port), falling back to an
+ephemeral port — with a logged warning — when the slot is taken, because
+a scrape endpoint must never be the reason training didn't start.
+
+``hvd.init()`` starts the exporter automatically when ``HVDT_TELEMETRY``
+is on (:func:`maybe_start_exporter`); ``hvd.shutdown()`` stops it.
+
+Driver-side aggregation: under the elastic launcher, each worker also
+publishes a compact JSON snapshot to the rendezvous KV
+(``/telemetry/<rank>``) at most every ``HVDT_TELEMETRY_PUBLISH_S``
+seconds, and :func:`collect_driver_snapshots` (used by
+``ElasticDriver.telemetry_snapshots``) reads them back — so the driver
+can answer "what is the fleet's goodput / who is the straggler" without
+scraping N worker endpoints itself.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from ..common import config
+from ..common.logging_util import get_logger
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["MetricsExporter", "start_exporter", "stop_exporter",
+           "get_exporter", "maybe_start_exporter", "snapshot_dict",
+           "collect_driver_snapshots"]
+
+log = get_logger(__name__)
+
+KV_PREFIX = "/telemetry/"
+
+
+def snapshot_dict(registry: Optional[MetricsRegistry] = None
+                  ) -> Dict[str, Any]:
+    """Compact, JSON-able roll-up of the headline training metrics — what
+    workers publish to the driver and bench.py embeds in its output."""
+    reg = registry if registry is not None else default_registry()
+    out: Dict[str, Any] = {}
+    bytes_total = reg.get("hvdt_collective_bytes_total")
+    if bytes_total is not None:
+        out["bytes_on_wire_total"] = bytes_total.total()
+    coll = reg.get("hvdt_collectives_total")
+    if coll is not None:
+        out["collectives_total"] = coll.total()
+    steps = reg.get("hvdt_step_time_seconds")
+    if steps is not None and steps.count:
+        pct = steps.percentiles()
+        out["steps"] = steps.count
+        out["step_time_p50_ms"] = (round(pct[0.5] * 1e3, 3)
+                                   if pct[0.5] is not None else None)
+        out["step_time_p99_ms"] = (round(pct[0.99] * 1e3, 3)
+                                   if pct[0.99] is not None else None)
+    for gname, key in (("hvdt_mfu", "mfu"),
+                       ("hvdt_examples_per_sec", "examples_per_sec"),
+                       ("hvdt_goodput_fraction", "goodput_fraction"),
+                       ("hvdt_straggler_rank", "straggler_rank"),
+                       ("hvdt_step_time_skew", "step_time_skew")):
+        g = reg.get(gname)
+        if g is not None:
+            v = g.value()
+            out[key] = round(v, 4) if v == v else None   # NaN-safe
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    exporter: "MetricsExporter"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("telemetry http: " + fmt, *args)
+
+    def _reply(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        exp = self.exporter
+        route = self.path.split("?")[0]
+        if route == "/metrics":
+            self._reply(200, exp.registry.render().encode(),
+                        "text/plain; version=0.0.4")
+        elif route == "/healthz":
+            steps = exp.registry.get("hvdt_steps_total")
+            payload = {
+                "status": "ok",
+                "rank": exp.rank,
+                "steps": (int(steps.total()) if steps is not None else 0),
+            }
+            self._reply(200, json.dumps(payload).encode(),
+                        "application/json")
+        else:
+            self._reply(404, json.dumps(
+                {"error": f"no route {self.path!r}"}).encode(),
+                "application/json")
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 32
+
+
+class MetricsExporter:
+    """One worker's scrape endpoint (+ optional KV snapshot publisher)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 host: str = "0.0.0.0", port: Optional[int] = None,
+                 rank: int = 0, port_offset: Optional[int] = None,
+                 kv_client: Optional[Any] = None,
+                 publish_interval_s: Optional[float] = None):
+        self.registry = (registry if registry is not None
+                         else default_registry())
+        self.host = host
+        base = int(port if port is not None
+                   else config.get_int("HVDT_METRICS_PORT"))
+        self.rank = int(rank)
+        offset = int(port_offset if port_offset is not None else 0)
+        # port 0 = ephemeral on purpose (tests, many workers per host
+        # without a port plan); otherwise base + per-host offset.
+        self.port = base + offset if base > 0 else 0
+        self._kv = kv_client
+        self.publish_interval_s = float(
+            publish_interval_s if publish_interval_s is not None
+            else config.get_float("HVDT_TELEMETRY_PUBLISH_S"))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._publisher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> int:
+        """Bind and serve in a daemon thread; returns the bound port."""
+        handler = type("Handler", (_Handler,), {"exporter": self})
+        try:
+            self._httpd = _HTTPServer((self.host, self.port), handler)
+        except OSError as e:
+            # The configured slot is taken (another worker, a stale
+            # process) — an ephemeral port with a loud log beats dying.
+            log.warning("metrics port %d unavailable (%s); "
+                        "binding an ephemeral port", self.port, e)
+            self._httpd = _HTTPServer((self.host, 0), handler)
+        self.port = self._httpd.server_address[1]
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvdt-metrics-http",
+            daemon=True)
+        self._thread.start()
+        if self._kv is not None and self.publish_interval_s > 0:
+            self._publisher = threading.Thread(
+                target=self._publish_loop, name="hvdt-metrics-publish",
+                daemon=True)
+            self._publisher.start()
+        log.info("telemetry /metrics on http://%s:%d (rank %d)",
+                 self.host, self.port, self.rank)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._publisher is not None:
+            self._publisher.join(timeout=5)
+            self._publisher = None
+
+    # -- KV snapshot publishing (driver-side aggregation feed) -------------
+    def publish_snapshot(self) -> bool:
+        """Push one compact snapshot to the rendezvous KV (best-effort)."""
+        if self._kv is None:
+            return False
+        try:
+            doc = snapshot_dict(self.registry)
+            doc["ts"] = time.time()
+            self._kv.put(f"{KV_PREFIX}{self.rank}",
+                         json.dumps(doc).encode())
+            return True
+        except Exception as e:
+            log.debug("telemetry KV publish failed: %s", e)
+            return False
+
+    def _publish_loop(self) -> None:
+        while not self._stop.wait(self.publish_interval_s):
+            self.publish_snapshot()
+
+
+def collect_driver_snapshots(kv_server) -> Dict[int, Dict[str, Any]]:
+    """Read every worker's published snapshot out of the rendezvous KV
+    store (driver side).  ``kv_server`` is a RendezvousServer (has
+    ``lock``/``store``)."""
+    out: Dict[int, Dict[str, Any]] = {}
+    with kv_server.lock:
+        items = {k: v for k, v in kv_server.store.items()
+                 if k.startswith(KV_PREFIX)}
+    for key, raw in items.items():
+        try:
+            rank = int(key[len(KV_PREFIX):])
+            out[rank] = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Process-wide exporter lifecycle (hvd.init() / hvd.shutdown() hooks)
+# ---------------------------------------------------------------------------
+
+_exp_lock = threading.Lock()
+_exporter: Optional[MetricsExporter] = None
+
+
+def get_exporter() -> Optional[MetricsExporter]:
+    return _exporter
+
+
+def start_exporter(**kwargs) -> MetricsExporter:
+    """Start (or return) the process-wide exporter."""
+    global _exporter
+    with _exp_lock:
+        if _exporter is None:
+            _exporter = MetricsExporter(**kwargs)
+            _exporter.start()
+        return _exporter
+
+
+def stop_exporter() -> None:
+    global _exporter
+    with _exp_lock:
+        if _exporter is not None:
+            _exporter.stop()
+            _exporter = None
+
+
+def maybe_start_exporter(topology=None) -> Optional[MetricsExporter]:
+    """The ``hvd.init()`` hook: start the exporter iff telemetry is on.
+
+    Never raises — observability must not sink init.  Uses local_rank as
+    the port offset (ranks sharing a host need distinct ports; hosts can
+    share the base), binds the KV publisher when the launcher's
+    rendezvous env contract is present, and arms the resilience bridge
+    gauges so one scrape carries the recovery story too."""
+    from . import instrument
+
+    if not instrument.enabled():
+        return None
+    try:
+        rank = getattr(topology, "rank", 0) or 0
+        local_rank = getattr(topology, "local_rank", 0) or 0
+        kv = None
+        if config.get_str("HVDT_RENDEZVOUS_ADDR"):
+            try:
+                from ..runner.http_kv import KVClient
+
+                kv = KVClient.from_env()
+            except Exception as e:
+                log.debug("telemetry KV client unavailable: %s", e)
+        from .step_stats import bind_resilience_gauges
+
+        bind_resilience_gauges()
+        return start_exporter(rank=rank,
+                              port_offset=max(0, int(local_rank)),
+                              kv_client=kv)
+    except Exception as e:   # pragma: no cover - defensive
+        log.warning("telemetry exporter not started: %s", e)
+        return None

@@ -1,0 +1,292 @@
+"""Shared metric primitives: counters, gauges, summaries → Prometheus text.
+
+Promoted out of ``serve/metrics.py`` (which re-exports for back-compat):
+the serving plane needed RED-triple observability first, but the same
+primitives are what training, the collectives, and the elastic driver
+need — so they live here now, one layer below every subsystem, together
+with a **process-wide default registry** (:func:`default_registry`) that
+training-side instrumentation (``telemetry/instrument.py``,
+``telemetry/step_stats.py``) and the per-worker ``/metrics`` exporter
+share.  Serving keeps per-engine registries (an inference replica scrapes
+its own engine, not the trainer's).
+
+No prometheus_client dependency: the text exposition format is a stable,
+trivially-rendered contract, and the container must not grow deps.  A
+:class:`Summary` keeps a bounded reservoir of recent samples and renders
+pre-computed p50/p95/p99 quantiles (the Prometheus *summary* type), which
+scrapers and humans can read directly — bucketed histograms would push
+the percentile math onto a query engine the test rig doesn't have.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Summary", "MetricsRegistry",
+           "default_registry", "reset_default_registry"]
+
+
+def _fmt_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class _Metric:
+    """Base: name/help/type plus per-metric lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+    def _header(self) -> List[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
+    def render(self) -> List[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic counter (optionally labelled)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def total(self) -> float:
+        """Sum across every label combination — the scrape-independent
+        aggregate harnesses (bench JSON, driver roll-ups) report."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def render(self) -> List[str]:
+        lines = self._header()
+        with self._lock:
+            items = sorted(self._values.items()) or [((), 0.0)]
+            for key, v in items:
+                lines.append(
+                    f"{self.name}{_fmt_labels(dict(key))} {_fmt_value(v)}")
+        return lines
+
+
+class Gauge(_Metric):
+    """Point-in-time value; ``set_function`` makes it a live probe (queue
+    depth is read from the batcher at scrape time, not shadowed)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._value = 0.0
+        self._fn = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_function(self, fn) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            return float("nan")
+
+    def render(self) -> List[str]:
+        return self._header() + [f"{self.name} {_fmt_value(self.value())}"]
+
+
+class Summary(_Metric):
+    """Latency summary: cumulative count/sum plus streaming quantiles over
+    a bounded reservoir of the most recent ``window`` observations.
+
+    The reservoir is a plain ring buffer — recent-window quantiles are
+    what an operator wants from a scrape (a p99 diluted by yesterday's
+    warmup spike is useless), and the bound keeps a long-lived server's
+    memory flat.
+    """
+
+    kind = "summary"
+
+    QUANTILES: Sequence[float] = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help: str = "", window: int = 2048):
+        super().__init__(name, help)
+        self._window = max(1, int(window))
+        self._ring: List[float] = []
+        self._next = 0
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if len(self._ring) < self._window:
+                self._ring.append(v)
+            else:
+                self._ring[self._next] = v
+                self._next = (self._next + 1) % self._window
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def mean(self) -> Optional[float]:
+        """Mean over the retained window (None before any observation)."""
+        with self._lock:
+            if not self._ring:
+                return None
+            return float(sum(self._ring) / len(self._ring))
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the retained window (None if no
+        observations yet)."""
+        with self._lock:
+            if not self._ring:
+                return None
+            data = sorted(self._ring)
+        idx = min(len(data) - 1, max(0, int(q * len(data) + 0.5) - 1))
+        return data[idx]
+
+    def percentiles(self) -> Dict[float, Optional[float]]:
+        return {q: self.quantile(q) for q in self.QUANTILES}
+
+    def render(self) -> List[str]:
+        lines = self._header()
+        with self._lock:
+            data = sorted(self._ring)
+            count, total = self._count, self._sum
+        for q in self.QUANTILES:
+            if data:
+                idx = min(len(data) - 1, max(0, int(q * len(data) + 0.5) - 1))
+                lines.append(f'{self.name}{{quantile="{q}"}} '
+                             f"{_fmt_value(data[idx])}")
+            else:
+                lines.append(f'{self.name}{{quantile="{q}"}} NaN')
+        lines.append(f"{self.name}_sum {_fmt_value(total)}")
+        lines.append(f"{self.name}_count {count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named metric collection rendering the Prometheus text format.
+
+    ``counter``/``gauge``/``summary`` are get-or-create (idempotent), so
+    independent components can reference the same metric by name without
+    plumbing object handles."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def summary(self, name: str, help: str = "",
+                window: int = 2048) -> Summary:
+        return self._get_or_create(Summary, name, help, window=window)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default registry — what /metrics on a training worker serves.
+# ---------------------------------------------------------------------------
+
+_default_lock = threading.Lock()
+_default: Optional[MetricsRegistry] = None
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every training-side instrumentation site
+    and the worker ``/metrics`` exporter share.  Created on first use."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default
+
+
+def reset_default_registry() -> MetricsRegistry:
+    """Swap in a fresh default registry (tests — counters are cumulative
+    and process-wide, so isolation requires an explicit reset)."""
+    global _default
+    with _default_lock:
+        _default = MetricsRegistry()
+        return _default

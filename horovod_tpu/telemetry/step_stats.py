@@ -1,0 +1,254 @@
+"""Step-level training statistics: step time, throughput, MFU, goodput.
+
+The aggregate layer above per-collective instrumentation — the numbers
+the TPU-pod scaling study says are binding at scale (goodput, MFU,
+straggler ranks) rather than per-op traces.  A :class:`StepTimer` wraps
+the training loop (bench.py, ``step_pipeline.donated_step`` consumers,
+user loops) and publishes:
+
+* ``hvdt_step_time_seconds``  — host-fenced step duration summary
+* ``hvdt_examples_per_sec``   — windowed throughput gauge
+* ``hvdt_mfu``                — model-flops utilization gauge, from the
+  caller's flops-per-step (bench.py reuses its XLA cost-analysis flops)
+  against the device generation's peak (:func:`peak_flops_for`)
+* ``hvdt_steps_total``        — monotonic step counter
+
+A :class:`GoodputLedger` charges wall-clock lost to recompiles, restores
+and recovered faults against total elapsed time and publishes
+``hvdt_goodput_fraction`` — the "fraction of wall time spent making
+forward progress" scalar an operator pages on.
+:func:`bind_resilience_gauges` bridges the PR-4 resilience counters
+(fault injector fire counts, emergency preemption checkpoints) into the
+registry as live probes, so one scrape tells the whole recovery story.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["StepTimer", "GoodputLedger", "peak_flops_for",
+           "bind_resilience_gauges", "PEAK_BY_DEVICE_KIND"]
+
+# bf16 peak FLOP/s and HBM byte/s by TPU generation (device_kind
+# substring, lowercase) — promoted from bench.py so MFU math has one
+# home (bench imports this table).
+PEAK_BY_DEVICE_KIND = (
+    ("v6", 918e12, 1640e9), ("trillium", 918e12, 1640e9),
+    ("v5p", 459e12, 2765e9),
+    ("v5 lite", 197e12, 819e9), ("v5e", 197e12, 819e9),
+    ("v5litepod", 197e12, 819e9),
+    ("v4", 275e12, 1228e9), ("v3", 123e12, 900e9), ("v2", 46e12, 700e9),
+)
+
+
+def peak_flops_for(device_kind: str):
+    """(peak_flops, peak_hbm_bw) for a device kind, or (None, None) when
+    unknown (CPU, simulators) — MFU is then unpublishable, not faked."""
+    dk = (device_kind or "").lower()
+    for sub, flops, bw in PEAK_BY_DEVICE_KIND:
+        if sub in dk:
+            return flops, bw
+    return None, None
+
+
+class StepTimer:
+    """Times training steps and publishes throughput/MFU metrics.
+
+    Usage (bench.py / custom loops)::
+
+        timer = StepTimer(examples_per_step=batch,
+                          flops_per_step=cost["flops"],
+                          device_kind=dev.device_kind)
+        for batch in loader:
+            with timer.step():
+                run_one_step(batch)   # must end with a host fence
+
+    or call :meth:`observe` with externally measured durations (bench
+    times whole iters and divides).  ``straggler`` optionally chains a
+    :class:`~horovod_tpu.telemetry.straggler.StragglerMonitor` so the
+    cross-rank skew check rides the same observation stream.
+    """
+
+    def __init__(self, examples_per_step: int = 0,
+                 flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None,
+                 device_kind: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 straggler=None,
+                 ewma_alpha: float = 0.2):
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self.examples_per_step = int(examples_per_step)
+        self.flops_per_step = flops_per_step
+        if peak_flops is None and device_kind:
+            peak_flops, _ = peak_flops_for(device_kind)
+        self.peak_flops = peak_flops
+        self.straggler = straggler
+        self._alpha = float(ewma_alpha)
+        self._ewma: Optional[float] = None
+        self._lock = threading.Lock()
+        self._summary = reg.summary(
+            "hvdt_step_time_seconds",
+            "Host-observed training step duration")
+        self._steps = reg.counter(
+            "hvdt_steps_total", "Training steps observed by the StepTimer")
+        self._examples = reg.gauge(
+            "hvdt_examples_per_sec",
+            "Windowed training throughput (examples/s, EWMA of step time)")
+        self._mfu = reg.gauge(
+            "hvdt_mfu",
+            "Model-flops utilization: flops_per_step / (step_time * "
+            "peak_flops); 0 until the first observation, absent peak "
+            "stays 0")
+
+    def step(self):
+        """Context manager timing one step."""
+        return _StepScope(self)
+
+    def observe(self, seconds: float) -> None:
+        """Record one step's duration (externally timed)."""
+        s = float(seconds)
+        self._summary.observe(s)
+        self._steps.inc()
+        with self._lock:
+            self._ewma = s if self._ewma is None else (
+                self._alpha * s + (1.0 - self._alpha) * self._ewma)
+            ewma = self._ewma
+        if ewma > 0:
+            if self.examples_per_step:
+                self._examples.set(self.examples_per_step / ewma)
+            if self.flops_per_step and self.peak_flops:
+                self._mfu.set(
+                    float(self.flops_per_step) / (ewma * self.peak_flops))
+        if self.straggler is not None:
+            self.straggler.observe(s)
+
+    @property
+    def count(self) -> int:
+        return self._summary.count
+
+    def mean_step_seconds(self) -> Optional[float]:
+        return self._summary.mean()
+
+    def mfu(self) -> Optional[float]:
+        v = self._mfu.value()
+        return v if v > 0 else None
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        """The compact dict harnesses (bench JSON) embed."""
+        pct = self._summary.percentiles()
+        return {
+            "steps": self._summary.count,
+            "step_time_p50_ms": (round(pct[0.5] * 1e3, 3)
+                                 if pct[0.5] is not None else None),
+            "step_time_p99_ms": (round(pct[0.99] * 1e3, 3)
+                                 if pct[0.99] is not None else None),
+            "examples_per_sec": (round(self._examples.value(), 2)
+                                 if self._summary.count else None),
+            "mfu": (round(self._mfu.value(), 4)
+                    if self._mfu.value() > 0 else None),
+        }
+
+
+class _StepScope:
+    __slots__ = ("_timer", "_t0")
+
+    def __init__(self, timer: StepTimer):
+        self._timer = timer
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._timer.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class GoodputLedger:
+    """Wall-clock accounting: where did the non-training time go?
+
+    ``charge(reason, seconds)`` books lost time under a reason label
+    (``recompile``, ``restore``, ``fault_recovery``, ...); the published
+    ``hvdt_goodput_fraction`` gauge is ``(elapsed - lost) / elapsed``
+    live-probed at scrape time, and
+    ``hvdt_goodput_lost_seconds_total{reason=...}`` itemizes the bill.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 clock=time.monotonic, already_elapsed: float = 0.0):
+        """``already_elapsed`` backdates the ledger start — a harness
+        that constructs the ledger after a compile it intends to charge
+        must include that time in the elapsed denominator too, or the
+        fraction double-penalizes."""
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self._clock = clock
+        self._start = clock() - max(0.0, float(already_elapsed))
+        self._lock = threading.Lock()
+        self._lost: Dict[str, float] = {}
+        self._lost_counter = reg.counter(
+            "hvdt_goodput_lost_seconds_total",
+            "Wall-clock seconds lost to non-training work, by reason")
+        reg.gauge(
+            "hvdt_goodput_fraction",
+            "(elapsed - lost) / elapsed since ledger start"
+        ).set_function(self.fraction)
+
+    def charge(self, reason: str, seconds: float) -> None:
+        s = max(0.0, float(seconds))
+        with self._lock:
+            self._lost[reason] = self._lost.get(reason, 0.0) + s
+        self._lost_counter.inc(s, reason=str(reason))
+
+    def lost_seconds(self, reason: Optional[str] = None) -> float:
+        with self._lock:
+            if reason is not None:
+                return self._lost.get(reason, 0.0)
+            return sum(self._lost.values())
+
+    def elapsed_seconds(self) -> float:
+        return max(0.0, self._clock() - self._start)
+
+    def fraction(self) -> float:
+        elapsed = self.elapsed_seconds()
+        if elapsed <= 0:
+            return 1.0
+        return max(0.0, (elapsed - self.lost_seconds()) / elapsed)
+
+
+def bind_resilience_gauges(registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """Publish the resilience subsystem's ad-hoc counters as live gauges.
+
+    Live probes (``set_function``) rather than shadow copies: the fault
+    injector and preemption guard keep their own state; a scrape reads
+    it at scrape time.  Safe to call repeatedly (gauges are
+    get-or-create and rebinding the probe is idempotent)."""
+    reg = registry if registry is not None else default_registry()
+
+    def _injected() -> float:
+        from ..resilience import faults
+
+        inj = faults.get_injector()
+        return float(inj.fired_total()) if inj is not None else 0.0
+
+    def _emergency() -> float:
+        from ..resilience.preempt import PreemptionGuard
+
+        return float(PreemptionGuard.emergency_checkpoints)
+
+    reg.gauge(
+        "hvdt_injected_faults",
+        "Faults the HVDT_FAULT_PLAN injector has fired in this process"
+    ).set_function(_injected)
+    reg.gauge(
+        "hvdt_emergency_checkpoints",
+        "Preemption-guard emergency checkpoints taken in this process"
+    ).set_function(_emergency)

@@ -1,0 +1,158 @@
+"""Cross-rank straggler detection from step-duration skew.
+
+The TPU-pod scaling study's observation: at scale the binding question
+is often *which rank* is slow — one throttled host drags every
+synchronous collective.  The stall inspector (``stall.py``) only sees a
+rank that stopped *submitting*; a straggler submits fine, just late, and
+is invisible to it.  This monitor closes that gap with data: every
+``HVDT_STRAGGLER_WINDOW`` locally-observed steps it allgathers each
+rank's mean step duration over the eager negotiated path (itself
+instrumented, so the probe's wire cost is visible in the same registry),
+compares ranks against the median, and
+
+* logs the outlier ranks with their slowdown ratios,
+* publishes ``hvdt_straggler_rank`` (worst offender, -1 = none) and
+  ``hvdt_step_time_skew`` (max/median ratio) gauges,
+* invokes ``on_straggler(rank, ratio)`` — the hook that feeds the stall
+  escalation ladder (or a scheduler's drain list) a real signal instead
+  of a timeout guess.
+
+Single-process runs (size 1, or hvd not initialized) skip the gather and
+publish skew 1.0 — the monitor is safe to leave on everywhere.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..common import config
+from ..common.logging_util import get_logger
+from .metrics import MetricsRegistry, default_registry
+
+__all__ = ["StragglerMonitor"]
+
+log = get_logger(__name__)
+
+
+class StragglerMonitor:
+    def __init__(self, window: Optional[int] = None,
+                 threshold: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 allgather_fn: Optional[Callable[[float], Optional[List[float]]]] = None,
+                 rank: Optional[int] = None,
+                 on_straggler: Optional[Callable[[int, float], None]] = None):
+        """``allgather_fn(local_mean) -> per-rank means or None`` is
+        injectable for tests and custom transports; the default rides
+        the eager negotiated allgather when hvd is initialized."""
+        self.window = int(window if window is not None
+                          else config.get_int("HVDT_STRAGGLER_WINDOW"))
+        self.threshold = float(
+            threshold if threshold is not None
+            else config.get_float("HVDT_STRAGGLER_THRESHOLD"))
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self._allgather = allgather_fn or self._eager_allgather
+        self._rank_override = rank
+        self.on_straggler = on_straggler
+        self._lock = threading.Lock()
+        self._durations: List[float] = []
+        self._round = 0
+        self.straggler_rank_gauge = reg.gauge(
+            "hvdt_straggler_rank",
+            "Rank whose mean step time most exceeds threshold x median "
+            "over the last window (-1 = no straggler)")
+        self.skew_gauge = reg.gauge(
+            "hvdt_step_time_skew",
+            "max(rank mean step time) / median over the last window")
+        self.checks_counter = reg.counter(
+            "hvdt_straggler_checks_total",
+            "Cross-rank straggler checks performed")
+        self.flagged_counter = reg.counter(
+            "hvdt_straggler_flags_total",
+            "Straggler detections, labelled by offending rank")
+        self.straggler_rank_gauge.set(-1)
+        self.skew_gauge.set(1.0)
+
+    # -- observation stream -------------------------------------------------
+    def observe(self, step_seconds: float) -> None:
+        """Feed one local step duration; triggers a cross-rank check every
+        ``window`` observations (window <= 0 disables)."""
+        if self.window <= 0:
+            return
+        with self._lock:
+            self._durations.append(float(step_seconds))
+            if len(self._durations) < self.window:
+                return
+            durations, self._durations = self._durations, []
+        self.check(sum(durations) / len(durations))
+
+    # -- the cross-rank check ----------------------------------------------
+    def check(self, local_mean: float) -> Optional[int]:
+        """Allgather per-rank means and flag outliers.  Returns the worst
+        straggler rank, or None."""
+        with self._lock:
+            self._round += 1
+        try:
+            means = self._allgather(float(local_mean))
+        except Exception as e:  # a flaky probe must not sink training
+            log.debug("straggler allgather failed: %s", e)
+            return None
+        self.checks_counter.inc()
+        if not means or len(means) < 2:
+            self.skew_gauge.set(1.0)
+            self.straggler_rank_gauge.set(-1)
+            return None
+        ordered = sorted(means)
+        # Lower median: with few ranks (or half the fleet slow) the upper
+        # median can BE the straggler, hiding it behind skew 1.0 — biasing
+        # the baseline toward the fast half is the conservative choice
+        # for a detector.
+        median = ordered[(len(ordered) - 1) // 2]
+        worst_rank = max(range(len(means)), key=lambda r: means[r])
+        worst = means[worst_rank]
+        skew = (worst / median) if median > 0 else 1.0
+        self.skew_gauge.set(skew)
+        if skew <= self.threshold:
+            self.straggler_rank_gauge.set(-1)
+            return None
+        outliers = [(r, m / median) for r, m in enumerate(means)
+                    if median > 0 and m / median > self.threshold]
+        log.warning(
+            "straggler detected: rank %d mean step %.4fs is %.2fx the "
+            "median %.4fs (all outliers: %s)",
+            worst_rank, worst, skew,
+            median, [(r, round(x, 2)) for r, x in outliers])
+        self.straggler_rank_gauge.set(worst_rank)
+        for r, _ in outliers:
+            self.flagged_counter.inc(rank=str(r))
+        if self.on_straggler is not None:
+            try:
+                self.on_straggler(worst_rank, skew)
+            except Exception as e:
+                log.debug("on_straggler hook failed: %s", e)
+        return worst_rank
+
+    # -- default transport --------------------------------------------------
+    def _eager_allgather(self, local_mean: float) -> Optional[List[float]]:
+        """Gather per-rank means over the eager negotiated path.  The
+        tensor name carries the round counter — every rank reaches round
+        N after the same N windows, so names line up without extra
+        coordination."""
+        from ..common import basics
+
+        state = basics._global_state()
+        if not state.initialized or state.topology is None:
+            return None
+        # Size 1 still rides the controller (single-rank collectives are
+        # the identity): the probe's own wire accounting stays visible
+        # in the registry, and single-process harnesses (bench.py)
+        # exercise the full instrumented path.
+        import numpy as np
+
+        from ..ops import eager
+
+        arr = np.asarray([local_mean], np.float64)
+        out = eager.allgather(
+            arr, name=f"hvdt.telemetry.straggler.{self._round}")
+        return [float(v) for v in np.asarray(out).reshape(-1)]

@@ -110,6 +110,12 @@ class Timeline:
         self._file.write(json.dumps(record))
 
     def _writer_loop(self) -> None:
+        # Open B..E spans per tensor row, so completed spans can
+        # double-record into the telemetry latency summaries
+        # (hvdt_phase_<PHASE>_seconds) — aggregate percentiles exist
+        # without opening the trace in a viewer.  All on the writer
+        # thread: the hot path still only enqueues.
+        open_spans: Dict[int, List] = {}
         while True:
             ev = self._queue.get()
             if ev is None:
@@ -124,6 +130,18 @@ class Timeline:
             if ev.args:
                 rec["args"] = ev.args
             self._emit(rec)
+            if ev.phase == "B":
+                open_spans.setdefault(pid, []).append((ev.marker, ev.ts))
+            elif ev.phase == "E":
+                stack = open_spans.get(pid)
+                if stack:
+                    marker, t0 = stack.pop()
+                    from .telemetry.instrument import get_recorder
+
+                    recorder = get_recorder()
+                    if recorder is not None:
+                        recorder.observe_phase(marker,
+                                               (ev.ts - t0) / 1e6)
 
     def close(self) -> None:
         if self._closed:

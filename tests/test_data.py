@@ -63,6 +63,62 @@ class TestAsyncLoader:
         assert list(loader) == [1, 2, 3]
         assert list(loader) == [1, 2, 3]
 
+    def test_close_mid_iteration_with_full_queue(self):
+        """Regression: close() must return promptly when the producer is
+        parked on a FULL queue mid-iteration (the producer's bounded puts
+        observe the stop flag; close drains and joins with a timeout)."""
+        produced = []
+
+        class Tracking(AsyncDataLoaderMixin, BaseDataLoader):
+            def _iterate(self):
+                for i in range(10_000):
+                    produced.append(i)
+                    yield i
+
+            def __len__(self):
+                return 10_000
+
+        loader = Tracking(async_loader_queue_size=1)
+        it = iter(loader)
+        assert next(it) == 0
+        # Let the producer refill the queue and block on the next put.
+        deadline = time.monotonic() + 5.0
+        while len(produced) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        t0 = time.monotonic()
+        loader.close()
+        assert time.monotonic() - t0 < 5.0, "close() hung on full queue"
+        assert loader._thread is None
+        # The producer really exited (it stopped far short of the
+        # 10k-batch iterator).
+        time.sleep(0.2)
+        assert len(produced) < 100
+
+    def test_close_bounded_when_producer_wedged_upstream(self):
+        """A producer blocked inside the UPSTREAM iterator (not our
+        queue) cannot be unblocked by draining; close() must still
+        return within its bounded timeout and abandon the daemon."""
+        release = threading.Event()
+
+        class Wedged(AsyncDataLoaderMixin, BaseDataLoader):
+            def _iterate(self):
+                yield 1
+                release.wait(30)   # simulates a stuck data source
+                yield 2
+
+            def __len__(self):
+                return 2
+
+        loader = Wedged(async_loader_queue_size=1,
+                        close_timeout_s=0.3)
+        it = iter(loader)
+        assert next(it) == 1
+        t0 = time.monotonic()
+        loader.close()
+        assert time.monotonic() - t0 < 2.0
+        assert loader._thread is None
+        release.set()   # let the daemon thread finish
+
 
 class TestPrefetchToDevice:
     def test_yields_all_on_device(self, hvd):

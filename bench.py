@@ -429,6 +429,8 @@ def _run_child(args) -> None:
     telemetry_doc = None
     if telemetry_timer is not None:
         from horovod_tpu.telemetry import exporter as _texp
+        from horovod_tpu.telemetry import flight_recorder as _tfr
+        from horovod_tpu.telemetry import trace as _ttrace
 
         telemetry_doc = _texp.snapshot_dict()
         telemetry_doc["goodput_fraction"] = round(
@@ -436,6 +438,15 @@ def _run_child(args) -> None:
         exp = _texp.get_exporter()
         if exp is not None:
             telemetry_doc["metrics_port"] = exp.port
+        # Forensics layer (rides inside the telemetry doc, so it stays
+        # out of the last-good headline cache with the rest of it):
+        # where the span dump landed and how much the flight recorder
+        # holds — the two handles an operator needs after a bad run.
+        if _ttrace.get_tracer() is not None:
+            telemetry_doc["trace_file"] = _ttrace.flush(publish=False)
+        fr = _tfr.get_flight_recorder()
+        if fr is not None:
+            telemetry_doc["flight_recorder_events"] = len(fr.events())
     print(json.dumps({
         "metric": METRIC,
         "value": round(value, 2),

@@ -5,8 +5,9 @@
 #
 #   ci/gen-matrix.sh --smoke   emit only the fast smoke service
 #       (compileall + optimizer-kernel + serving-subsystem +
-#       quantized-collective + resilience-chaos + telemetry tests on
-#       CPU) — the pre-merge gate.
+#       quantized-collective + resilience-chaos + telemetry +
+#       tracing/flight-recorder-forensics tests on CPU) — the
+#       pre-merge gate.
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

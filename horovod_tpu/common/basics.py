@@ -267,11 +267,19 @@ def init(
 
 def shutdown() -> None:
     """Tear down (ref: operations.cc horovod_shutdown)."""
+    from ..telemetry import trace as _trace
     from ..telemetry.exporter import stop_exporter
     from ..timeline import stop_timeline
 
     from ..ops import tcp_backend
 
+    try:
+        # Final span flush: per-rank Chrome-trace file into
+        # HVDT_TRACE_DIR + KV publish for the driver-side merge (no-op
+        # when tracing is off; never sinks shutdown).
+        _trace.flush()
+    except Exception:   # pragma: no cover - defensive
+        pass
     stop_exporter()
     with _state.lock:
         if not _state.initialized:

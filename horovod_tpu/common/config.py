@@ -99,6 +99,28 @@ KNOBS: Dict[str, Knob] = {
            "Seconds between worker snapshot publishes to the rendezvous "
            "KV (/telemetry/<rank>) for driver-side aggregation; only "
            "active under the elastic launcher.  0 disables publishing."),
+        # --- distributed tracing + flight recorder (telemetry/trace.py,
+        #     telemetry/flight_recorder.py — cross-rank forensics) ---
+        _k("HVDT_TRACE_DIR", "", str,
+           "Enable distributed span tracing and write per-rank Chrome-"
+           "trace dumps (trace_rank<N>.json) plus desync reports into "
+           "this directory; under the elastic launcher the driver also "
+           "merges per-rank dumps from the rendezvous KV into "
+           "trace_merged.json (rank as pid).  Empty (default) = off, "
+           "zero overhead (telemetry.trace.get_tracer() is None)."),
+        _k("HVDT_TRACE_BUFFER", 65536, int,
+           "Max spans retained per rank by the trace buffer (ring; "
+           "forensics wants the recent window, memory stays flat)."),
+        _k("HVDT_FLIGHT_RECORDER", False, _parse_bool,
+           "Enable the collective flight recorder: an always-cheap ring "
+           "buffer of the last N collective events per rank (seq, "
+           "op/name/dtype/bytes/wire, in-flight vs done), dumped on "
+           "stall-abort (with a cross-rank desync report), on "
+           "preemption, and on demand via the exporter's /flightrecorder"
+           " endpoint.  Off (default) = zero overhead "
+           "(telemetry.flight_recorder.get_flight_recorder() is None)."),
+        _k("HVDT_FLIGHT_RECORDER_EVENTS", 256, int,
+           "Ring capacity (events) of the collective flight recorder."),
         # --- timeline (ref: HOROVOD_TIMELINE common.h:110) ---
         _k("HVDT_TIMELINE", "", str,
            "Write per-tensor Chrome-tracing timeline JSON to this path."),

@@ -464,8 +464,10 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
     # branch records its own wire accounting inside
     # quantized_allreduce_flat).
     from ..telemetry import instrument as _ti
+    from ..telemetry import flight_recorder as _frm
 
     _rec = _ti.get_recorder()
+    _flight = _frm.get_flight_recorder()
 
     out_leaves: List[Optional[jax.Array]] = [None] * len(leaves)
     for bi, bucket in enumerate(buckets):
@@ -477,14 +479,27 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         orig_dtype = flat.dtype
         if wire_dtype is not None and flat.dtype != wire_dtype:
             flat = flat.astype(wire_dtype)
-        if _rec is not None:
+        if _rec is not None or _flight is not None:
             bucket_bytes = int(flat.size) * jnp.dtype(flat.dtype).itemsize
-            _rec.observe_fusion_fill(bucket_bytes / float(threshold_bytes))
-            if not (quant_wire and jnp.issubdtype(orig_dtype, jnp.floating)):
-                _rec.record_collective(
-                    "allreduce", jnp.dtype(orig_dtype).name,
-                    jnp.dtype(flat.dtype).name, bucket_bytes,
-                    count=len(parts), path="jit")
+            quant_bucket = (quant_wire
+                            and jnp.issubdtype(orig_dtype, jnp.floating))
+            if _rec is not None:
+                _rec.observe_fusion_fill(
+                    bucket_bytes / float(threshold_bytes))
+                if not quant_bucket:
+                    _rec.record_collective(
+                        "allreduce", jnp.dtype(orig_dtype).name,
+                        jnp.dtype(flat.dtype).name, bucket_bytes,
+                        count=len(parts), path="jit")
+            if _flight is not None and not quant_bucket:
+                # One traced event per compiled bucket program (under jit
+                # the program, not this host code, runs the collective).
+                _flight.record(
+                    op="allreduce", name=f"fused.b{bi}",
+                    dtype=jnp.dtype(orig_dtype).name,
+                    shape=(int(flat.size),), nbytes=bucket_bytes,
+                    wire=jnp.dtype(flat.dtype).name, path="jit",
+                    count=len(parts))
         # Named scope per fused bucket — the jit-trace analog of the
         # reference's NVTX op ranges; buckets appear as
         # hvdt.fused_allreduce.bN in XPlane/profiler output.

@@ -61,10 +61,11 @@ class _Entry:
     """Local in-flight tensor (ref: TensorTableEntry common.h:348-382)."""
 
     __slots__ = ("request", "tensor", "handle", "enqueue_ts", "was_jax",
-                 "announce_ts")
+                 "announce_ts", "fr_seq")
 
     def __init__(self, request: Request, tensor: Optional[np.ndarray],
-                 handle: int, was_jax: bool):
+                 handle: int, was_jax: bool,
+                 fr_seq: Optional[int] = None):
         self.request = request
         self.tensor = tensor
         self.handle = handle
@@ -74,6 +75,10 @@ class _Entry:
         # telemetry splits enqueue->announce (queue) from
         # announce->response (negotiate).  None when telemetry is off.
         self.announce_ts: Optional[float] = None
+        # Flight-recorder sequence opened at enqueue (None when the
+        # recorder is off) — closed when the handle completes, so a hung
+        # peer's collectives stay visibly "inflight" in the ring.
+        self.fr_seq = fr_seq
 
 
 class ResponseCache:
@@ -189,14 +194,32 @@ class EagerController:
     def enqueue(self, request: Request, tensor: Optional[np.ndarray],
                 was_jax: bool) -> int:
         key = (request.process_set_id, request.tensor_name)
+        from ..telemetry import flight_recorder as _frm
+
+        flight = _frm.get_flight_recorder()
+        fr_seq = None
+        if flight is not None:
+            dtype = numpy_dtype_of_safe(request.tensor_type)
+            shape = tuple(request.tensor_shape or ())
+            nbytes = int(np.prod(shape)) * dtype.itemsize if shape \
+                else dtype.itemsize
+            fr_seq = flight.record_begin(
+                op=RequestType(request.request_type).name.lower(),
+                name=request.tensor_name, dtype=dtype.name, shape=shape,
+                nbytes=nbytes, path="eager")
         with self._lock:
             if not self._running:
+                if flight is not None:
+                    flight.record_end(fr_seq, status="error")
                 raise HorovodInternalError("controller is shut down")
             if key in self._entries:
+                if flight is not None:
+                    flight.record_end(fr_seq, status="error")
                 raise ValueError(DUPLICATE_NAME_ERROR +
                                  f" (tensor: {request.tensor_name})")
             handle = self.handles.allocate()
-            self._entries[key] = _Entry(request, tensor, handle, was_jax)
+            self._entries[key] = _Entry(request, tensor, handle, was_jax,
+                                        fr_seq=fr_seq)
             self._to_announce.append(request)
         if self._timeline:
             self._timeline.start_activity(
@@ -559,6 +582,7 @@ class EagerController:
         if rt == RequestType.BARRIER:
             for name, entry in zip(resp.tensor_names, self._pop_entries(resp)):
                 if entry is not None:
+                    self._fr_close([entry])
                     self.handles.mark_done(entry.handle, Status.ok(), None)
             return
         if resp.error_message:
@@ -573,9 +597,12 @@ class EagerController:
                 self._timeline.start_activity(name, f"EXEC_{rt.name}",
                                               {"fused": len(resp.tensor_names)})
         from ..telemetry import instrument as _ti
+        from ..telemetry import trace as _trace
 
         rec = _ti.get_recorder()
-        t_exec0 = time.monotonic() if rec is not None else 0.0
+        tracer = _trace.get_tracer()
+        t_exec0 = time.monotonic() if (rec is not None or
+                                       tracer is not None) else 0.0
         if rec is not None:
             dtype = numpy_dtype_of_safe(resp.tensor_type)
             nbytes = sum(
@@ -608,15 +635,24 @@ class EagerController:
             # Skip handles _dispatch already completed (a fused response
             # can fail partway through its finish loop); mark_done has no
             # already-done guard and would overwrite a good result.
+            self._fr_close(entries, status="error")
             for entry in entries:
                 if entry is not None and not self.handles.poll(entry.handle):
                     self.handles.mark_done(
                         entry.handle,
                         Status.unknown(f"{type(e).__name__}: {e}"))
             raise
+        else:
+            self._fr_close(entries)
         finally:
             if rec is not None:
                 rec.observe_execute(time.monotonic() - t_exec0)
+            if tracer is not None:
+                tracer.complete(
+                    f"EXEC_{rt.name}:{resp.tensor_names[0]}",
+                    time.monotonic() - t_exec0, cat="collective",
+                    args={"fused": len(resp.tensor_names),
+                          "tensors": list(resp.tensor_names[:4])})
             if self._timeline:
                 for name, shape in zip(resp.tensor_names,
                                        resp.tensor_shapes or
@@ -745,9 +781,23 @@ class EagerController:
         else:
             raise HorovodInternalError(f"Unknown response type {rt}")
 
+    def _fr_close(self, entries, status: str = "done") -> None:
+        """Close the flight-recorder events opened at enqueue for these
+        entries (no-op when the recorder is off)."""
+        from ..telemetry import flight_recorder as _frm
+
+        flight = _frm.get_flight_recorder()
+        if flight is None:
+            return
+        for e in entries:
+            if e is not None and e.fr_seq is not None:
+                flight.record_end(e.fr_seq, status=status)
+                e.fr_seq = None
+
     def _fail_response(self, resp: Response, message: str) -> None:
         for entry in self._pop_entries(resp):
             if entry is not None:
+                self._fr_close([entry], status="error")
                 self.handles.mark_done(entry.handle,
                                        Status.unknown(message))
         if self._timeline:
@@ -759,6 +809,7 @@ class EagerController:
             self._running = False
             entries = list(self._entries.values())
             self._entries.clear()
+        self._fr_close(entries, status="error")
         for e in entries:
             self.handles.mark_done(e.handle, Status.unknown(message))
         self.handles.abort_all(message)

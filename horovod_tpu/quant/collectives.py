@@ -92,12 +92,19 @@ def quantized_allreduce_flat(flat, axis="dp",
     # wire hops): record the int8 wire-format payload this bucket's
     # program moves per hop (qk.wire_bytes = 1 B/elem + f32 block scales).
     from ..telemetry import instrument as _ti
+    from ..telemetry import flight_recorder as _frm
 
     _rec = _ti.get_recorder()
     if _rec is not None:
         _rec.record_collective("allreduce", jnp.dtype(dtype).name,
                                INT8_WIRE, qk.wire_bytes(size, block),
                                path="jit")
+    _flight = _frm.get_flight_recorder()
+    if _flight is not None:
+        _flight.record(op="allreduce", name="quantized.flat",
+                       dtype=jnp.dtype(dtype).name, shape=(int(size),),
+                       nbytes=int(qk.wire_bytes(size, block)),
+                       wire=INT8_WIRE, path="jit")
 
     x = flat.astype(jnp.float32)
     if prescale_factor != 1.0:
@@ -226,8 +233,24 @@ def eager_quantized_allreduce(tensor, name: Optional[str] = None,
         # double count of the same series).
         _rec.record_collective("allreduce", str(dtype), INT8_WIRE,
                                packed.size, path="eager")
-    gathered = eager.allgather(packed, name=name and f"{name}.q8",
-                               process_set=process_set)
+    from ..telemetry import flight_recorder as _frm
+
+    _flight = _frm.get_flight_recorder()
+    _fr_seq = None
+    if _flight is not None:
+        _fr_seq = _flight.record_begin(
+            op="allreduce", name=name or "quantized.eager",
+            dtype=str(dtype), shape=shape, nbytes=int(packed.size),
+            wire=INT8_WIRE, path="eager")
+    try:
+        gathered = eager.allgather(packed, name=name and f"{name}.q8",
+                                   process_set=process_set)
+    except Exception:
+        if _flight is not None:
+            _flight.record_end(_fr_seq, status="error")
+        raise
+    if _flight is not None:
+        _flight.record_end(_fr_seq)
     per_rank = np.asarray(gathered).reshape(-1, packed.size)
     n = per_rank.shape[0]
     nblocks = x2.shape[0]

@@ -115,8 +115,10 @@ class Escalator:
                         name, _LEVEL_NAMES[lv], age_s)
             if lv == WARN and self._on_warn is not None:
                 self._on_warn(name, age_s)
-            elif lv == ABORT and self._on_abort is not None:
-                self._on_abort(name)
+            elif lv == ABORT:
+                if self._on_abort is not None:
+                    self._on_abort(name)
+                _abort_forensics(name, age_s)
             elif lv == RESET and self._on_reset is not None:
                 self._on_reset()
         return target
@@ -139,6 +141,20 @@ class Escalator:
         with self._lock:
             out, self._reset_pending = self._reset_pending, False
             return out
+
+
+def _abort_forensics(name: str, age_s: float) -> None:
+    """Abort-rung forensics: when the flight recorder is on, gather every
+    rank's recent collective sequence over the rendezvous KV and emit the
+    structured desync report (telemetry/flight_recorder.py).  A no-op
+    when the recorder is off; never raises — forensics must not worsen
+    the failure being diagnosed."""
+    try:
+        from ..telemetry.flight_recorder import emit_desync_report
+
+        emit_desync_report(stalled=name, age_s=age_s)
+    except Exception as e:   # pragma: no cover - defensive
+        log.debug("stall-abort forensics failed: %r", e)
 
 
 def request_elastic_reset(reason: str = "stall escalation") -> bool:

@@ -132,6 +132,15 @@ class PreemptionGuard:
         log.warning("preemption signal %s received — emergency checkpoint"
                     "%s", sig_name, f" at step {step}" if step is not None
                     else "")
+        try:
+            # Flight-recorder dump inside the grace window: the last N
+            # collective events are on disk before the host disappears
+            # (no-op when HVDT_FLIGHT_RECORDER is off; never raises).
+            from ..telemetry.flight_recorder import dump_on_preempt
+
+            dump_on_preempt()
+        except Exception:   # pragma: no cover - defensive
+            pass
         if self._on_preempt is not None:
             try:
                 self._on_preempt()

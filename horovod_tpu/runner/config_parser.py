@@ -38,6 +38,8 @@ YAML shape (mirrors the reference's config sections)::
       enabled: true
       metrics_port: 9090
       straggler_window: 64
+      trace_dir: /tmp/hvdt-trace
+      flight_recorder: true
     library_options:
       cpu_operations: tcp
       tcp_port_stride: 128
@@ -166,6 +168,19 @@ KNOB_FLAGS: List[_Flag] = [
           "HVDT_STRAGGLER_WINDOW", "telemetry", "straggler_window",
           "Steps between cross-rank straggler checks (0 = off).",
           type=int),
+    _Flag("--trace-dir", "trace_dir", "HVDT_TRACE_DIR",
+          "telemetry", "trace_dir",
+          "Enable distributed span tracing on every worker and collect "
+          "per-rank Chrome-trace dumps (plus desync reports) in this "
+          "directory; the elastic driver additionally merges per-rank "
+          "dumps into trace_merged.json with rank as pid."),
+    _Flag("--flight-recorder", "flight_recorder", "HVDT_FLIGHT_RECORDER",
+          "telemetry", "flight_recorder",
+          "Enable the per-rank collective flight recorder (ring buffer "
+          "of recent collective events; dumped on stall-abort with a "
+          "cross-rank desync report, on preemption, and via the "
+          "exporter's /flightrecorder endpoint).", is_bool=True,
+          to_env=_bool_env),
     # --- library options ---
     _Flag("--cpu-operations", "cpu_operations", "HVDT_CPU_OPERATIONS",
           "library_options", "cpu_operations",

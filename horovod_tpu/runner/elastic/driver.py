@@ -173,6 +173,28 @@ class ElasticDriver:
 
         return collect_driver_snapshots(self._kv)
 
+    def trace_dumps(self):
+        """Per-rank Chrome-trace dumps published to the rendezvous KV
+        (workers publish /trace/<rank> when HVDT_TRACE_DIR is set —
+        merged into one rank-as-pid trace by telemetry.trace.merge_dumps
+        / write_merged; run_elastic writes trace_merged.json under
+        --trace-dir).  Returns {rank: dump}; empty without a KV."""
+        if self._kv is None:
+            return {}
+        from ...telemetry.trace import collect_server_dumps
+
+        return collect_server_dumps(self._kv)
+
+    def flight_recorder_events(self):
+        """Per-rank collective flight-recorder event lists from the
+        rendezvous KV (/flightrecorder/<rank>) — the raw material of
+        telemetry.flight_recorder.analyze_desync."""
+        if self._kv is None:
+            return {}
+        from ...telemetry.flight_recorder import collect_server_events
+
+        return collect_server_events(self._kv)
+
     def _notify_hosts_updated(self) -> None:
         with self._cond:
             self._cond.notify_all()
@@ -372,4 +394,19 @@ def run_elastic(args) -> int:
         return code if code is not None else 1
     finally:
         driver.stop()
+        trace_dir = knob_env.get("HVDT_TRACE_DIR") or \
+            os.environ.get("HVDT_TRACE_DIR", "")
+        if trace_dir:
+            # Driver-side merge (hvdtrun --trace-dir): pull every rank's
+            # published dump from the KV before the server dies and emit
+            # the single rank-as-pid Chrome trace.
+            try:
+                from ...telemetry.trace import write_merged
+
+                merged = write_merged(server, trace_dir)
+                if merged:
+                    print(f"elastic: merged trace written to {merged}",
+                          file=sys.stderr)
+            except Exception as e:
+                print(f"elastic: trace merge failed: {e}", file=sys.stderr)
         server.stop()

@@ -95,9 +95,12 @@ def donated_step(fn, *, donate_argnums=(0, 1), compile_cache=None,
     Returns the jitted callable unchanged otherwise — ``.lower()``,
     static args, shard_map bodies all work as with plain ``jax.jit``.
     With telemetry on (``HVDT_TELEMETRY=1``) the callable is wrapped so
-    each call's dispatch duration feeds ``hvdt_step_dispatch_seconds``
-    (attribute access still forwards to the jitted fn); telemetry off
-    returns the jitted fn itself — zero wrapper objects.
+    each call's dispatch duration feeds ``hvdt_step_dispatch_seconds``;
+    with distributed tracing on (``HVDT_TRACE_DIR``) the same wrapper
+    records a ``train.step`` span and advances the deterministic
+    per-step trace id (telemetry/trace.py).  Attribute access still
+    forwards to the jitted fn; with both off the jitted fn itself is
+    returned — zero wrapper objects.
     """
     import jax
 

@@ -17,8 +17,17 @@ and ad-hoc module-level ints).  Layering, bottom up:
 * :mod:`~horovod_tpu.telemetry.straggler` — cross-rank step-duration
   skew detection publishing a ``straggler_rank`` gauge;
 * :mod:`~horovod_tpu.telemetry.exporter` — per-worker ``/metrics`` +
-  ``/healthz`` HTTP endpoint (started by ``hvd.init()`` when enabled)
-  and driver-side snapshot aggregation over the rendezvous KV.
+  ``/healthz`` + ``/flightrecorder`` HTTP endpoint (started by
+  ``hvd.init()`` when enabled) and driver-side snapshot aggregation
+  over the rendezvous KV;
+* :mod:`~horovod_tpu.telemetry.trace` — distributed span tracing:
+  bounded per-rank Chrome-trace buffers with deterministic per-step
+  trace ids, merged driver-side into one rank-as-pid trace
+  (``hvdtrun --trace-dir``);
+* :mod:`~horovod_tpu.telemetry.flight_recorder` — always-cheap ring of
+  recent collective events (seq/op/dtype/bytes/wire, in-flight vs done)
+  + the cross-rank desync analyzer that names the first divergent
+  collective on stall-abort.
 
 Knobs: ``HVDT_TELEMETRY``, ``HVDT_METRICS_PORT``,
 ``HVDT_STRAGGLER_WINDOW``, ``HVDT_STRAGGLER_THRESHOLD``,
@@ -50,12 +59,25 @@ from .step_stats import (  # noqa: F401
 from .straggler import StragglerMonitor  # noqa: F401
 from .exporter import (  # noqa: F401
     MetricsExporter,
+    bind_process_gauges,
     collect_driver_snapshots,
     get_exporter,
     maybe_start_exporter,
     snapshot_dict,
     start_exporter,
     stop_exporter,
+)
+from .trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    merge_dumps,
+    step_trace_id,
+)
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    analyze_desync,
+    emit_desync_report,
+    get_flight_recorder,
 )
 
 __all__ = [
@@ -66,4 +88,8 @@ __all__ = [
     "peak_flops_for", "StragglerMonitor",
     "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
     "maybe_start_exporter", "snapshot_dict", "collect_driver_snapshots",
+    "bind_process_gauges",
+    "Tracer", "get_tracer", "merge_dumps", "step_trace_id",
+    "FlightRecorder", "analyze_desync", "emit_desync_report",
+    "get_flight_recorder",
 ]

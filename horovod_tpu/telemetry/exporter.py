@@ -35,7 +35,7 @@ from .metrics import MetricsRegistry, default_registry
 
 __all__ = ["MetricsExporter", "start_exporter", "stop_exporter",
            "get_exporter", "maybe_start_exporter", "snapshot_dict",
-           "collect_driver_snapshots"]
+           "collect_driver_snapshots", "bind_process_gauges"]
 
 log = get_logger(__name__)
 
@@ -103,6 +103,18 @@ class _Handler(BaseHTTPRequestHandler):
             }
             self._reply(200, json.dumps(payload).encode(),
                         "application/json")
+        elif route == "/flightrecorder":
+            from . import flight_recorder as _frm
+
+            fr = _frm.get_flight_recorder()
+            if fr is None:
+                self._reply(404, json.dumps({
+                    "error": "flight recorder disabled "
+                             "(set HVDT_FLIGHT_RECORDER=1)"}).encode(),
+                    "application/json")
+            else:
+                self._reply(200, json.dumps(fr.dump()).encode(),
+                            "application/json")
         else:
             self._reply(404, json.dumps(
                 {"error": f"no route {self.path!r}"}).encode(),
@@ -182,7 +194,10 @@ class MetricsExporter:
 
     # -- KV snapshot publishing (driver-side aggregation feed) -------------
     def publish_snapshot(self) -> bool:
-        """Push one compact snapshot to the rendezvous KV (best-effort)."""
+        """Push one compact snapshot to the rendezvous KV (best-effort);
+        also refreshes this rank's trace and flight-recorder dumps so
+        the driver-side merge / desync gather sees recent data even from
+        a worker that later dies without flushing."""
         if self._kv is None:
             return False
         try:
@@ -190,14 +205,89 @@ class MetricsExporter:
             doc["ts"] = time.time()
             self._kv.put(f"{KV_PREFIX}{self.rank}",
                          json.dumps(doc).encode())
-            return True
         except Exception as e:
             log.debug("telemetry KV publish failed: %s", e)
             return False
+        try:
+            from . import flight_recorder as _frm
+            from . import trace as _trace
+
+            tracer = _trace.get_tracer()
+            if tracer is not None:
+                tracer.publish(self._kv, self.rank)
+            fr = _frm.get_flight_recorder()
+            if fr is not None:
+                fr.publish(self._kv, self.rank)
+        except Exception as e:
+            log.debug("trace/flight KV publish failed: %s", e)
+        return True
 
     def _publish_loop(self) -> None:
         while not self._stop.wait(self.publish_interval_s):
             self.publish_snapshot()
+
+
+def bind_process_gauges(registry: Optional[MetricsRegistry] = None) -> None:
+    """Publish process resource usage as live-probe gauges: RSS, open
+    file descriptors, and device HBM in use.
+
+    Live probes (``set_function``), read at scrape time.  Every probe is
+    guarded: ``/proc`` may be absent (non-Linux), and
+    ``jax.Device.memory_stats()`` returns ``None`` on CPU backends and
+    older jax (0.4.37 in the container) — an unavailable number renders
+    as ``nan``, never an exception.  Idempotent (gauges are
+    get-or-create; rebinding the probe is a no-op in effect)."""
+    import os as _os
+
+    reg = registry if registry is not None else default_registry()
+
+    def _rss() -> float:
+        try:
+            with open("/proc/self/statm") as fh:
+                pages = int(fh.read().split()[1])
+            return float(pages * _os.sysconf("SC_PAGE_SIZE"))
+        except (OSError, ValueError, IndexError):
+            try:
+                import resource
+
+                # ru_maxrss is KiB on Linux (peak, not live — the
+                # portable fallback when /proc is unavailable).
+                return float(resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss * 1024)
+            except Exception:
+                return float("nan")
+
+    def _fds() -> float:
+        try:
+            return float(len(_os.listdir("/proc/self/fd")))
+        except OSError:
+            return float("nan")
+
+    def _hbm() -> float:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if not stats:   # CPU backends / jax 0.4.37 return None
+                return float("nan")
+            return float(stats.get("bytes_in_use", float("nan")))
+        except Exception:
+            return float("nan")
+
+    reg.gauge(
+        "hvdt_process_rss_bytes",
+        "Resident set size of this worker process (live /proc probe; "
+        "peak-RSS fallback where /proc is unavailable)"
+    ).set_function(_rss)
+    reg.gauge(
+        "hvdt_process_open_fds",
+        "Open file descriptors of this worker process (nan off-Linux)"
+    ).set_function(_fds)
+    reg.gauge(
+        "hvdt_hbm_bytes_in_use",
+        "Live device memory in use (jax.Device.memory_stats; nan on CPU "
+        "backends and jax builds where memory_stats returns None)"
+    ).set_function(_hbm)
 
 
 def collect_driver_snapshots(kv_server) -> Dict[int, Dict[str, Any]]:
@@ -273,6 +363,7 @@ def maybe_start_exporter(topology=None) -> Optional[MetricsExporter]:
         from .step_stats import bind_resilience_gauges
 
         bind_resilience_gauges()
+        bind_process_gauges()
         return start_exporter(rank=rank,
                               port_offset=max(0, int(local_rank)),
                               kv_client=kv)

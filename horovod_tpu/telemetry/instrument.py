@@ -159,13 +159,19 @@ def reset() -> None:
 
 
 def wrap_step(fn: Callable) -> Callable:
-    """Wrap a jitted step so each call's dispatch duration is recorded.
+    """Wrap a jitted step so each call's dispatch duration is recorded
+    (metric summary, and a span + step-counter advance when the
+    distributed tracer is on — trace.py derives the deterministic
+    per-step trace ids from that counter).
 
-    Zero-overhead contract: telemetry off returns ``fn`` ITSELF (no
-    wrapper object, identity-tested).  The wrapper forwards attribute
-    access (``.lower()``, ``.trace()``, static-arg plumbing) to the
-    jitted callable so it stays a drop-in."""
-    if get_recorder() is None:
+    Zero-overhead contract: with both ``HVDT_TELEMETRY`` and
+    ``HVDT_TRACE_DIR`` unset this returns ``fn`` ITSELF (no wrapper
+    object, identity-tested).  The wrapper forwards attribute access
+    (``.lower()``, ``.trace()``, static-arg plumbing) to the jitted
+    callable so it stays a drop-in."""
+    from . import trace as _trace
+
+    if get_recorder() is None and _trace.get_tracer() is None:
         return fn
     return _TimedStep(fn)
 
@@ -179,14 +185,21 @@ class _TimedStep:
         self._fn = fn
 
     def __call__(self, *args, **kwargs):
+        from . import trace as _trace
+
         rec = get_recorder()
-        if rec is None:
+        tracer = _trace.get_tracer()
+        if rec is None and tracer is None:
             return self._fn(*args, **kwargs)
         import time
 
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
-        rec.observe_step_dispatch(time.perf_counter() - t0)
+        dur = time.perf_counter() - t0
+        if rec is not None:
+            rec.observe_step_dispatch(dur)
+        if tracer is not None:
+            tracer.step_span(dur)
         return out
 
     def __getattr__(self, name):

@@ -36,6 +36,10 @@ def _fmt_labels(labels: Dict[str, str]) -> str:
 
 def _fmt_value(v: float) -> str:
     f = float(v)
+    if f != f:
+        return "NaN"    # Prometheus-canonical (live probes with no data)
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
     return str(int(f)) if f == int(f) else repr(f)
 
 

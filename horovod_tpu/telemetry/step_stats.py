@@ -28,7 +28,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from .metrics import MetricsRegistry, default_registry
+from .metrics import Gauge, MetricsRegistry, default_registry
 
 __all__ = ["StepTimer", "GoodputLedger", "peak_flops_for",
            "bind_resilience_gauges", "PEAK_BY_DEVICE_KIND"]
@@ -43,6 +43,16 @@ PEAK_BY_DEVICE_KIND = (
     ("v5litepod", 197e12, 819e9),
     ("v4", 275e12, 1228e9), ("v3", 123e12, 900e9), ("v2", 46e12, 700e9),
 )
+
+
+def _positive_or_none(value) -> Optional[float]:
+    """Finite positive float, else None — the 'is MFU publishable' test
+    (0, NaN, inf, and unparsable values all mean 'unknown')."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return v if (v > 0 and v != float("inf")) else None
 
 
 def peak_flops_for(device_kind: str):
@@ -83,10 +93,15 @@ class StepTimer:
         reg = registry if registry is not None else default_registry()
         self.registry = reg
         self.examples_per_step = int(examples_per_step)
-        self.flops_per_step = flops_per_step
+        # MFU inputs are *validated up front*: an unknown device-peak
+        # table entry (peak_flops_for -> None), zero/absent caller
+        # flops, or a non-finite value mean MFU is unpublishable — the
+        # gauge is then never registered (rather than rendering a
+        # misleading 0) and observe() can't divide by zero.
+        self.flops_per_step = _positive_or_none(flops_per_step)
         if peak_flops is None and device_kind:
             peak_flops, _ = peak_flops_for(device_kind)
-        self.peak_flops = peak_flops
+        self.peak_flops = _positive_or_none(peak_flops)
         self.straggler = straggler
         self._alpha = float(ewma_alpha)
         self._ewma: Optional[float] = None
@@ -99,11 +114,13 @@ class StepTimer:
         self._examples = reg.gauge(
             "hvdt_examples_per_sec",
             "Windowed training throughput (examples/s, EWMA of step time)")
-        self._mfu = reg.gauge(
-            "hvdt_mfu",
-            "Model-flops utilization: flops_per_step / (step_time * "
-            "peak_flops); 0 until the first observation, absent peak "
-            "stays 0")
+        self._mfu: Optional[Gauge] = None
+        if self.flops_per_step is not None and self.peak_flops is not None:
+            self._mfu = reg.gauge(
+                "hvdt_mfu",
+                "Model-flops utilization: flops_per_step / (step_time * "
+                "peak_flops); only published when caller flops and the "
+                "device peak are both known")
 
     def step(self):
         """Context manager timing one step."""
@@ -121,9 +138,9 @@ class StepTimer:
         if ewma > 0:
             if self.examples_per_step:
                 self._examples.set(self.examples_per_step / ewma)
-            if self.flops_per_step and self.peak_flops:
+            if self._mfu is not None:
                 self._mfu.set(
-                    float(self.flops_per_step) / (ewma * self.peak_flops))
+                    self.flops_per_step / (ewma * self.peak_flops))
         if self.straggler is not None:
             self.straggler.observe(s)
 
@@ -135,6 +152,8 @@ class StepTimer:
         return self._summary.mean()
 
     def mfu(self) -> Optional[float]:
+        if self._mfu is None:
+            return None
         v = self._mfu.value()
         return v if v > 0 else None
 
@@ -150,7 +169,8 @@ class StepTimer:
             "examples_per_sec": (round(self._examples.value(), 2)
                                  if self._summary.count else None),
             "mfu": (round(self._mfu.value(), 4)
-                    if self._mfu.value() > 0 else None),
+                    if self._mfu is not None and self._mfu.value() > 0
+                    else None),
         }
 
 

@@ -137,11 +137,20 @@ class Timeline:
                 if stack:
                     marker, t0 = stack.pop()
                     from .telemetry.instrument import get_recorder
+                    from .telemetry.trace import get_tracer
 
+                    dur_s = (ev.ts - t0) / 1e6
                     recorder = get_recorder()
                     if recorder is not None:
-                        recorder.observe_phase(marker,
-                                               (ev.ts - t0) / 1e6)
+                        recorder.observe_phase(marker, dur_s)
+                    tracer = get_tracer()
+                    if tracer is not None:
+                        # Same span, cross-rank view: the distributed
+                        # tracer's buffer feeds the driver-side merged
+                        # trace (rank as pid) while this file keeps the
+                        # per-tensor single-rank view.
+                        tracer.complete(marker, dur_s, cat="timeline",
+                                        args={"tensor": ev.tensor})
 
     def close(self) -> None:
         if self._closed:

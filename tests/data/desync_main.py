@@ -1,0 +1,92 @@
+"""Desync-forensics scenario for the flight-recorder battery.
+
+Two (or more) ranks run a lockstep loop; each step every rank books one
+deterministic collective event into its flight recorder, publishes its
+ring to the rendezvous KV, and heartbeats.  A ``hang@step=N:rank=R``
+fault plan wedges one rank *before* it records step N's event — exactly
+the shape of a diverged-host-control-flow hang.  Rank 0 feeds the peer's
+heartbeat age into a real resilience :class:`Escalator`; when the abort
+rung fires, the escalation path's forensics hook gathers every rank's
+event sequence from the KV and emits the structured desync report
+(``desync_report_rank0.json`` under ``HVDT_TRACE_DIR``) naming the hung
+rank and the first divergent seq — the assertion surface of the test.
+
+(Coupling rides KV heartbeats, not collectives: the container's CPU jax
+cannot run multiprocess XLA — same constraint and pattern as
+``resilient_main.py``; the forensics machinery under test is identical
+either way.)
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from horovod_tpu.resilience import faults  # noqa: E402
+from horovod_tpu.resilience.escalation import (EscalationPolicy,  # noqa: E402
+                                               Escalator)
+from horovod_tpu.runner.http_kv import KVClient  # noqa: E402
+from horovod_tpu.telemetry import flight_recorder as frm  # noqa: E402
+
+
+def _peer_step(kv, r):
+    try:
+        raw = kv.get(f"/hb/{r}")
+    except (ConnectionError, OSError):
+        raw = None
+    return int(raw) if raw else 0
+
+
+def main():
+    rank = int(os.environ["HVDT_RANK"])
+    size = int(os.environ["HVDT_SIZE"])
+    steps = int(os.environ.get("DESYNC_TEST_STEPS", "12"))
+    deadline_s = float(os.environ.get("DESYNC_TEST_DEADLINE", "20"))
+    abort_s = float(os.environ.get("DESYNC_TEST_ABORT_S", "1.0"))
+
+    kv = KVClient.from_env()
+    fr = frm.get_flight_recorder()
+    assert fr is not None, "HVDT_FLIGHT_RECORDER must be on for this test"
+    inj = faults.get_injector()
+    esc = (Escalator(EscalationPolicy(warn_s=abort_s / 2, abort_s=abort_s))
+           if rank == 0 else None)
+
+    for step in range(1, steps + 1):
+        if inj is not None:
+            inj.fire("step", step=step)   # the hang fires here on its rank
+        seq = fr.record_begin(op="allreduce", name=f"grads.step{step}",
+                              dtype="float32", shape=(1024,), nbytes=4096)
+        fr.record_end(seq)
+        fr.publish(kv, rank)
+        kv.put(f"/hb/{rank}", str(step).encode())
+
+        stall_t0 = time.monotonic()
+        hard_deadline = stall_t0 + deadline_s
+        while True:
+            if kv.get("/desync/done"):
+                # The coordinator already diagnosed the hang and wrote
+                # its report; everyone winds down cleanly.
+                return 0
+            behind = [r for r in range(size)
+                      if r != rank and _peer_step(kv, r) < step]
+            if not behind:
+                break
+            if esc is not None:
+                level = esc.observe(f"grads.step{step}",
+                                    time.monotonic() - stall_t0)
+                if level >= 2:   # ABORT fired -> forensics hook ran
+                    kv.put("/desync/done", b"1")
+                    print(f"desync: abort rung fired at step {step}, "
+                          f"report emitted", flush=True)
+                    return 0
+            if time.monotonic() > hard_deadline:
+                print(f"desync: rank {rank} gave up waiting at step "
+                      f"{step}", file=sys.stderr, flush=True)
+                return 3
+            time.sleep(0.05)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

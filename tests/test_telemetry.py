@@ -248,6 +248,33 @@ class TestStepStats:
         assert timer.mfu() is None
         assert timer.snapshot()["mfu"] is None
 
+    def test_mfu_gauge_not_registered_for_unknown_device(self,
+                                                         telemetry_on):
+        """Regression: an unknown device-peak table entry must not
+        register (or render) a misleading hvdt_mfu=0 gauge."""
+        timer = tele.StepTimer(examples_per_step=8, flops_per_step=1e9,
+                               device_kind="riscv-sim-9000")
+        timer.observe(0.01)
+        assert telemetry_on.get("hvdt_mfu") is None
+        assert "hvdt_mfu" not in telemetry_on.render()
+        assert timer.mfu() is None
+
+    def test_mfu_guard_zero_and_nonfinite_inputs(self, telemetry_on):
+        """Regression: zero/absent/NaN caller flops or peak never divide
+        by zero and simply leave the gauge unpublished."""
+        for flops, peak in ((0, 1e12), (None, 1e12), (float("nan"), 1e12),
+                            (1e9, 0), (1e9, float("nan")),
+                            (1e9, float("inf")), ("garbage", 1e12)):
+            tmetrics.reset_default_registry()
+            reg = tele.default_registry()
+            timer = tele.StepTimer(examples_per_step=8,
+                                   flops_per_step=flops, peak_flops=peak,
+                                   registry=reg)
+            timer.observe(0.01)   # must not raise
+            assert reg.get("hvdt_mfu") is None, (flops, peak)
+            assert timer.mfu() is None
+            assert timer.snapshot()["mfu"] is None
+
     def test_peak_table(self):
         flops, bw = tele.peak_flops_for("TPU v4")
         assert flops == 275e12 and bw == 1228e9
@@ -437,6 +464,69 @@ class TestExporter:
         finally:
             a.stop()
             b.stop()
+
+    def test_two_workers_same_env_port_both_scrapeable(self, monkeypatch,
+                                                       telemetry_on):
+        """The launch-contract collision path: two same-host workers read
+        the same HVDT_METRICS_PORT (no port_offset plan); the second must
+        fall back to an ephemeral port with a logged warning, and BOTH
+        endpoints must scrape."""
+        import logging
+        import socket
+
+        # pick a concrete free port, then hand it to both workers via env
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        base_port = probe.getsockname()[1]
+        probe.close()
+        monkeypatch.setenv("HVDT_METRICS_PORT", str(base_port))
+        a = tele.MetricsExporter(rank=0)
+        b = tele.MetricsExporter(rank=1)
+        # the hvdt logger root doesn't propagate (logging_util), so
+        # caplog can't see it — attach a capturing handler directly
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        exporter_log = logging.getLogger(
+            tele.exporter.log.name if hasattr(tele, "exporter")
+            else "horovod_tpu.telemetry.exporter")
+        handler = _Capture(level=logging.WARNING)
+        exporter_log.addHandler(handler)
+        try:
+            pa = a.start()
+            pb = b.start()
+            assert pa == base_port
+            assert pb != pa and pb > 0
+            reg = tele.default_registry()
+            reg.counter("t_shared").inc()
+            assert "t_shared" in _scrape(pa)
+            assert "t_shared" in _scrape(pb)
+            assert any("unavailable" in m for m in records), records
+        finally:
+            exporter_log.removeHandler(handler)
+            a.stop()
+            b.stop()
+
+    def test_process_resource_gauges(self, telemetry_on):
+        """RSS / open-fds / HBM gauges: live probes, guarded — on this
+        container (Linux, CPU jax 0.4.37) RSS and fds are real numbers
+        and memory_stats() returns None, which must render as nan, not
+        raise."""
+        tele.bind_process_gauges()
+        reg = tele.default_registry()
+        rss = reg.get("hvdt_process_rss_bytes").value()
+        assert rss > 1024 * 1024     # a Python+JAX process is >1 MiB
+        fds = reg.get("hvdt_process_open_fds").value()
+        assert fds >= 3              # stdin/stdout/stderr at minimum
+        hbm = reg.get("hvdt_hbm_bytes_in_use").value()
+        assert hbm != hbm or hbm >= 0    # nan (CPU/old jax) or a real byte count
+        text = reg.render()          # probes render without raising
+        assert "hvdt_process_rss_bytes" in text
+        assert "hvdt_process_open_fds" in text
+        assert "hvdt_hbm_bytes_in_use" in text
 
     def test_snapshot_dict_rolls_up_headline_metrics(self, telemetry_on):
         rec = tinst.get_recorder()

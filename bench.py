@@ -48,7 +48,8 @@ def _save_last_good(line: str) -> None:
         if d.get("platform") in (None, "cpu"):
             return
         if d.get("steps_per_call") or d.get("fused_optimizer") \
-                or d.get("fault_plan") or d.get("telemetry"):
+                or d.get("fault_plan") or d.get("telemetry") \
+                or d.get("overlap"):
             # A/B probe variants, chaos runs, and telemetry-instrumented
             # runs are not the headline metric — caching one would
             # contaminate the outage-fallback evidence (telemetry adds
@@ -100,6 +101,17 @@ def _parse_args(argv=None):
                          "optax — one HBM pass per eligible parameter. "
                          "Default off pending the TPU A/B; the leg is "
                          "kept out of the last-good headline cache.")
+    ap.add_argument("--overlap", action="store_true",
+                    help="A/B leg: route the train step through the "
+                         "overlap scheduling layer (HVDT_OVERLAP=on, "
+                         "ops/overlap.py) — grads exchanged over a "
+                         "mesh-bound dp axis with the reverse-"
+                         "topological bucket schedule, XLA latency-"
+                         "hiding flags engaged, telemetry on so the "
+                         "hvdt_overlap_fraction gauge feeds the JSON "
+                         "(overlap_fraction / overlap_schedule).  Kept "
+                         "out of the last-good headline cache until a "
+                         "real TPU run lands.")
     ap.add_argument("--serve", action="store_true",
                     help="Serving micro-benchmark instead of training: "
                          "an in-process ModelServer (MLP, shape-bucketed "
@@ -224,6 +236,19 @@ def _run_child(args) -> None:
                      ".xla_cache"))
     cache_dir = enable_compilation_cache()
 
+    if args.overlap:
+        # Overlap leg env contract (read lazily by the subsystems):
+        # route the exchange through the scheduler, turn telemetry on so
+        # the hvdt_overlap_fraction gauge is live, and default the
+        # fusion threshold down so the ResNet-50 gradient pytree plans a
+        # multi-bucket schedule (bf16 grads ~51 MB would fit one 64 MiB
+        # bucket — nothing to overlap).  All setdefault: explicit env
+        # wins.
+        os.environ.setdefault("HVDT_OVERLAP", "on")
+        os.environ.setdefault("HVDT_TELEMETRY", "1")
+        os.environ.setdefault("HVDT_FUSION_THRESHOLD",
+                              str(8 * 1024 * 1024))
+
     dev = jax.devices()[0]
     print(f"benchmarking on {dev.platform}:{dev.device_kind}"
           + (f" (compile cache: {cache_dir})" if cache_dir else ""),
@@ -250,6 +275,63 @@ def _run_child(args) -> None:
             resnet_loss, has_aux=True)(params, stats, images, labels, cfg)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    if args.overlap:
+        # Overlap A/B leg: run the step inside a dp-axis shard_map so the
+        # gradient exchange actually exists (single-chip runs bind a
+        # 1-device axis; the schedule, barriers and accounting are the
+        # same program that runs multi-chip), routed through the overlap
+        # scheduler via HVDT_OVERLAP=on.  A smaller default fusion
+        # threshold guarantees a multi-bucket schedule on the ~100 MB
+        # ResNet-50 gradient pytree so overlap_fraction is meaningful.
+        import inspect
+
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        from horovod_tpu import optimizer as hvd_opt
+        from horovod_tpu.common.types import ReduceOp
+        from horovod_tpu.ops import device as hvd_dev
+        from horovod_tpu.ops import overlap as hvd_ovl
+
+        hvd_ovl.enable_latency_hiding()
+        ndev = len(jax.devices())
+        if ndev < 1 or args.batch_size % ndev:
+            ndev = 1    # batch must split evenly over the dp axis
+        mesh = Mesh(np.asarray(jax.devices()[:ndev], dtype=object), ("dp",))
+        print(f"overlap leg: dp mesh over {ndev} device(s), "
+              f"HVDT_OVERLAP={os.environ.get('HVDT_OVERLAP')!r}",
+              file=sys.stderr)
+        _smap_kw = {}
+        _sig = inspect.signature(shard_map).parameters
+        if "check_rep" in _sig:
+            _smap_kw["check_rep"] = False   # pre-vma JAX + Pallas legs
+        elif "check_vma" in _sig:
+            _smap_kw["check_vma"] = False
+
+        def _sharded_step(params, stats, opt_state, images, labels):
+            def body(params, stats, opt_state, images, labels):
+                (loss, new_stats), grads = jax.value_and_grad(
+                    resnet_loss, has_aux=True)(params, stats, images,
+                                               labels, cfg)
+                grads = hvd_opt.allreduce_gradients(grads, axis="dp")
+                new_stats = hvd_dev.allreduce(new_stats, "dp",
+                                              ReduceOp.AVERAGE)
+                loss = hvd_dev.allreduce(loss, "dp", ReduceOp.AVERAGE)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), new_stats,
+                        opt_state, loss)
+
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(), P(), P("dp"), P("dp")),
+                out_specs=(P(), P(), P(), P()), **_smap_kw)(
+                    params, stats, opt_state, images, labels)
+
+        one_step = _sharded_step
 
     if args.steps_per_call > 1:
         from jax import lax
@@ -467,6 +549,7 @@ def _run_child(args) -> None:
         "flops_per_step": flops_per_step,
         "flops_pre_rescale": flops_pre_rescale,
         **({"compile_cache": cache_dir} if cache_dir else {}),
+        **(_overlap_doc() if args.overlap else {}),
         **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
            if args.steps_per_call != 1 else {}),
@@ -477,6 +560,31 @@ def _run_child(args) -> None:
            if inj is not None else {}),
         **({"telemetry": telemetry_doc} if telemetry_doc else {}),
     }))
+
+
+def _overlap_doc() -> dict:
+    """The --overlap leg's JSON fields: the telemetry gauge value (the
+    acceptance handle — `overlap_fraction > 0` proves the schedule
+    actually traced hidden collectives) and the last bucket plan.
+    Rides outside the last-good headline cache (see _save_last_good)
+    until a real TPU run lands."""
+    from horovod_tpu.ops import overlap as _ovl
+    from horovod_tpu.telemetry.instrument import get_recorder
+
+    fraction = None
+    rec = get_recorder()
+    if rec is not None:
+        try:
+            v = float(rec.registry.gauge("hvdt_overlap_fraction").value())
+            if v > 0:       # 0.0 is the never-set default — fall through
+                fraction = round(v, 4)
+        except Exception:
+            fraction = None
+    if fraction is None and _ovl.overlap_fraction() is not None:
+        fraction = round(_ovl.overlap_fraction(), 4)
+    return {"overlap": True,
+            "overlap_fraction": fraction,
+            "overlap_schedule": _ovl.last_schedule()}
 
 
 def _profiled_hbm_util(compiled, params, stats, opt_state, images,
@@ -573,7 +681,8 @@ def main() -> None:
             "--num-batches-per-iter", str(args.num_batches_per_iter),
             "--num-warmup", str(args.num_warmup),
             "--steps-per-call", str(args.steps_per_call)] \
-        + (["--fused-optimizer"] if args.fused_optimizer else [])
+        + (["--fused-optimizer"] if args.fused_optimizer else []) \
+        + (["--overlap"] if args.overlap else [])
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

@@ -6,8 +6,8 @@
 #   ci/gen-matrix.sh --smoke   emit only the fast smoke service
 #       (compileall + optimizer-kernel + serving-subsystem +
 #       quantized-collective + resilience-chaos + telemetry +
-#       tracing/flight-recorder-forensics tests on CPU) — the
-#       pre-merge gate.
+#       tracing/flight-recorder-forensics + overlap-scheduling tests
+#       on CPU) — the pre-merge gate.
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

@@ -121,10 +121,17 @@ def __getattr__(name):
             from .ops import optim_kernels
 
             return getattr(optim_kernels, name)
-        if name in ("enable_compilation_cache", "donated_step"):
+        if name in ("enable_compilation_cache", "donated_step",
+                    "overlap_step"):
             from . import step_pipeline as _sp
 
             return getattr(_sp, name)
+        if name == "overlap":
+            # Overlap scheduling layer (dependency-ordered gradient
+            # exchange, async collectives, pipelined updates).
+            from .ops import overlap
+
+            return overlap
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
                     "data", "native", "orchestrate", "interop",

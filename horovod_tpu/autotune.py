@@ -164,6 +164,7 @@ class ParameterManager:
     OVERLAP_CANDIDATES = (1, 2, 4)
     FUSED_OPTIMIZER_CANDIDATES = (0.0, 1.0)
     QUANT_CANDIDATES = (0.0, 1.0)
+    OVERLAP_SCHEDULE_CANDIDATES = (0.0, 1.0)
 
     def __init__(self,
                  warmup_samples: Optional[int] = None,
@@ -172,7 +173,8 @@ class ParameterManager:
                  log_file: Optional[str] = None,
                  noise: Optional[float] = None,
                  tune_fused_optimizer: Optional[bool] = None,
-                 tune_quant: Optional[bool] = None):
+                 tune_quant: Optional[bool] = None,
+                 tune_overlap: Optional[bool] = None):
         self.warmup = (warmup_samples if warmup_samples is not None
                        else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
         self.steps_per_sample = (
@@ -197,9 +199,21 @@ class ParameterManager:
         # with the bucketing it directly interacts with.
         self.tune_quant = (tune_quant if tune_quant is not None
                            else config.get_bool("HVDT_AUTOTUNE_QUANT"))
-        # Column layout: [log2_bucket, overlap] (+fused) (+quant).
+        # Optional fifth dimension: overlap-schedule on/off
+        # (ops/overlap.py) — whether the dependency-ordered, pipelined
+        # exchange beats the monolithic fused path depends on the very
+        # bucketing the GP already searches, so they are priced jointly.
+        # Both legs keep one optimizer state tree (the schedule changes
+        # lowering, never state), so the hot swap is a re-jit only.
+        self.tune_overlap = (tune_overlap if tune_overlap is not None
+                             else config.get_bool("HVDT_AUTOTUNE_OVERLAP"))
+        # Column layout: [log2_bucket, overlap] (+fused) (+quant)
+        # (+overlap_schedule).
         self._quant_col = (2 + int(self.tune_fused)) if self.tune_quant \
             else None
+        self._overlap_col = (
+            2 + int(self.tune_fused) + int(self.tune_quant)
+        ) if self.tune_overlap else None
         import itertools
 
         dims = [self.LOG2_BUCKET_CANDIDATES, self.OVERLAP_CANDIDATES]
@@ -207,6 +221,8 @@ class ParameterManager:
             dims.append(self.FUSED_OPTIMIZER_CANDIDATES)
         if self.tune_quant:
             dims.append(self.QUANT_CANDIDATES)
+        if self.tune_overlap:
+            dims.append(self.OVERLAP_SCHEDULE_CANDIDATES)
         grid = np.array(list(itertools.product(*dims)), float)
         self._bo = BayesianOptimizer(grid, noise=noise)
         start = [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0]
@@ -214,6 +230,8 @@ class ParameterManager:
             start.append(float(config.get_bool("HVDT_FUSED_OPTIMIZER")))
         if self.tune_quant:
             start.append(float(_env_quant_wire()))
+        if self.tune_overlap:
+            start.append(float(_env_overlap()))
         self._current = np.array(start)
         self._sample = _Sample(self._current)
         self._samples_done = 0
@@ -245,6 +263,14 @@ class ParameterManager:
         if self.tune_quant:
             return bool(self._current[self._quant_col] >= 0.5)
         return _env_quant_wire()
+
+    @property
+    def overlap_schedule(self) -> bool:
+        """Current overlap-schedule on/off choice; outside the tuned
+        dimension it reports the HVDT_OVERLAP env default."""
+        if self.tune_overlap:
+            return bool(self._current[self._overlap_col] >= 0.5)
+        return _env_overlap()
 
     @property
     def tuning_complete(self) -> bool:
@@ -304,6 +330,14 @@ def _env_quant_wire() -> bool:
     starting leg): HVDT_QUANT, or HVDT_COMPRESSION=int8."""
     return (config.get_bool("HVDT_QUANT")
             or config.get_str("HVDT_COMPRESSION").strip().lower() == "int8")
+
+
+def _env_overlap() -> bool:
+    """The environment's overlap-schedule default (the overlap
+    dimension's starting leg): HVDT_OVERLAP truthy."""
+    from .ops.overlap import enabled
+
+    return enabled()
 
 
 class BenchmarkAutotuner:
@@ -391,8 +425,10 @@ class BenchmarkAutotuner:
                  if self.pm.tune_fused else "")
         quant = (f" wire={'int8' if self.pm.quant_wire else 'f32'}"
                  if self.pm.tune_quant else "")
+        ovl = (f" schedule={'overlap' if self.pm.overlap_schedule else 'mono'}"
+               if self.pm.tune_overlap else "")
         return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
-                f"overlap={self.pm.overlap_buckets}{fused}{quant} "
+                f"overlap={self.pm.overlap_buckets}{fused}{quant}{ovl} "
                 f"({self.pm._samples_done} samples)")
 
 
@@ -442,6 +478,15 @@ class AutotunedStep:
     ``compression=`` between ``Compression.int8`` and
     ``Compression.none``; tests/test_quant.py pins the contract).
 
+    With ``HVDT_AUTOTUNE_OVERLAP=1`` the space gains an
+    overlap-schedule on/off dimension (ops/overlap.py): builders
+    accepting an ``overlap`` keyword are rebuilt as
+    ``builder(threshold_bytes, overlap=bool)`` — hot-swappable mid-run
+    because the schedule changes lowering, never optimizer state, so
+    both legs keep one state tree (and a leg-memoizing builder flips
+    back to a previously compiled program without re-jitting;
+    tests/test_overlap.py pins the contract).
+
     Args:
       builder: ``builder(threshold_bytes | None) -> step_callable``
         (optionally also accepting ``fused=bool``).
@@ -466,9 +511,11 @@ class AutotunedStep:
                          for p in sig.values())
             self._accepts_fused = "fused" in sig or var_kw
             self._accepts_quant = "quant" in sig or var_kw
+            self._accepts_overlap = "overlap" in sig or var_kw
         except (TypeError, ValueError):
             self._accepts_fused = False
             self._accepts_quant = False
+            self._accepts_overlap = False
         # Pin every tuned A/B dimension's starting leg at build 0 so the
         # opt-state structure established before tuning matches every
         # later rebuild (both fused legs keep one state tree —
@@ -481,6 +528,9 @@ class AutotunedStep:
         if (self.enabled and self._accepts_quant
                 and config.get_bool("HVDT_AUTOTUNE_QUANT")):
             build_kw["quant"] = _env_quant_wire()
+        if (self.enabled and self._accepts_overlap
+                and config.get_bool("HVDT_AUTOTUNE_OVERLAP")):
+            build_kw["overlap"] = _env_overlap()
         self._step = builder(None, **build_kw)
         self._tree_example = tree_example
         self._steps_per_sample = steps_per_sample
@@ -513,6 +563,8 @@ class AutotunedStep:
             kw["fused"] = pm.fused_optimizer
         if pm.tune_quant and self._accepts_quant:
             kw["quant"] = pm.quant_wire
+        if pm.tune_overlap and self._accepts_overlap:
+            kw["overlap"] = pm.overlap_schedule
         return self._builder(self._tuner.bucket_bytes, **kw)
 
     @staticmethod

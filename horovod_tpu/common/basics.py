@@ -190,6 +190,18 @@ def init(
 
         enable_compilation_cache()
 
+        # XLA latency-hiding / async-collective-fusion flags
+        # (HVDT_XLA_LATENCY_HIDING, ops/overlap.py): engage BEFORE the
+        # first jax computation below initializes the backend — libtpu
+        # reads LIBTPU_INIT_ARGS once at TPU init.  auto (default) keeps
+        # non-TPU environments untouched; never raises.
+        try:
+            from ..ops.overlap import enable_latency_hiding
+
+            enable_latency_hiding()
+        except Exception as e:  # flags must never sink init
+            log.warning("latency-hiding flags not engaged: %r", e)
+
         # Wire-compression env selection (HVDT_COMPRESSION / HVDT_QUANT):
         # resolve NOW so an unknown name fails at init with the valid
         # list, not at the first optimizer step on some worker.

@@ -57,6 +57,32 @@ KNOBS: Dict[str, Knob] = {
            "operations.cc:500-506)."),
         _k("HVDT_BATCH_COLLECTIVES", True, _parse_bool,
            "Pack multiple same-dtype tensors into one fused collective."),
+        # --- overlap scheduling (ops/overlap.py: dependency-ordered
+        #     bucket schedule, async collectives, pipelined int8 wire,
+        #     fused-update latency hiding) ---
+        _k("HVDT_OVERLAP", "", str,
+           "Overlapped gradient exchange: 'on' routes bucketed gradient "
+           "collectives through the reverse-topological, barrier-pinned "
+           "overlap schedule (ops/overlap.py) so each bucket's allreduce "
+           "is issued as soon as its grads exist; unset/'off' (default) "
+           "keeps the monolithic fused_allreduce path — the EXACT "
+           "pre-existing code objects (overlap.get_scheduler() is None, "
+           "zero wrappers)."),
+        _k("HVDT_XLA_LATENCY_HIDING", "auto", str,
+           "XLA latency-hiding scheduler / async collective fusion "
+           "flags (ridden via LIBTPU_INIT_ARGS, read once at TPU "
+           "backend init; inert off-TPU): auto (skip when JAX_PLATFORMS "
+           "pins a non-TPU backend), on, off.  Engaged by hvd.init() "
+           "and bench.py --overlap — this is what turns the overlap "
+           "schedule's dependency freedom into overlapped execution on "
+           "hardware."),
+        _k("HVDT_AUTOTUNE_OVERLAP", False, _parse_bool,
+           "Add an overlap-schedule on/off dimension to the autotune "
+           "search space; the step builder is rebuilt with overlap=... "
+           "at each knob change (autotune.AutotunedStep), hot-swappable "
+           "because both legs keep one optimizer state tree (the "
+           "schedule changes lowering, never state).  Starting point "
+           "comes from HVDT_OVERLAP."),
         # --- cache (ref: HOROVOD_CACHE_CAPACITY common.h:114) ---
         _k("HVDT_CACHE_CAPACITY", 1024, int,
            "Response-cache capacity (negotiated-collective descriptors)."),

@@ -162,25 +162,66 @@ def prefetch_to_device(it: Iterable[Any], size: int = 2,
     Keeps ``size`` batches in flight: each is ``jax.device_put`` (with
     ``sharding`` — e.g. NamedSharding(mesh, P('dp'))) before the previous
     one is consumed, so the h2d transfer of batch N+1 overlaps step N.
-    ``put`` overrides the transfer fn (e.g. for pytrees of mixed
-    shardings).
+
+    ``sharding`` may be a single Sharding (applied to every leaf) or a
+    pytree of shardings matching the batch structure — per-leaf
+    sharding-aware transfer, e.g. batch-sharded images next to a
+    replicated step counter.  ``put`` overrides the transfer fn entirely.
+
+    The returned generator cleans up after itself: abandoning it early
+    (``close()`` / GeneratorExit / garbage collection) drops the queued
+    in-flight device buffers — and deletes their device storage when the
+    backend exposes ``.delete()`` — instead of pinning ``size`` batches
+    of HBM until process exit.
     """
+    if size < 1:
+        raise ValueError(
+            f"prefetch_to_device needs size >= 1 (got {size}); size "
+            "batches are kept in flight, so 0 would never yield")
+    return _prefetch_gen(iter(it), size, sharding, put)
+
+
+def _prefetch_gen(it: Iterator[Any], size: int, sharding: Any,
+                  put: Optional[Callable[[Any], Any]]) -> Iterator[Any]:
     import collections
 
     import jax
 
     if put is None:
-        def put(batch):
-            return jax.tree.map(
-                lambda x: jax.device_put(x, sharding), batch)
+        single = sharding is None or isinstance(
+            sharding, getattr(jax.sharding, "Sharding", ()))
+        if single:
+            def put(batch):
+                return jax.tree.map(
+                    lambda x: jax.device_put(x, sharding), batch)
+        else:
+            # Pytree of shardings: per-leaf transfer placement.
+            def put(batch):
+                return jax.tree.map(
+                    lambda x, s: jax.device_put(x, s), batch, sharding)
 
     buf: collections.deque = collections.deque()
-    it = iter(it)
     try:
+        exhausted = False
         while True:
-            while len(buf) < size:
-                buf.append(put(next(it)))
+            while not exhausted and len(buf) < size:
+                try:
+                    buf.append(put(next(it)))
+                except StopIteration:
+                    exhausted = True
+            if not buf:
+                return
             yield buf.popleft()
-    except StopIteration:
+    finally:
+        # Early abandonment (close()/GeneratorExit/GC): drop queued
+        # device buffers so they don't pin HBM; normal exhaustion hits
+        # this with an empty deque.
         while buf:
-            yield buf.popleft()
+            dropped = buf.popleft()
+            for leaf in jax.tree.leaves(dropped):
+                delete = getattr(leaf, "delete", None)
+                if callable(delete):
+                    try:
+                        delete()
+                    except Exception:  # freeing must never raise mid-close
+                        pass

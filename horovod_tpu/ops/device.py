@@ -23,7 +23,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.logging_util import get_logger
 from ..common.types import ReduceOp
+
+log = get_logger(__name__)
 
 __all__ = [
     "allreduce",
@@ -400,18 +403,58 @@ def alltoall(x, axis: AxisName = "dp", split_axis: int = 0, concat_axis: int = 0
 # copies that fuse with neighbours.
 # ---------------------------------------------------------------------------
 
+_threshold_warned = False
+
+
+def _validated_threshold(threshold_bytes: Optional[Any] = None) -> int:
+    """Resolve and validate the fusion threshold.
+
+    ``None`` reads ``HVDT_FUSION_THRESHOLD``.  Non-positive or
+    unparseable values (env garbage, a caller passing 0/-1) must not
+    flow into bucket planning — a threshold of 0 would put every leaf
+    in its own bucket and a negative one is meaningless — so they clamp
+    to the registry default with a one-time warning."""
+    global _threshold_warned
+    from ..common import config
+
+    if threshold_bytes is None:
+        threshold_bytes = config.get_int("HVDT_FUSION_THRESHOLD")
+    try:
+        t = int(threshold_bytes)
+    except (TypeError, ValueError):
+        t = -1
+    if t <= 0:
+        default = int(config.KNOBS["HVDT_FUSION_THRESHOLD"].default)
+        if not _threshold_warned:
+            log.warning(
+                "invalid fusion threshold %r (HVDT_FUSION_THRESHOLD or "
+                "caller override); clamping to the default %d bytes",
+                threshold_bytes, default)
+            _threshold_warned = True
+        return default
+    return t
+
+
 def fused_allreduce_buckets(leaves: Sequence[jax.Array],
                             threshold_bytes: int) -> List[List[int]]:
     """Plan fusion buckets: group leaf indices by dtype, pack up to
     ``threshold_bytes`` per bucket (64-byte alignment unit like the
     reference, common.h:147 — moot on TPU but kept for parity of the plan).
 
-    Pure planning function; host-side, shape-only."""
+    Pure planning function; host-side, shape-only.  Deterministic:
+    dtype groups are emitted in canonical (dtype-name) order, not dict
+    insertion order, so the plan does not depend on which dtype happens
+    to appear first in ``leaves`` — same leaves, any interleaving of
+    dtypes → same bucket plan (within a dtype, input order is preserved:
+    it is the reverse-topological adjacency the overlap schedule needs).
+    """
+    threshold_bytes = _validated_threshold(threshold_bytes)
     by_dtype: Dict[Any, List[int]] = {}
     for i, leaf in enumerate(leaves):
         by_dtype.setdefault(jnp.result_type(leaf), []).append(i)
     buckets: List[List[int]] = []
-    for dtype, idxs in by_dtype.items():
+    for dtype, idxs in sorted(by_dtype.items(),
+                              key=lambda kv: jnp.dtype(kv[0]).name):
         cur: List[int] = []
         cur_bytes = 0
         itemsize = jnp.dtype(dtype).itemsize
@@ -443,10 +486,7 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
     payloads on the wire, f32 accumulation in the middle; non-float
     buckets keep the exact path.
     """
-    from ..common import config
-
-    if threshold_bytes is None:
-        threshold_bytes = config.get_int("HVDT_FUSION_THRESHOLD")
+    threshold_bytes = _validated_threshold(threshold_bytes)
 
     quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
         "int8", "int8_blockwise")

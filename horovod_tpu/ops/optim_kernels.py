@@ -57,7 +57,8 @@ import jax.numpy as jnp
 
 from .pallas_kernels import _use_interpret, _vma_kw
 
-__all__ = ["fused_adam", "fused_sgd", "fused_update_eligible"]
+__all__ = ["fused_adam", "fused_sgd", "fused_update_eligible",
+           "sgd_leaf_update", "adam_leaf_update"]
 
 _LANES = 128
 # Per-dtype minimum sublane tile (see pallas_kernels._fit_block): Mosaic
@@ -258,6 +259,23 @@ def fused_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def adam_leaf_update(p, g, m, v, scalars, *, b1: float = 0.9,
+                     b2: float = 0.999, eps: float = 1e-8,
+                     eps_root: float = 0.0, weight_decay: float = 0.0,
+                     use_kernels: bool = True):
+    """Public per-leaf Adam update ``(delta, m_new, v_new)`` — the unit
+    the overlap scheduler pipelines between bucket collectives
+    (ops/overlap.exchange_and_update).  ``scalars`` is the
+    ``[lr, 1/(1-b1^t), 1/(1-b2^t)]`` f32 stack (what ``fused_adam``
+    builds per step); picks the single-HBM-pass Pallas kernel when the
+    leaf is tile-eligible, the identical-math XLA fallback otherwise."""
+    fn = (_adam_leaf_fused if use_kernels
+          and fused_update_eligible(g, p.dtype, m.dtype, v.dtype)
+          else _adam_leaf_xla)
+    return fn(p, g, m, v, scalars, b1=b1, b2=b2, eps=eps,
+              eps_root=eps_root, wd=weight_decay)
+
+
 # ---- SGD (momentum) ------------------------------------------------------
 
 
@@ -304,6 +322,20 @@ def _sgd_leaf_xla(g, m, scalars, *, momentum, nesterov):
     m_new = g32 + momentum * m.astype(f32)
     u = g32 + momentum * m_new if nesterov else m_new
     return (-scalars[0] * u).astype(g.dtype), m_new.astype(m.dtype)
+
+
+def sgd_leaf_update(g, m, scalars, *, momentum: float,
+                    nesterov: bool = False, use_kernels: bool = True):
+    """Public per-leaf SGD-momentum update ``(delta, new_trace)`` — the
+    unit the overlap scheduler pipelines between bucket collectives
+    (ops/overlap.exchange_and_update / pipelined_sgd).  ``scalars`` is
+    the 1-element f32 ``[lr]`` stack; picks the single-HBM-pass Pallas
+    kernel when the leaf is tile-eligible, the identical-math XLA
+    fallback otherwise."""
+    fn = (_sgd_leaf_fused
+          if use_kernels and fused_update_eligible(g, m.dtype)
+          else _sgd_leaf_xla)
+    return fn(g, m, scalars, momentum=momentum, nesterov=nesterov)
 
 
 def fused_sgd(learning_rate, momentum: float = 0.0,

@@ -173,7 +173,13 @@ def allreduce_gradients(grads, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
         for i in unvarying_idx:
             out[i] = out[i] * scale if scale != 1.0 else out[i]
     if varying_idx:
-        reduced = dev.fused_allreduce(
+        # Overlap routing (ops/overlap.py): HVDT_OVERLAP=on swaps the
+        # monolithic fused_allreduce for the dependency-ordered bucket
+        # schedule; off/unset returns fused_allreduce ITSELF (identity
+        # contract — the pre-existing code object, zero wrappers).
+        from .ops.overlap import exchange_fn
+
+        reduced = exchange_fn()(
             [leaves[i] for i in varying_idx], axis=axis, op=op,
             threshold_bytes=threshold_bytes,
             prescale_factor=prescale_factor,

@@ -35,7 +35,8 @@ needed anywhere on this path.
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +46,8 @@ from ..common.types import ReduceOp
 from . import kernels as qk
 
 __all__ = ["quantized_allreduce_flat", "quantized_allreduce",
-           "eager_quantized_allreduce", "INT8_WIRE"]
+           "quantized_allreduce_start", "quantized_allreduce_finish",
+           "InflightQuantized", "eager_quantized_allreduce", "INT8_WIRE"]
 
 # Sentinel a Compressor exposes as ``wire_dtype`` to select this path in
 # fused_allreduce (a string on purpose: never mistakable for a dtype).
@@ -69,16 +71,41 @@ def _axis_size_static(axis: str) -> int:
         lax.psum(1, axis))
 
 
-def quantized_allreduce_flat(flat, axis="dp",
-                             op: ReduceOp = ReduceOp.AVERAGE,
-                             block_size: Optional[int] = None,
-                             prescale_factor: float = 1.0,
-                             postscale_factor: float = 1.0):
-    """Allreduce one flat float vector over ``axis`` with the int8 wire
-    (the bucket-level primitive ``fused_allreduce`` routes to).  Valid
-    inside shard_map where ``axis`` is bound; SUM/AVERAGE only (MIN/MAX
-    etc. have no meaningful block-rescaled accumulation).  Returns the
-    reduced vector in the input dtype, replicated across ``axis``."""
+@dataclasses.dataclass
+class InflightQuantized:
+    """A quantized allreduce whose bandwidth-heavy wire hop has been
+    issued but whose dequant-accumulate half has not run yet.
+
+    Produced by :func:`quantized_allreduce_start`, consumed by
+    :func:`quantized_allreduce_finish` — the seam the overlap scheduler
+    (ops/overlap.py) pipelines across buckets: while bucket N sits in
+    this state, bucket N+1's wire hop is already in flight, so N's
+    dequant-accumulate overlaps N+1's wire phase.  ``q_recv``/``s_recv``
+    are traced arrays (the received wire shards); everything else is
+    static trace-time metadata.
+    """
+    q_recv: Any
+    s_recv: Any
+    axis: str
+    op: ReduceOp
+    block: int
+    n: int
+    shard: int
+    total: int
+    size: int
+    dtype: Any
+
+
+def quantized_allreduce_start(flat, axis="dp",
+                              op: ReduceOp = ReduceOp.AVERAGE,
+                              block_size: Optional[int] = None,
+                              prescale_factor: float = 1.0
+                              ) -> InflightQuantized:
+    """Stages 1-2 of the quantized allreduce: quantize locally and issue
+    the wire-format reduce-scatter (the bandwidth-heavy ``all_to_all``
+    hop).  Returns an :class:`InflightQuantized` handle for
+    :func:`quantized_allreduce_finish`; ``finish(start(x))`` is the
+    exact program :func:`quantized_allreduce_flat` traces."""
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             f"quantized allreduce supports SUM/AVERAGE, got {op}")
@@ -123,6 +150,21 @@ def quantized_allreduce_flat(flat, axis="dp",
                             tiled=True)
     s_recv = lax.all_to_all(s_rows, ax, split_axis=0, concat_axis=0,
                             tiled=True)
+    return InflightQuantized(q_recv=q_recv, s_recv=s_recv, axis=ax, op=op,
+                             block=block, n=n, shard=shard, total=total,
+                             size=size, dtype=dtype)
+
+
+def quantized_allreduce_finish(inflight: InflightQuantized,
+                               postscale_factor: float = 1.0):
+    """Stages 3-5 of the quantized allreduce: dequantize-accumulate this
+    rank's shard, requantize, reassemble in wire format, final
+    dequantize.  Inverse bookend of :func:`quantized_allreduce_start`."""
+    ax, op = inflight.axis, inflight.op
+    block, n = inflight.block, inflight.n
+    shard, total, size = inflight.shard, inflight.total, inflight.size
+    dtype = inflight.dtype
+    q_recv, s_recv = inflight.q_recv, inflight.s_recv
 
     # Stage 3: dequantize-accumulate this rank's shard in f32.
     contrib = (q_recv.reshape(n, shard // block, block).astype(jnp.float32)
@@ -156,6 +198,28 @@ def quantized_allreduce_flat(flat, axis="dp",
     if total != size:
         out = out[:size]
     return out.astype(dtype)
+
+
+def quantized_allreduce_flat(flat, axis="dp",
+                             op: ReduceOp = ReduceOp.AVERAGE,
+                             block_size: Optional[int] = None,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0):
+    """Allreduce one flat float vector over ``axis`` with the int8 wire
+    (the bucket-level primitive ``fused_allreduce`` routes to).  Valid
+    inside shard_map where ``axis`` is bound; SUM/AVERAGE only (MIN/MAX
+    etc. have no meaningful block-rescaled accumulation).  Returns the
+    reduced vector in the input dtype, replicated across ``axis``.
+
+    Composition of :func:`quantized_allreduce_start` (quantize + wire
+    reduce-scatter) and :func:`quantized_allreduce_finish`
+    (dequant-accumulate + requantize + reassembly) — split so the
+    overlap scheduler can pipeline bucket N's finish under bucket N+1's
+    wire phase; calling this traces the identical monolithic program."""
+    return quantized_allreduce_finish(
+        quantized_allreduce_start(flat, axis, op, block_size,
+                                  prescale_factor),
+        postscale_factor)
 
 
 def quantized_allreduce(tree, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,

@@ -81,6 +81,10 @@ def _bool_env(v) -> str:
     return "1" if v else "0"
 
 
+def _on_off_env(v) -> str:
+    return "on" if v else "off"
+
+
 KNOB_FLAGS: List[_Flag] = [
     # --- params (ref: config_parser.py set_args_from_config 'params') ---
     _Flag("--fusion-threshold-mb", "fusion_threshold_mb",
@@ -93,6 +97,16 @@ KNOB_FLAGS: List[_Flag] = [
     _Flag("--cache-capacity", "cache_capacity", "HVDT_CACHE_CAPACITY",
           "params", "cache_capacity",
           "Response-cache capacity.", type=int),
+    _Flag("--overlap", "overlap", "HVDT_OVERLAP", "params", "overlap",
+          "Overlapped gradient exchange on every worker (ops/overlap.py):"
+          " reverse-topological bucket schedule with collectives issued "
+          "as each segment's grads exist, pipelined int8 wire, fused-"
+          "update latency hiding.", is_bool=True, to_env=_on_off_env),
+    _Flag("--xla-latency-hiding", "xla_latency_hiding",
+          "HVDT_XLA_LATENCY_HIDING", "params", "xla_latency_hiding",
+          "XLA latency-hiding / async-collective-fusion flags "
+          "(auto|on|off; ridden via LIBTPU_INIT_ARGS, engaged in "
+          "hvd.init())."),
     # --- autotune ---
     _Flag("--autotune", "autotune", "HVDT_AUTOTUNE", "autotune", "enabled",
           "Enable Bayesian autotuning of fusion knobs.", is_bool=True,

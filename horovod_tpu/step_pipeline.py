@@ -32,7 +32,7 @@ from typing import Optional
 from .common import config
 from .common.logging_util import get_logger
 
-__all__ = ["enable_compilation_cache", "donated_step"]
+__all__ = ["enable_compilation_cache", "donated_step", "overlap_step"]
 
 log = get_logger(__name__)
 
@@ -109,3 +109,72 @@ def donated_step(fn, *, donate_argnums=(0, 1), compile_cache=None,
     enable_compilation_cache(compile_cache)
     return wrap_step(jax.jit(fn, donate_argnums=donate_argnums,
                              **jit_kwargs))
+
+
+class _OverlapStep:
+    """The :func:`overlap_step` handle: calls forward to the (donated,
+    cache-engaged) jitted step; :meth:`run` drives a whole batch stream
+    with double-buffered host→device input."""
+
+    __slots__ = ("_fn", "_prefetch", "_sharding", "_put")
+
+    def __init__(self, fn, prefetch: int, sharding, put):
+        self._fn = fn
+        self._prefetch = prefetch
+        self._sharding = sharding
+        self._put = put
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def run(self, state, batches):
+        """Drive the step over ``batches`` with ``prefetch_size`` device
+        batches in flight: batch N+1's h2d transfer (sharding-aware,
+        data/loader.prefetch_to_device) rides under step N's compute.
+
+        ``state`` is the tuple of donated leading arguments (e.g.
+        ``(params, opt_state)``); each batch is appended as trailing
+        argument(s) — a tuple/list batch is splatted.  The step must
+        return the next state tuple.  Returns the final state; the
+        prefetch generator is closed (queued device buffers dropped)
+        even when the loop exits early via an exception.
+        """
+        from .data.loader import prefetch_to_device
+
+        state = tuple(state)
+        it = prefetch_to_device(batches, size=self._prefetch,
+                                sharding=self._sharding, put=self._put)
+        try:
+            for batch in it:
+                args = (tuple(batch) if isinstance(batch, (tuple, list))
+                        else (batch,))
+                out = self._fn(*state, *args)
+                state = out if isinstance(out, tuple) else (out,)
+        finally:
+            it.close()
+        return state
+
+
+def overlap_step(fn, *, donate_argnums=(0, 1), prefetch_size: int = 2,
+                 sharding=None, put=None, compile_cache=None,
+                 **jit_kwargs) -> _OverlapStep:
+    """:func:`donated_step` plus double-buffered host→device input — the
+    input half of the overlap scheduling layer (ops/overlap.py is the
+    collective half).
+
+    Returns an :class:`_OverlapStep`: call it exactly like the jitted
+    step (``.lower()``, attributes, static args all forward), or use
+    ``.run(state, batches)`` to drive a whole stream with batch N+1's
+    transfer riding under step N.  ``sharding`` may be a single Sharding
+    or a pytree of shardings matching each batch (per-leaf placement);
+    ``put`` overrides the transfer fn entirely.
+    """
+    if prefetch_size < 1:
+        raise ValueError(
+            f"overlap_step needs prefetch_size >= 1 (got {prefetch_size})")
+    step = donated_step(fn, donate_argnums=donate_argnums,
+                        compile_cache=compile_cache, **jit_kwargs)
+    return _OverlapStep(step, prefetch_size, sharding, put)

@@ -87,6 +87,19 @@ class CollectiveRecorder:
             "donated_step call duration (async dispatch interval, not "
             "device step time — see hvdt_step_time_seconds for the "
             "host-fenced number)")
+        self._overlap_hidden = reg.counter(
+            "hvdt_overlap_hidden_bytes_total",
+            "Collective bytes issued with compute still scheduled under "
+            "their flight window by the overlap scheduler (ops/overlap)")
+        self._overlap_total = reg.counter(
+            "hvdt_overlap_bytes_total",
+            "Total collective bytes scheduled by the overlap scheduler")
+        self._overlap_fraction = reg.gauge(
+            "hvdt_overlap_fraction",
+            "Hidden ÷ total collective bytes across overlapped exchange "
+            "schedules (byte-weighted proxy for collective-seconds "
+            "hidden ÷ total; recorded at trace time, path=jit "
+            "convention)")
 
     # -- collectives --------------------------------------------------------
     def record_collective(self, op: str, dtype: str, wire: str,
@@ -108,6 +121,17 @@ class CollectiveRecorder:
 
     def observe_fusion_fill(self, ratio: float) -> None:
         self._fusion_fill.observe(ratio)
+
+    def observe_overlap(self, hidden_bytes: float,
+                        total_bytes: float) -> None:
+        """One overlapped exchange schedule's byte accounting; the gauge
+        tracks the cumulative hidden/total ratio."""
+        self._overlap_hidden.inc(float(hidden_bytes))
+        self._overlap_total.inc(float(total_bytes))
+        total = self._overlap_total.value()
+        if total > 0:
+            self._overlap_fraction.set(
+                self._overlap_hidden.value() / total)
 
     def observe_step_dispatch(self, seconds: float) -> None:
         self._step_dispatch.observe(seconds)

@@ -49,7 +49,7 @@ def _save_last_good(line: str) -> None:
             return
         if d.get("steps_per_call") or d.get("fused_optimizer") \
                 or d.get("fault_plan") or d.get("telemetry") \
-                or d.get("overlap"):
+                or d.get("overlap") or d.get("transport"):
             # A/B probe variants, chaos runs, and telemetry-instrumented
             # runs are not the headline metric — caching one would
             # contaminate the outage-fallback evidence (telemetry adds
@@ -112,6 +112,16 @@ def _parse_args(argv=None):
                          "(overlap_fraction / overlap_schedule).  Kept "
                          "out of the last-good headline cache until a "
                          "real TPU run lands.")
+    ap.add_argument("--transport", default="",
+                    help="A/B leg: run the train step under an "
+                         "HVDT_TRANSPORT policy (horovod_tpu/transport) "
+                         "on a two-level ('dcn','ici') mesh so gradient "
+                         "exchange goes hierarchical (fast-axis "
+                         "reduce-scatter -> slow-axis shard exchange -> "
+                         "allgather).  Pass a policy spec like "
+                         "'ici:ring:f32:8M,dcn:tree:int8:8M' or 'auto'. "
+                         "Recorded in the JSON outside the last-good "
+                         "headline cache.")
     ap.add_argument("--serve", action="store_true",
                     help="Serving micro-benchmark instead of training: "
                          "an in-process ModelServer (MLP, shape-bucketed "
@@ -248,6 +258,15 @@ def _run_child(args) -> None:
         os.environ.setdefault("HVDT_TELEMETRY", "1")
         os.environ.setdefault("HVDT_FUSION_THRESHOLD",
                               str(8 * 1024 * 1024))
+    if args.transport:
+        # Transport leg: the policy routes the gradient exchange
+        # through the hierarchical allreduce on the two-level mesh
+        # below; telemetry on so the per-axis hvdt_wire_bytes_total
+        # counters land in the JSON.
+        os.environ["HVDT_TRANSPORT"] = args.transport
+        os.environ.setdefault("HVDT_TELEMETRY", "1")
+        os.environ.setdefault("HVDT_FUSION_THRESHOLD",
+                              str(8 * 1024 * 1024))
 
     dev = jax.devices()[0]
     print(f"benchmarking on {dev.platform}:{dev.device_kind}"
@@ -276,14 +295,15 @@ def _run_child(args) -> None:
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
 
-    if args.overlap:
-        # Overlap A/B leg: run the step inside a dp-axis shard_map so the
-        # gradient exchange actually exists (single-chip runs bind a
-        # 1-device axis; the schedule, barriers and accounting are the
-        # same program that runs multi-chip), routed through the overlap
-        # scheduler via HVDT_OVERLAP=on.  A smaller default fusion
-        # threshold guarantees a multi-bucket schedule on the ~100 MB
-        # ResNet-50 gradient pytree so overlap_fraction is meaningful.
+    if args.overlap or args.transport:
+        # Overlap / transport A/B legs: run the step inside a mesh-bound
+        # shard_map so the gradient exchange actually exists (single-chip
+        # runs bind a 1-device axis; the schedule, barriers and
+        # accounting are the same program that runs multi-chip).  The
+        # transport leg splits the devices into a two-level
+        # ('dcn', 'ici') mesh so the policy resolves hierarchically; a
+        # smaller default fusion threshold guarantees a multi-bucket
+        # schedule on the ~100 MB ResNet-50 gradient pytree.
         import inspect
 
         from jax.sharding import Mesh, PartitionSpec as P
@@ -301,10 +321,24 @@ def _run_child(args) -> None:
         ndev = len(jax.devices())
         if ndev < 1 or args.batch_size % ndev:
             ndev = 1    # batch must split evenly over the dp axis
-        mesh = Mesh(np.asarray(jax.devices()[:ndev], dtype=object), ("dp",))
-        print(f"overlap leg: dp mesh over {ndev} device(s), "
-              f"HVDT_OVERLAP={os.environ.get('HVDT_OVERLAP')!r}",
-              file=sys.stderr)
+        if args.transport and ndev >= 4 and ndev % 2 == 0:
+            mesh = Mesh(np.asarray(jax.devices()[:ndev],
+                                   dtype=object).reshape(2, ndev // 2),
+                        ("dcn", "ici"))
+            grad_axis = ("dcn", "ici")
+            print(f"transport leg: 2x{ndev // 2} ('dcn','ici') mesh, "
+                  f"HVDT_TRANSPORT={os.environ.get('HVDT_TRANSPORT')!r}",
+                  file=sys.stderr)
+        else:
+            mesh = Mesh(np.asarray(jax.devices()[:ndev], dtype=object),
+                        ("dp",))
+            grad_axis = "dp"
+            print(f"overlap leg: dp mesh over {ndev} device(s), "
+                  f"HVDT_OVERLAP={os.environ.get('HVDT_OVERLAP')!r} "
+                  f"HVDT_TRANSPORT="
+                  f"{os.environ.get('HVDT_TRANSPORT')!r}",
+                  file=sys.stderr)
+        batch_spec = P(grad_axis)
         _smap_kw = {}
         _sig = inspect.signature(shard_map).parameters
         if "check_rep" in _sig:
@@ -317,17 +351,18 @@ def _run_child(args) -> None:
                 (loss, new_stats), grads = jax.value_and_grad(
                     resnet_loss, has_aux=True)(params, stats, images,
                                                labels, cfg)
-                grads = hvd_opt.allreduce_gradients(grads, axis="dp")
-                new_stats = hvd_dev.allreduce(new_stats, "dp",
+                grads = hvd_opt.allreduce_gradients(grads, axis=grad_axis)
+                new_stats = hvd_dev.allreduce(new_stats, grad_axis,
                                               ReduceOp.AVERAGE)
-                loss = hvd_dev.allreduce(loss, "dp", ReduceOp.AVERAGE)
+                loss = hvd_dev.allreduce(loss, grad_axis,
+                                         ReduceOp.AVERAGE)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), new_stats,
                         opt_state, loss)
 
             return shard_map(
                 body, mesh=mesh,
-                in_specs=(P(), P(), P(), P("dp"), P("dp")),
+                in_specs=(P(), P(), P(), batch_spec, batch_spec),
                 out_specs=(P(), P(), P(), P()), **_smap_kw)(
                     params, stats, opt_state, images, labels)
 
@@ -550,6 +585,7 @@ def _run_child(args) -> None:
         "flops_pre_rescale": flops_pre_rescale,
         **({"compile_cache": cache_dir} if cache_dir else {}),
         **(_overlap_doc() if args.overlap else {}),
+        **(_transport_doc(args.transport) if args.transport else {}),
         **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
            if args.steps_per_call != 1 else {}),
@@ -585,6 +621,29 @@ def _overlap_doc() -> dict:
     return {"overlap": True,
             "overlap_fraction": fraction,
             "overlap_schedule": _ovl.last_schedule()}
+
+
+def _transport_doc(spec: str) -> dict:
+    """The --transport leg's JSON fields: the resolved policy and the
+    per-axis wire-byte counters (the hierarchical-savings evidence).
+    Rides outside the last-good headline cache (see _save_last_good)."""
+    from horovod_tpu.telemetry.instrument import get_recorder
+    from horovod_tpu.transport import get_policy
+
+    pol = get_policy()
+    doc = {"transport": spec,
+           "transport_policy": pol.describe() if pol else None}
+    rec = get_recorder()
+    if rec is not None:
+        try:
+            wb = rec.registry.get("hvdt_wire_bytes_total")
+            if wb is not None:
+                doc["wire_bytes_by_axis"] = {
+                    ",".join(f"{k}={v}" for k, v in key): val
+                    for key, val in sorted(wb._values.items())}
+        except Exception:
+            pass
+    return doc
 
 
 def _profiled_hbm_util(compiled, params, stats, opt_state, images,
@@ -682,7 +741,8 @@ def main() -> None:
             "--num-warmup", str(args.num_warmup),
             "--steps-per-call", str(args.steps_per_call)] \
         + (["--fused-optimizer"] if args.fused_optimizer else []) \
-        + (["--overlap"] if args.overlap else [])
+        + (["--overlap"] if args.overlap else []) \
+        + (["--transport", args.transport] if args.transport else [])
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

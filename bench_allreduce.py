@@ -27,6 +27,16 @@ the f32 leg and report ``speedup_vs_f32``; ``--json-out FILE`` writes
 the sweep (bytes_on_wire, GB/s, speedup) as a JSON result file for the
 BENCH trajectory, like bench.py does.
 
+``--hierarchical`` measures the transport-policy data plane
+(horovod_tpu/transport) on a two-level (outer × inner) mesh: per size
+it times the flat psum over both axes, the hierarchical allreduce under
+``--transport`` (default ``auto``), and each tier in isolation —
+emitting one row per (axis, algorithm, wire, size) plus a measured
+``hierarchical_speedup_vs_flat`` column.  The summary's
+``hierarchical_speedup_vs_flat_at_peak`` is what
+``HVDT_AUTOTUNE_TRANSPORT_SEED`` reads to seed the autotuner's
+transport dimension — policies are measured, not guessed.
+
 Runs anywhere: 8-device CPU sim for correctness/CI, a TPU slice for real
 numbers.  Prints one human line per size and a final JSON summary line.
 """
@@ -130,6 +140,182 @@ def bench_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
     return min(times)
 
 
+def _build_mesh2d(outer: int):
+    """(outer × inner) mesh with ('dcn', 'ici') axes — the two-level
+    topology the hierarchical sweep measures (outer = the slow tier)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = len(devs)
+    if outer < 2 or n % outer:
+        outer = 2 if (n >= 4 and n % 2 == 0) else 0
+    if not outer:
+        raise SystemExit(
+            f"--hierarchical needs an even device count >= 4 to split "
+            f"into (outer, inner); have {n}")
+    return Mesh(np.asarray(devs, dtype=object).reshape(outer, n // outer),
+                ("dcn", "ici"))
+
+
+def bench_hier_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
+                   warmup: int, leg: str):
+    """Per-op seconds for one leg of the hierarchical sweep on the
+    ('dcn', 'ici') mesh: ``flat`` = psum over both axes, ``hier`` = the
+    transport-policy hierarchical allreduce, ``ici``/``dcn`` = one tier
+    in isolation (fast reduce-scatter+allgather / slow shard
+    exchange)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.common.types import ReduceOp
+    from horovod_tpu.ops import device as hdev
+
+    n_dcn, n_ici = (mesh.devices.shape[0], mesh.devices.shape[1])
+    n = n_dcn * n_ici
+    count = max(n_ici, nbytes // jnp.dtype(dtype).itemsize)
+    count -= count % n_ici      # shard evenly over the fast tier
+    if leg == "dcn":
+        count //= n_ici         # the slow tier moves the 1/n_ici shard
+    x = jax.device_put(jnp.ones((n, count), dtype),
+                       NamedSharding(mesh, P(("dcn", "ici"))))
+    pcast = getattr(lax, "pcast", None)
+
+    def body(xl):
+        def one(_, acc):
+            if leg == "flat":
+                red = lax.psum(acc, ("dcn", "ici")) * (1.0 / n)
+            elif leg == "hier":
+                # fused_allreduce resolves the HVDT_TRANSPORT policy at
+                # trace time and routes hierarchically.
+                red = hdev.fused_allreduce(
+                    [acc.reshape(-1)], ("dcn", "ici"),
+                    ReduceOp.AVERAGE)[0].reshape(acc.shape)
+            elif leg == "ici":
+                shard = lax.psum_scatter(acc.reshape(-1), "ici",
+                                         tiled=True)
+                red = hdev.invariant_allgather_shards(
+                    shard, "ici").reshape(acc.shape) * (1.0 / n_ici)
+            else:   # dcn: the slow shard exchange in isolation
+                red = lax.psum(acc, "dcn") * (1.0 / n_dcn)
+            return (pcast(red, ("dcn", "ici"), to="varying")
+                    if pcast is not None else red)
+
+        return lax.fori_loop(0, inner, one, xl)
+
+    f = jax.jit(_shard_map()(body, mesh=mesh,
+                             in_specs=P(("dcn", "ici")),
+                             out_specs=P(("dcn", "ici"))))
+
+    def run_and_wait():
+        float(jnp.sum(f(x)[..., :1].astype(jnp.float32)))
+
+    for _ in range(warmup):
+        run_and_wait()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_and_wait()
+        times.append((time.perf_counter() - t0) / inner)
+    return min(times)
+
+
+def _run_hierarchical(args) -> None:
+    """--hierarchical: the per-(axis, algorithm, wire, size) sweep of
+    the transport-policy data plane, with the measured
+    hierarchical-vs-flat verdict the autotune transport dimension
+    seeds from."""
+    import os
+
+    os.environ.setdefault("HVDT_TRANSPORT", args.transport or "auto")
+
+    import jax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.quant import wire_bytes as q_wire_bytes
+    from horovod_tpu.transport import get_policy
+
+    hvd.init()
+    mesh = _build_mesh2d(args.outer)
+    n_dcn, n_ici = mesh.devices.shape
+    pol = get_policy()
+    res = pol.resolve(("dcn", "ici"))
+    dev0 = jax.devices()[0]
+    item = 4 if args.dtype == "float32" else 2
+    print(f"# hierarchical allreduce sweep on {n_dcn}x{n_ici} "
+          f"{dev0.platform}:{dev0.device_kind} policy={pol.describe()}",
+          file=sys.stderr)
+
+    def _wire_item(wire):
+        return {"bf16": 2, "fp16": 2}.get(wire, item)
+
+    rows = []
+    size = args.min_bytes
+    while size <= args.max_bytes:
+        count = max(n_ici, size // item)
+        count -= count % n_ici
+        shard = count // n_ici
+        t = {leg: bench_hier_jit(mesh, size, args.dtype, args.inner,
+                                 args.iters, args.warmup, leg)
+             for leg in ("flat", "hier", "ici", "dcn")}
+        # Per-tier ring wire accounting: RS+AG over ici moves
+        # 2(k-1)/k of the payload; the slow tier exchanges the 1/k
+        # shard (int8: payload + block scales via quant.wire_bytes).
+        ici_wire = 2 * count * _wire_item(res.fast.wire) \
+            * (n_ici - 1) // n_ici
+        if res.slow.wire == "int8":
+            dcn_wire = int(q_wire_bytes(shard))
+        else:
+            dcn_wire = 2 * shard * _wire_item(res.slow.wire) \
+                * (n_dcn - 1) // max(1, n_dcn)
+        speedup = t["flat"] / t["hier"] if t["hier"] > 0 else None
+        rows.extend([
+            {"bytes": size, "axis": "ici",
+             "algorithm": res.fast.algorithm, "wire": res.fast.wire,
+             "us": t["ici"] * 1e6, "bytes_on_wire": ici_wire,
+             "wire_gbps": ici_wire / t["ici"] / 1e9},
+            {"bytes": size, "axis": "dcn",
+             "algorithm": res.slow.algorithm, "wire": res.slow.wire,
+             "us": t["dcn"] * 1e6, "bytes_on_wire": dcn_wire,
+             "wire_gbps": dcn_wire / t["dcn"] / 1e9},
+            {"bytes": size, "axis": "ici+dcn",
+             "algorithm": "hierarchical",
+             "wire": f"{res.fast.wire}/{res.slow.wire}",
+             "us": t["hier"] * 1e6, "flat_us": t["flat"] * 1e6,
+             "bytes_on_wire": ici_wire + dcn_wire,
+             "jit_algbw_gbps": size / t["hier"] / 1e9,
+             "hierarchical_speedup_vs_flat": speedup},
+        ])
+        print(f"{_fmt_bytes(size):>8}  flat {t['flat']*1e6:>9.1f}us  "
+              f"hier {t['hier']*1e6:>9.1f}us  speedup {speedup:>5.2f}x  "
+              f"(ici {t['ici']*1e6:.1f}us dcn {t['dcn']*1e6:.1f}us)",
+              file=sys.stderr)
+        size *= 4
+
+    hier_rows = [r for r in rows if r["axis"] == "ici+dcn"]
+    peak = max(hier_rows, key=lambda r: r["jit_algbw_gbps"])
+    summary = {
+        "metric": "allreduce_hierarchical_sweep",
+        "value": round(peak["hierarchical_speedup_vs_flat"], 3),
+        "unit": "speedup_vs_flat",
+        "n_devices": int(n_dcn * n_ici),
+        "mesh": {"dcn": int(n_dcn), "ici": int(n_ici)},
+        "platform": dev0.platform,
+        "transport": os.environ.get("HVDT_TRANSPORT", ""),
+        "at_bytes": peak["bytes"],
+        "hierarchical_speedup_vs_flat_at_peak": round(
+            peak["hierarchical_speedup_vs_flat"], 3),
+        "rows": rows,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
 def bench_eager(hvd, nbytes: int, dtype, iters: int, warmup: int):
     """Per-op seconds for the negotiated eager allreduce path."""
     import numpy as np
@@ -213,7 +399,21 @@ def main() -> None:
                          "f32 leg for speedup_vs_f32)")
     ap.add_argument("--json-out", default="",
                     help="also write the sweep JSON to this file "
-                         "(bytes_on_wire / GB/s / speedup_vs_f32 rows)")
+                         "(axis / algorithm / bytes_on_wire / GB/s / "
+                         "speedup rows)")
+    ap.add_argument("--hierarchical", action="store_true",
+                    help="two-level transport-policy sweep on an "
+                         "(outer x inner) mesh: per-(axis, algorithm, "
+                         "wire, size) rows + measured "
+                         "hierarchical_speedup_vs_flat (the "
+                         "HVDT_AUTOTUNE_TRANSPORT_SEED input)")
+    ap.add_argument("--transport", default="",
+                    help="HVDT_TRANSPORT policy spec for the "
+                         "hierarchical sweep (e.g. 'ici:ring:f32:64M,"
+                         "dcn:tree:int8:8M'; default 'auto')")
+    ap.add_argument("--outer", type=int, default=2,
+                    help="slow-axis (dcn) size for --hierarchical; "
+                         "must divide the device count")
     ap.add_argument("--np", type=int, default=0,
                     help="measure the eager path across N real worker "
                          "processes (launched via the programmatic runner)")
@@ -221,6 +421,9 @@ def main() -> None:
 
     if args.np > 1:
         _run_eager_multiproc(args)
+        return
+    if args.hierarchical or args.transport:
+        _run_hierarchical(args)
         return
 
     import jax
@@ -246,6 +449,7 @@ def main() -> None:
         row = {"bytes": size, "jit_algbw_gbps": size / t_jit / 1e9,
                "jit_busbw_gbps": size / t_jit * factor / 1e9,
                "jit_us": t_jit * 1e6,
+               "axis": "dp", "algorithm": "flat",
                "wire": args.wire, "bytes_on_wire": on_wire,
                "wire_gbps": on_wire / t_jit / 1e9}
         if args.wire != "f32":

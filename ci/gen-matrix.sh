@@ -6,8 +6,9 @@
 #   ci/gen-matrix.sh --smoke   emit only the fast smoke service
 #       (compileall + optimizer-kernel + serving-subsystem +
 #       quantized-collective + resilience-chaos + telemetry +
-#       tracing/flight-recorder-forensics + overlap-scheduling tests
-#       on CPU) — the pre-merge gate.
+#       tracing/flight-recorder-forensics + overlap-scheduling +
+#       transport-policy/hierarchical-collective tests on CPU) —
+#       the pre-merge gate.
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

@@ -165,6 +165,7 @@ class ParameterManager:
     FUSED_OPTIMIZER_CANDIDATES = (0.0, 1.0)
     QUANT_CANDIDATES = (0.0, 1.0)
     OVERLAP_SCHEDULE_CANDIDATES = (0.0, 1.0)
+    TRANSPORT_CANDIDATES = (0.0, 1.0)
 
     def __init__(self,
                  warmup_samples: Optional[int] = None,
@@ -174,7 +175,8 @@ class ParameterManager:
                  noise: Optional[float] = None,
                  tune_fused_optimizer: Optional[bool] = None,
                  tune_quant: Optional[bool] = None,
-                 tune_overlap: Optional[bool] = None):
+                 tune_overlap: Optional[bool] = None,
+                 tune_transport: Optional[bool] = None):
         self.warmup = (warmup_samples if warmup_samples is not None
                        else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
         self.steps_per_sample = (
@@ -207,13 +209,29 @@ class ParameterManager:
         # lowering, never state), so the hot swap is a re-jit only.
         self.tune_overlap = (tune_overlap if tune_overlap is not None
                              else config.get_bool("HVDT_AUTOTUNE_OVERLAP"))
+        # Optional sixth dimension: flat-vs-hierarchical transport
+        # (horovod_tpu/transport) — whether the two-level fast-axis/
+        # slow-axis schedule beats the flat collective depends on the
+        # bucketing and wire already searched, so the GP prices the
+        # policy jointly.  Both legs keep one optimizer state tree (the
+        # policy changes lowering, never state), so the hot swap is a
+        # re-jit only.  The starting leg is MEASURED when
+        # HVDT_AUTOTUNE_TRANSPORT_SEED points at a bench_allreduce
+        # sweep (hierarchical_speedup_vs_flat_at_peak > 1).
+        self.tune_transport = (
+            tune_transport if tune_transport is not None
+            else config.get_bool("HVDT_AUTOTUNE_TRANSPORT"))
         # Column layout: [log2_bucket, overlap] (+fused) (+quant)
-        # (+overlap_schedule).
+        # (+overlap_schedule) (+transport).
         self._quant_col = (2 + int(self.tune_fused)) if self.tune_quant \
             else None
         self._overlap_col = (
             2 + int(self.tune_fused) + int(self.tune_quant)
         ) if self.tune_overlap else None
+        self._transport_col = (
+            2 + int(self.tune_fused) + int(self.tune_quant)
+            + int(self.tune_overlap)
+        ) if self.tune_transport else None
         import itertools
 
         dims = [self.LOG2_BUCKET_CANDIDATES, self.OVERLAP_CANDIDATES]
@@ -223,6 +241,8 @@ class ParameterManager:
             dims.append(self.QUANT_CANDIDATES)
         if self.tune_overlap:
             dims.append(self.OVERLAP_SCHEDULE_CANDIDATES)
+        if self.tune_transport:
+            dims.append(self.TRANSPORT_CANDIDATES)
         grid = np.array(list(itertools.product(*dims)), float)
         self._bo = BayesianOptimizer(grid, noise=noise)
         start = [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0]
@@ -232,6 +252,8 @@ class ParameterManager:
             start.append(float(_env_quant_wire()))
         if self.tune_overlap:
             start.append(float(_env_overlap()))
+        if self.tune_transport:
+            start.append(float(_env_transport()))
         self._current = np.array(start)
         self._sample = _Sample(self._current)
         self._samples_done = 0
@@ -271,6 +293,15 @@ class ParameterManager:
         if self.tune_overlap:
             return bool(self._current[self._overlap_col] >= 0.5)
         return _env_overlap()
+
+    @property
+    def transport_policy(self) -> bool:
+        """Current flat-vs-hierarchical transport choice; outside the
+        tuned dimension it reports the HVDT_TRANSPORT / seed-file env
+        default."""
+        if self.tune_transport:
+            return bool(self._current[self._transport_col] >= 0.5)
+        return _env_transport()
 
     @property
     def tuning_complete(self) -> bool:
@@ -338,6 +369,31 @@ def _env_overlap() -> bool:
     from .ops.overlap import enabled
 
     return enabled()
+
+
+def _env_transport() -> bool:
+    """The environment's flat-vs-hierarchical default (the transport
+    dimension's starting leg): HVDT_TRANSPORT set, else the MEASURED
+    verdict of a bench_allreduce sweep named by
+    HVDT_AUTOTUNE_TRANSPORT_SEED (hierarchical_speedup_vs_flat_at_peak
+    > 1 ⇒ start hierarchical) — the policies-are-measured loop."""
+    from .transport import enabled
+
+    if enabled():
+        return True
+    seed = config.get_str("HVDT_AUTOTUNE_TRANSPORT_SEED").strip()
+    if not seed:
+        return False
+    import json
+
+    try:
+        with open(seed) as fh:
+            doc = json.load(fh)
+        return float(doc.get("hierarchical_speedup_vs_flat_at_peak",
+                             0.0)) > 1.0
+    except (OSError, ValueError, TypeError) as e:
+        log.warning("transport autotune seed %s unreadable: %s", seed, e)
+        return False
 
 
 class BenchmarkAutotuner:
@@ -427,8 +483,10 @@ class BenchmarkAutotuner:
                  if self.pm.tune_quant else "")
         ovl = (f" schedule={'overlap' if self.pm.overlap_schedule else 'mono'}"
                if self.pm.tune_overlap else "")
+        tr = (f" transport={'hier' if self.pm.transport_policy else 'flat'}"
+              if self.pm.tune_transport else "")
         return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
-                f"overlap={self.pm.overlap_buckets}{fused}{quant}{ovl} "
+                f"overlap={self.pm.overlap_buckets}{fused}{quant}{ovl}{tr} "
                 f"({self.pm._samples_done} samples)")
 
 
@@ -487,6 +545,15 @@ class AutotunedStep:
     back to a previously compiled program without re-jitting;
     tests/test_overlap.py pins the contract).
 
+    With ``HVDT_AUTOTUNE_TRANSPORT=1`` the space gains a
+    flat-vs-hierarchical transport dimension (horovod_tpu/transport):
+    builders accepting a ``transport`` keyword are rebuilt as
+    ``builder(threshold_bytes, transport=bool)`` — same
+    one-state-tree hot-swap contract (the policy changes lowering,
+    never state; tests/test_transport.py pins it), with the STARTING
+    leg seeded from ``HVDT_TRANSPORT`` or the measured
+    ``HVDT_AUTOTUNE_TRANSPORT_SEED`` bench verdict.
+
     Args:
       builder: ``builder(threshold_bytes | None) -> step_callable``
         (optionally also accepting ``fused=bool``).
@@ -512,10 +579,12 @@ class AutotunedStep:
             self._accepts_fused = "fused" in sig or var_kw
             self._accepts_quant = "quant" in sig or var_kw
             self._accepts_overlap = "overlap" in sig or var_kw
+            self._accepts_transport = "transport" in sig or var_kw
         except (TypeError, ValueError):
             self._accepts_fused = False
             self._accepts_quant = False
             self._accepts_overlap = False
+            self._accepts_transport = False
         # Pin every tuned A/B dimension's starting leg at build 0 so the
         # opt-state structure established before tuning matches every
         # later rebuild (both fused legs keep one state tree —
@@ -531,6 +600,9 @@ class AutotunedStep:
         if (self.enabled and self._accepts_overlap
                 and config.get_bool("HVDT_AUTOTUNE_OVERLAP")):
             build_kw["overlap"] = _env_overlap()
+        if (self.enabled and self._accepts_transport
+                and config.get_bool("HVDT_AUTOTUNE_TRANSPORT")):
+            build_kw["transport"] = _env_transport()
         self._step = builder(None, **build_kw)
         self._tree_example = tree_example
         self._steps_per_sample = steps_per_sample
@@ -565,6 +637,8 @@ class AutotunedStep:
             kw["quant"] = pm.quant_wire
         if pm.tune_overlap and self._accepts_overlap:
             kw["overlap"] = pm.overlap_schedule
+        if pm.tune_transport and self._accepts_transport:
+            kw["transport"] = pm.transport_policy
         return self._builder(self._tuner.bucket_bytes, **kw)
 
     @staticmethod

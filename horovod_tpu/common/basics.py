@@ -212,6 +212,17 @@ def init(
             log.info("gradient wire compression from env: %s",
                      _env_comp.__name__)
 
+        # Transport-policy env selection (HVDT_TRANSPORT): parse NOW so
+        # unknown axis/algorithm/wire vocabulary or garbage thresholds
+        # fail at init with the valid lists, not at the first traced
+        # step on some worker (same idiom as HVDT_COMPRESSION above).
+        from ..transport import validate_env as _transport_validate
+
+        _env_transport = _transport_validate()
+        if _env_transport is not None:
+            log.info("transport policy from env: %s",
+                     _env_transport.describe())
+
         env_size = config.get_int("HVDT_SIZE")
         env_rank = config.get_int("HVDT_RANK")
         coord = coordinator_address or config.get_str("HVDT_COORDINATOR_ADDR")

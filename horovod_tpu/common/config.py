@@ -83,6 +83,36 @@ KNOBS: Dict[str, Knob] = {
            "because both legs keep one optimizer state tree (the "
            "schedule changes lowering, never state).  Starting point "
            "comes from HVDT_OVERLAP."),
+        # --- transport policies (horovod_tpu/transport: per-mesh-axis
+        #     algorithm / wire dtype / fusion threshold + the two-level
+        #     hierarchical allreduce) ---
+        _k("HVDT_TRANSPORT", "", str,
+           "Per-mesh-axis transport policy: comma entries "
+           "axis:algorithm:wire[:threshold] with axis in "
+           "{ici,dcn,dp,pp,fsdp,ep,sp,tp}, algorithm in "
+           "{ring,tree,2d_ring}, wire in {f32,bf16,fp16,int8}, "
+           "threshold like 64M — e.g. 'ici:ring:f32:64M,dcn:tree:int8:"
+           "8M'; 'auto' derives the topology default (innermost axis = "
+           "ICI ring f32, outer = DCN tree f32 8M).  Multi-axis reduce "
+           "groups then run the hierarchical allreduce (fast-axis "
+           "reduce-scatter -> slow-axis shard exchange -> allgather).  "
+           "Unset (default) keeps the flat path as the identical code "
+           "objects (transport.get_policy() is None, zero wrappers); "
+           "unknown vocabulary fails hvd.init() with the valid lists."),
+        _k("HVDT_AUTOTUNE_TRANSPORT", False, _parse_bool,
+           "Add a flat-vs-hierarchical transport dimension (0/1) to the "
+           "autotune search space; the step builder is rebuilt with "
+           "transport=... at each knob change (autotune.AutotunedStep), "
+           "hot-swappable because both legs keep one optimizer state "
+           "tree (the policy changes lowering, never state).  Starting "
+           "point: HVDT_TRANSPORT set, or the measured "
+           "HVDT_AUTOTUNE_TRANSPORT_SEED verdict."),
+        _k("HVDT_AUTOTUNE_TRANSPORT_SEED", "", str,
+           "Path to a bench_allreduce.py --json-out file; when its "
+           "measured hierarchical_speedup_vs_flat_at_peak exceeds 1.0 "
+           "the autotuner's transport dimension STARTS on the "
+           "hierarchical leg — policies are seeded from measurements, "
+           "not guesses."),
         # --- cache (ref: HOROVOD_CACHE_CAPACITY common.h:114) ---
         _k("HVDT_CACHE_CAPACITY", 1024, int,
            "Response-cache capacity (negotiated-collective descriptors)."),

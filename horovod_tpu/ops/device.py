@@ -485,13 +485,36 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
     through the two-stage block-scaled quantized allreduce — real int8
     payloads on the wire, f32 accumulation in the middle; non-float
     buckets keep the exact path.
+
+    Transport policies (``HVDT_TRANSPORT``, horovod_tpu/transport): when
+    the active policy resolves ``axis``, float SUM/AVERAGE buckets route
+    through the two-level hierarchical allreduce (fast-axis
+    reduce-scatter → slow-axis shard exchange → allgather) with the
+    per-axis algorithm/wire/threshold the policy names; a single-axis
+    flat resolution only overrides the wire/threshold.  Unset (the
+    default) leaves this function's program byte-identical — the policy
+    lookup is one env read at trace time.
     """
+    from ..transport import policy as _tpolicy
+
+    _res = _tpolicy.resolve_axis(axis)
+    if threshold_bytes is None and _res is not None:
+        threshold_bytes = _res.threshold_bytes
     threshold_bytes = _validated_threshold(threshold_bytes)
+
+    if _res is not None and _res.kind == "flat" and wire_dtype is None:
+        # Per-axis wire override for the single-axis flat path (the
+        # policy's exact-name / ici-class entry); an explicit caller
+        # wire (Compression) keeps precedence.
+        wire_dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16,
+                      "int8": "int8_blockwise"}.get(_res.fast.wire)
 
     quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
         "int8", "int8_blockwise")
     if quant_wire:
         wire_dtype = None  # the quantized path owns the wire format
+    hier = (_res is not None and _res.kind == "hierarchical"
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
 
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
@@ -509,6 +532,7 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
     _rec = _ti.get_recorder()
     _flight = _frm.get_flight_recorder()
 
+    _axis_label = "+".join(_axes_tuple(axis))
     out_leaves: List[Optional[jax.Array]] = [None] * len(leaves)
     for bi, bucket in enumerate(buckets):
         parts = [leaves[i] for i in bucket]
@@ -517,34 +541,46 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         flat = jnp.concatenate([jnp.ravel(p) for p in parts]) if len(parts) > 1 \
             else jnp.ravel(parts[0])
         orig_dtype = flat.dtype
-        if wire_dtype is not None and flat.dtype != wire_dtype:
+        float_bucket = jnp.issubdtype(orig_dtype, jnp.floating)
+        hier_bucket = hier and float_bucket
+        if wire_dtype is not None and flat.dtype != wire_dtype \
+                and not hier_bucket:
             flat = flat.astype(wire_dtype)
         if _rec is not None or _flight is not None:
             bucket_bytes = int(flat.size) * jnp.dtype(flat.dtype).itemsize
-            quant_bucket = (quant_wire
-                            and jnp.issubdtype(orig_dtype, jnp.floating))
+            quant_bucket = quant_wire and float_bucket
             if _rec is not None:
                 _rec.observe_fusion_fill(
                     bucket_bytes / float(threshold_bytes))
-                if not quant_bucket:
+                if not quant_bucket and not hier_bucket:
                     _rec.record_collective(
                         "allreduce", jnp.dtype(orig_dtype).name,
                         jnp.dtype(flat.dtype).name, bucket_bytes,
-                        count=len(parts), path="jit")
+                        count=len(parts), path="jit", axis=_axis_label)
             if _flight is not None and not quant_bucket:
                 # One traced event per compiled bucket program (under jit
                 # the program, not this host code, runs the collective).
                 _flight.record(
-                    op="allreduce", name=f"fused.b{bi}",
+                    op="allreduce",
+                    name=f"hier.b{bi}" if hier_bucket else f"fused.b{bi}",
                     dtype=jnp.dtype(orig_dtype).name,
                     shape=(int(flat.size),), nbytes=bucket_bytes,
-                    wire=jnp.dtype(flat.dtype).name, path="jit",
-                    count=len(parts))
+                    wire=(f"{_res.fast.wire}/{_res.slow.wire}"
+                          if hier_bucket
+                          else jnp.dtype(flat.dtype).name),
+                    path="jit", count=len(parts), axis=_axis_label)
         # Named scope per fused bucket — the jit-trace analog of the
         # reference's NVTX op ranges; buckets appear as
         # hvdt.fused_allreduce.bN in XPlane/profiler output.
         with jax.named_scope(f"hvdt.fused_allreduce.b{bi}"):
-            if quant_wire and jnp.issubdtype(orig_dtype, jnp.floating):
+            if hier_bucket:
+                from ..transport.hierarchy import hierarchical_allreduce_flat
+
+                red = hierarchical_allreduce_flat(
+                    flat, _res, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+            elif quant_wire and float_bucket:
                 from ..quant.collectives import quantized_allreduce_flat
 
                 red = quantized_allreduce_flat(

@@ -248,17 +248,26 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
     1. **issue** — every bucket's payload is concatenated, barriered
        with the previous payload's token (issue-order pin) and its
        collective started (for the int8 wire: the quantize + wire-format
-       reduce-scatter ``quantized_allreduce_start``);
+       reduce-scatter ``quantized_allreduce_start``; under a transport
+       policy: the hierarchical fast-axis reduce-scatter + slow-axis
+       wire hop ``hierarchical_allreduce_start``);
     2. **finish** — bucket k's epilogue (dequant-accumulate for the
-       quantized wire, the optimizer update when ``leaf_finish`` runs
-       one) is barriered with bucket k+1's payload, so it is scheduled
-       while k+1's collective is in flight.
+       quantized wire, slow finish + allgather for the hierarchical
+       path, the optimizer update when ``leaf_finish`` runs one) is
+       barriered with bucket k+1's payload, so it is scheduled while
+       k+1's collective is in flight.
     """
     schedule = overlap_schedule(leaves, threshold_bytes)
 
     from ..telemetry import instrument as _ti
+    from ..transport import policy as _tpolicy
 
     rec = _ti.get_recorder()
+    _res = _tpolicy.resolve_axis(axis)
+    hier = (_res is not None and _res.kind == "hierarchical"
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
+    _axis_label = "+".join((axis,) if isinstance(axis, str)
+                           else tuple(axis))
 
     issued = []   # (bucket, shapes, sizes, orig_dtype, kind, state, payload)
     bucket_bytes: List[int] = []
@@ -269,7 +278,10 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
         flat = jnp.concatenate([jnp.ravel(p) for p in parts]) \
             if len(parts) > 1 else jnp.ravel(parts[0])
         orig_dtype = flat.dtype
-        if wire_dtype is not None and flat.dtype != wire_dtype:
+        float_bucket = jnp.issubdtype(orig_dtype, jnp.floating)
+        hier_bucket = hier and float_bucket
+        if wire_dtype is not None and flat.dtype != wire_dtype \
+                and not hier_bucket:
             flat = flat.astype(wire_dtype)
         # Issue-order pin: this payload cannot be scheduled before the
         # previous bucket's payload, so collectives keep the
@@ -278,9 +290,14 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
             flat, _ = lax.optimization_barrier((flat, token))
         token = _payload_token(flat)
         nbytes = int(flat.size) * jnp.dtype(flat.dtype).itemsize
-        quant_bucket = (quant_wire
-                        and jnp.issubdtype(orig_dtype, jnp.floating))
-        if quant_bucket:
+        quant_bucket = quant_wire and float_bucket and not hier_bucket
+        if hier_bucket:
+            from ..transport import hierarchy as _th
+
+            bucket_bytes.append(_th.wire_bytes_estimate(
+                _res, int(flat.size),
+                jnp.dtype(flat.dtype).itemsize) or nbytes)
+        elif quant_bucket:
             from ..quant import kernels as _qk
 
             bucket_bytes.append(int(_qk.wire_bytes(
@@ -289,13 +306,19 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
             bucket_bytes.append(nbytes)
         if rec is not None:
             rec.observe_fusion_fill(nbytes / float(threshold_bytes))
-            if not quant_bucket:
+            if not quant_bucket and not hier_bucket:
                 rec.record_collective(
                     "allreduce", jnp.dtype(orig_dtype).name,
                     jnp.dtype(flat.dtype).name, nbytes,
-                    count=len(parts), path="jit")
+                    count=len(parts), path="jit", axis=_axis_label)
         with jax.named_scope(f"hvdt.overlap.b{bi}"):
-            if quant_bucket:
+            if hier_bucket:
+                from ..transport import hierarchy as _th
+
+                state = _th.hierarchical_allreduce_start(
+                    flat, _res, op=op, prescale_factor=prescale_factor)
+                kind = "hier"
+            elif quant_bucket:
                 from ..quant import collectives as qc
 
                 state = qc.quantized_allreduce_start(
@@ -308,14 +331,25 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
         issued.append((bucket, shapes, sizes, orig_dtype, kind, state, flat))
 
     _account(bucket_bytes,
-             wire="int8_blockwise" if quant_wire else "exact")
+             wire=("hierarchical" if hier
+                   else "int8_blockwise" if quant_wire else "exact"))
 
     cells: List[Any] = [None] * len(leaves)
     for k, (bucket, shapes, sizes, orig_dtype, kind, state, _payload) \
             in enumerate(issued):
         pin = (_payload_token(issued[k + 1][6])
                if k + 1 < len(issued) else None)
-        if kind == "quant":
+        if kind == "hier":
+            from ..transport import hierarchy as _th
+
+            # Slow finish + allgather of bucket k overlaps bucket k+1's
+            # flight window: the inflight arrays are barriered with
+            # k+1's payload, never with k+1's result.
+            state = _th.pin_inflight(state, pin)
+            with jax.named_scope(f"hvdt.overlap.b{k}.finish"):
+                red = _th.hierarchical_allreduce_finish(
+                    state, postscale_factor)
+        elif kind == "quant":
             import dataclasses as _dc
 
             from ..quant import collectives as qc
@@ -357,7 +391,10 @@ class OverlapScheduler:
         identical results for exact wires (psum is elementwise — any
         bucketing slices out the same values); the int8 wire keeps the
         established block-scale/2 bound per stage."""
-        threshold_bytes = dev._validated_threshold(threshold_bytes)
+        from ..transport import policy as _tpolicy
+
+        threshold_bytes = dev._validated_threshold(
+            _tpolicy.bucket_threshold(axis, threshold_bytes))
         quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
             "int8", "int8_blockwise")
         if quant_wire:
@@ -419,7 +456,10 @@ def overlap_value_and_grad(stage_fns: Sequence[Callable],
         if getattr(loss, "shape", ()) != ():
             raise ValueError("the last stage must return a scalar loss")
 
-        threshold = dev._validated_threshold(threshold_bytes)
+        from ..transport import policy as _tpolicy
+
+        threshold = dev._validated_threshold(
+            _tpolicy.bucket_threshold(axis, threshold_bytes))
         quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
             "int8", "int8_blockwise")
         wd = None if quant_wire else wire_dtype

@@ -32,10 +32,57 @@ AXIS_EP = "ep"
 CANONICAL_AXES: Tuple[str, ...] = (
     AXIS_DP, AXIS_PP, AXIS_FSDP, AXIS_EP, AXIS_SP, AXIS_TP)
 
+# Transport classes: which physical interconnect tier a mesh axis rides.
+# Innermost axes step between ICI neighbours (within a slice); every axis
+# outside the innermost tier is presumed to hop DCN (across slices/pods).
+# The transport-policy layer (horovod_tpu/transport) keys per-axis
+# algorithm/wire/threshold choices on these classes.
+TRANSPORT_ICI = "ici"
+TRANSPORT_DCN = "dcn"
+TRANSPORT_CLASSES: Tuple[str, ...] = (TRANSPORT_ICI, TRANSPORT_DCN)
+
 __all__ = [
     "AXIS_DP", "AXIS_FSDP", "AXIS_PP", "AXIS_TP", "AXIS_SP", "AXIS_EP",
-    "CANONICAL_AXES", "MeshSpec", "make_mesh", "mesh_shape_for",
+    "CANONICAL_AXES", "TRANSPORT_ICI", "TRANSPORT_DCN",
+    "TRANSPORT_CLASSES", "axis_transport_class", "split_transport_axes",
+    "MeshSpec", "make_mesh", "mesh_shape_for",
 ]
+
+
+def axis_transport_class(axis: str, axes: Sequence[str]) -> str:
+    """Transport tier of ``axis`` within the ordered reduce group ``axes``.
+
+    Axes follow the mesh convention (outermost first, innermost last —
+    see the module docstring): the innermost axis of a multi-axis group
+    rides ICI (neighbouring devices share the fastest links), every
+    outer axis is presumed to cross DCN.  A single-axis group is one ICI
+    domain.  This is the default classification the transport-policy
+    layer's ``ici``/``dcn`` entries key on; exact mesh-axis names
+    override it.
+    """
+    axes = tuple(axes)
+    if axis not in axes:
+        raise ValueError(f"axis {axis!r} not in reduce group {axes}")
+    if len(axes) == 1 or axis == axes[-1]:
+        return TRANSPORT_ICI
+    return TRANSPORT_DCN
+
+
+def split_transport_axes(axes: Sequence[str], fast_width: int = 1
+                         ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """Split an ordered reduce group into ``(slow_axes, fast_axes)``.
+
+    ``fast_axes`` are the ``fast_width`` innermost (ICI) axes — the tier
+    the hierarchical allreduce reduce-scatters over; ``slow_axes`` is
+    everything outside it (the DCN tier the shard exchange crosses).  At
+    least one axis always stays slow when the group has more than one
+    axis, so a two-level schedule exists whenever one is possible.
+    """
+    axes = tuple(axes)
+    if not axes:
+        raise ValueError("empty reduce group")
+    width = max(1, min(int(fast_width), len(axes) - 1 or 1))
+    return axes[:-width], axes[-width:]
 
 
 @dataclasses.dataclass(frozen=True)

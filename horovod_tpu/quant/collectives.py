@@ -125,13 +125,13 @@ def quantized_allreduce_start(flat, axis="dp",
     if _rec is not None:
         _rec.record_collective("allreduce", jnp.dtype(dtype).name,
                                INT8_WIRE, qk.wire_bytes(size, block),
-                               path="jit")
+                               path="jit", axis=ax)
     _flight = _frm.get_flight_recorder()
     if _flight is not None:
         _flight.record(op="allreduce", name="quantized.flat",
                        dtype=jnp.dtype(dtype).name, shape=(int(size),),
                        nbytes=int(qk.wire_bytes(size, block)),
-                       wire=INT8_WIRE, path="jit")
+                       wire=INT8_WIRE, path="jit", axis=ax)
 
     x = flat.astype(jnp.float32)
     if prescale_factor != 1.0:

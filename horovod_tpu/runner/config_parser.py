@@ -107,6 +107,15 @@ KNOB_FLAGS: List[_Flag] = [
           "XLA latency-hiding / async-collective-fusion flags "
           "(auto|on|off; ridden via LIBTPU_INIT_ARGS, engaged in "
           "hvd.init())."),
+    _Flag("--transport", "transport", "HVDT_TRANSPORT", "params",
+          "transport",
+          "Per-mesh-axis transport policy on every worker "
+          "(horovod_tpu/transport): axis:algorithm:wire[:threshold] "
+          "entries, e.g. 'ici:ring:f32:64M,dcn:tree:int8:8M', or "
+          "'auto' for the topology-derived default.  Multi-axis "
+          "reduce groups then run the hierarchical allreduce "
+          "(fast-axis reduce-scatter -> slow-axis shard exchange -> "
+          "allgather); workers validate the grammar in hvd.init()."),
     # --- autotune ---
     _Flag("--autotune", "autotune", "HVDT_AUTOTUNE", "autotune", "enabled",
           "Enable Bayesian autotuning of fusion knobs.", is_bool=True,

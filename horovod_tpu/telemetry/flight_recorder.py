@@ -99,7 +99,7 @@ class FlightRecorder:
     # -- recording ----------------------------------------------------------
     def _new_event(self, op: str, name: str, dtype: str, shape, nbytes: int,
                    wire: str, path: str, count: int,
-                   status: str) -> Dict[str, Any]:
+                   status: str, axis: str = "") -> Dict[str, Any]:
         ev = {
             "seq": 0,                       # assigned under the lock
             "op": str(op).lower(),
@@ -110,6 +110,11 @@ class FlightRecorder:
             "wire": str(wire) if wire else str(dtype),
             "path": str(path),
             "count": int(count),
+            # Mesh axis / tier the collective reduces over (jit paths;
+            # "" on the eager negotiated path, whose group is a process
+            # set) — lets a desync report say WHICH interconnect tier
+            # the divergent collective was crossing.
+            "axis": str(axis),
             "start_ts": time.time(),
             "end_ts": None,
             "status": status,
@@ -131,10 +136,11 @@ class FlightRecorder:
     def record_begin(self, op: str, name: str, dtype: str = "",
                      shape: Optional[Sequence[int]] = None,
                      nbytes: int = 0, wire: str = "", path: str = "eager",
-                     count: int = 1) -> int:
+                     count: int = 1, axis: str = "") -> int:
         """Open an in-flight collective event; returns its seq."""
         return self._append(self._new_event(op, name, dtype, shape, nbytes,
-                                            wire, path, count, INFLIGHT))
+                                            wire, path, count, INFLIGHT,
+                                            axis))
 
     def record_end(self, seq: Optional[int], status: str = DONE) -> None:
         """Close an in-flight event (no-op for evicted/unknown seqs)."""
@@ -149,10 +155,10 @@ class FlightRecorder:
     def record(self, op: str, name: str, dtype: str = "",
                shape: Optional[Sequence[int]] = None, nbytes: int = 0,
                wire: str = "", path: str = "jit", count: int = 1,
-               status: str = TRACED) -> int:
+               status: str = TRACED, axis: str = "") -> int:
         """One-shot event (jit trace-time buckets, external sequences)."""
         ev = self._new_event(op, name, dtype, shape, nbytes, wire, path,
-                             count, status)
+                             count, status, axis)
         ev["end_ts"] = ev["start_ts"]
         return self._append(ev)
 

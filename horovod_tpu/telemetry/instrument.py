@@ -16,8 +16,13 @@ wrapper objects and no metric lookups.  Tests identity-check both.
 
 Metric catalog (docs/observability.md has the full table):
 
-* ``hvdt_collective_bytes_total{op,dtype,wire,path}`` — bytes on wire
-* ``hvdt_collectives_total{op,dtype,wire,path}``      — collective count
+* ``hvdt_collective_bytes_total{op,dtype,wire,path[,axis]}`` — bytes on
+  wire (jit paths label the mesh axis the collective reduces over;
+  hierarchical transport records one series per tier hop)
+* ``hvdt_collectives_total{op,dtype,wire,path[,axis]}`` — collective count
+* ``hvdt_wire_bytes_total{axis,wire}`` — per-mesh-axis wire bytes (the
+  hierarchical-savings view: compare the dcn-axis series against the
+  ici-axis series on /metrics)
 * ``hvdt_collective_negotiate_seconds`` — announce → response (eager)
 * ``hvdt_collective_queue_seconds``     — enqueue → announce (eager)
 * ``hvdt_collective_execute_seconds``   — dispatch duration (eager)
@@ -68,6 +73,12 @@ class CollectiveRecorder:
         self._count = reg.counter(
             "hvdt_collectives_total",
             "Collectives recorded, labelled op/dtype/wire/path")
+        self._wire_bytes = reg.counter(
+            "hvdt_wire_bytes_total",
+            "Bytes on the wire per mesh axis (axis/wire labels) — the "
+            "per-tier view of hierarchical transport policies: int8 on "
+            "the slow dcn axis shows up as that axis's series shrinking "
+            "relative to the ici series")
         self._negotiate = reg.summary(
             "hvdt_collective_negotiate_seconds",
             "Eager-path announce -> negotiated-response latency")
@@ -104,9 +115,18 @@ class CollectiveRecorder:
     # -- collectives --------------------------------------------------------
     def record_collective(self, op: str, dtype: str, wire: str,
                           nbytes: float, count: int = 1,
-                          path: str = "eager") -> None:
+                          path: str = "eager", axis: str = "") -> None:
+        """``axis`` (when known — the jit paths pass the mesh axis/tier
+        the collective reduces over) adds an axis label to the main
+        counters AND books the per-axis ``hvdt_wire_bytes_total``
+        series; empty (eager/negotiated paths, where the reduce group
+        is a process set, not a mesh axis) keeps the legacy label set."""
         labels = dict(op=str(op).lower(), dtype=str(dtype),
                       wire=str(wire), path=path)
+        if axis:
+            labels["axis"] = str(axis)
+            self._wire_bytes.inc(float(nbytes), axis=str(axis),
+                                 wire=str(wire))
         self._bytes.inc(float(nbytes), **labels)
         self._count.inc(float(count), **labels)
 

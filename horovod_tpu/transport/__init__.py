@@ -1,0 +1,38 @@
+"""Topology-aware transport policies for collective communication.
+
+The per-mesh-axis policy layer (ROADMAP item 2): instead of one flat
+algorithm and one global wire format over the whole mesh, every mesh
+axis gets its own **transport policy** — algorithm (``ring | tree |
+2d_ring``), wire dtype (``f32 | bf16 | fp16 | int8``) and fusion
+threshold — selected by ``HVDT_TRANSPORT`` and applied by the
+hierarchical allreduce in :mod:`.hierarchy`:
+
+* reduce-scatter over the fast (ICI) axis,
+* cross-axis exchange of the 1/n shard over the slow (DCN) axis —
+  riding the block-scaled int8 wire (quant/collectives) when the slow
+  policy says so,
+* allgather back over the fast axis.
+
+Zero-wrapper contract (same idiom as telemetry/instrument and
+ops/overlap): with ``HVDT_TRANSPORT`` unset, :func:`get_policy` returns
+``None`` and every data-plane call site takes its pre-existing flat
+path untouched — ``overlap.exchange_fn()`` still resolves to
+``ops.device.fused_allreduce`` as the identical code object.
+"""
+
+from .policy import (AxisPolicy, ResolvedTransport, TransportPolicy,
+                     bucket_threshold, enabled, get_policy, parse_transport,
+                     reset, resolve_axis, validate_env)
+from .hierarchy import (InflightHierarchical, hierarchical_allreduce_finish,
+                        hierarchical_allreduce_flat,
+                        hierarchical_allreduce_start, pin_inflight,
+                        wire_bytes_estimate)
+
+__all__ = [
+    "AxisPolicy", "ResolvedTransport", "TransportPolicy",
+    "parse_transport", "get_policy", "resolve_axis", "bucket_threshold",
+    "enabled", "reset", "validate_env",
+    "InflightHierarchical", "hierarchical_allreduce_start",
+    "hierarchical_allreduce_finish", "hierarchical_allreduce_flat",
+    "pin_inflight", "wire_bytes_estimate",
+]

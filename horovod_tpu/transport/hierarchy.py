@@ -1,0 +1,341 @@
+"""Hierarchical allreduce under a transport policy — the two-level data
+plane (ref: NCCLHierarchicalAllreduce, nccl_operations.cc:249-517; the
+MLPerf-on-TPU-pods schedule: ICI reduce-scatter → DCN shard exchange →
+ICI allgather).
+
+The bucket-level primitive ``ops.device.fused_allreduce`` and the overlap
+scheduler (``ops/overlap.py``) route float buckets here when
+``HVDT_TRANSPORT`` resolves the reduce group hierarchically:
+
+1. optional fast-axis wire cast (``bf16``/``fp16`` — the established
+   cast-around-the-collective compression);
+2. **fast tier** — reduce-scatter over the innermost (ICI) axis (or the
+   two innermost under ``2d_ring``); ``tree`` skips the split and fuses
+   the whole fast reduction into one collective (latency-optimal for
+   small buckets);
+3. **slow tier** — the 1/n shard crosses the outer (DCN) axes: plain
+   psum for exact wires, or the block-scaled int8 two-stage collective
+   (``quant/collectives``) when the slow policy says ``int8`` — the
+   bandwidth-heavy cross-pod hop at ~1 B/element;
+4. allgather back over the fast tier (``invariant_allgather_shards`` —
+   the psum-family terminal op keeps the result replicated, which P()
+   out_specs and optax.MultiSteps require);
+5. single final division for AVERAGE, postscale, cast to the original
+   dtype.
+
+Split into :func:`hierarchical_allreduce_start` /
+:func:`hierarchical_allreduce_finish` (the ``quantized_allreduce_start``
+/ ``finish`` seam) so the overlap scheduler can pipeline bucket N's
+slow-tier finish + allgather under bucket N+1's flight window;
+``finish(start(x))`` is the exact program
+:func:`hierarchical_allreduce_flat` traces.
+
+Numerics: the fast/slow split only *reassociates* the cross-rank sum —
+the same values are added, grouped per tier — and AVERAGE divides the
+full sum once by the total group size exactly like the flat path, so
+f32 results differ from flat ``fused_allreduce`` by reassociation
+rounding at most (bitwise-equal on exactly-representable inputs, the
+contract tests/test_transport.py pins).  The int8 slow wire keeps the
+established per-stage block-scale/2 bound on 1/n-sized shards.
+
+jax-0.4.37 guard: only ``lax.psum``/``psum_scatter``/named-axis
+primitives — no ``jax.typeof``/``lax.pcast`` anywhere on this path;
+axis sizes resolve through the guarded ``dev._axis_size_static``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.logging_util import get_logger
+from ..common.types import ReduceOp
+from .policy import ResolvedTransport
+
+log = get_logger(__name__)
+
+__all__ = ["InflightHierarchical", "hierarchical_allreduce_start",
+           "hierarchical_allreduce_finish", "hierarchical_allreduce_flat",
+           "pin_inflight", "wire_bytes_estimate"]
+
+_WIRE_DTYPES = {"bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+
+@dataclasses.dataclass
+class InflightHierarchical:
+    """A hierarchical allreduce whose fast reduce-scatter (and, for the
+    int8 slow wire, the bandwidth-heavy slow wire hop) has been issued
+    but whose finish half has not run yet — the seam the overlap
+    scheduler pipelines across buckets.
+
+    The finish half carries the plain-wire slow psum, the int8
+    dequant-accumulate, and the fast allgather — every terminal op is
+    psum-family, so replication over the full reduce group is restored
+    AFTER any ``optimization_barrier`` pin (barriers erase replication
+    tracking; a pinned finish must re-establish it, the same design as
+    the quantized start/finish split).  ``shard`` / ``quant_state``
+    hold the traced arrays; everything else is static trace-time
+    metadata."""
+
+    res: ResolvedTransport
+    op: ReduceOp
+    n_total: int
+    size: int
+    pad: int
+    dtype: Any
+    gathered: bool                  # True when the fast tier was fused
+    slow_done: bool                 # True when no slow exchange remains
+    shard: Optional[Any] = None
+    quant_state: Optional[Any] = None   # slow tier in flight (int8 wire)
+
+
+def _record_hop(op: str, axis: str, dtype, wire: str, nbytes: int,
+                count: int = 1) -> None:
+    """Trace-time per-axis accounting (path=jit convention): the main
+    collective counters gain the axis label and the per-axis
+    ``hvdt_wire_bytes_total{axis=...}`` counter books the hop."""
+    from ..telemetry import instrument as _ti
+
+    rec = _ti.get_recorder()
+    if rec is not None:
+        rec.record_collective(op, jnp.dtype(dtype).name, wire,
+                              int(nbytes), count=count, path="jit",
+                              axis=axis)
+
+
+def _ring_bytes(size_elems: int, itemsize: int, k: int) -> int:
+    """Per-rank ring wire bytes for one data-moving hop (RS or AG) over
+    an axis of size k: (k-1)/k of the payload crosses the wire."""
+    if k <= 1:
+        return 0
+    return int(size_elems * itemsize * (k - 1) // k)
+
+
+def hierarchical_allreduce_start(flat, res: ResolvedTransport,
+                                 op: ReduceOp = ReduceOp.AVERAGE,
+                                 prescale_factor: float = 1.0
+                                 ) -> InflightHierarchical:
+    """Fast-tier reduce-scatter + slow-tier wire hop for one flat float
+    bucket.  Returns the inflight handle for
+    :func:`hierarchical_allreduce_finish`."""
+    from ..ops import device as dev
+
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"hierarchical allreduce supports SUM/AVERAGE, got {op}")
+    if res.fast.wire == "int8":
+        raise ValueError(
+            "int8 rides the slow (dcn) axis; the fast-axis "
+            "reduce-scatter leg has no int8 wire format")
+
+    dtype = flat.dtype
+    size = int(flat.shape[0])
+    n_total = 1
+    for a in res.axes:
+        n_total *= dev._axis_size_static(a)
+
+    x = flat
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype)
+    cast_to = _WIRE_DTYPES.get(res.fast.wire)
+    if cast_to is not None and x.dtype != cast_to:
+        x = x.astype(cast_to)
+
+    pad = 0
+    if res.fast.algorithm == "tree":
+        # Latency-optimal fast tier: one fused collective, no RS/AG
+        # split — the slow tier then exchanges the FULL vector (right
+        # when the bucket is small enough that launches dominate).
+        n_fast = _fast_size(res)
+        _record_hop("allreduce", "+".join(res.fast_axes), dtype,
+                    res.fast.wire,
+                    2 * _ring_bytes(size, jnp.dtype(x.dtype).itemsize,
+                                    n_fast))
+        shard = lax.psum(x, res.fast_axes)
+        gathered = True
+    else:
+        n_fast = _fast_size(res)
+        pad = (-size) % n_fast
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+        shard = x
+        remaining = size + pad
+        for a in res.fast_axes:
+            k = dev._axis_size_static(a)
+            _record_hop("reduce_scatter", a, dtype, res.fast.wire,
+                        _ring_bytes(remaining,
+                                    jnp.dtype(shard.dtype).itemsize, k))
+            shard = lax.psum_scatter(shard, a, tiled=True)
+            remaining //= k
+        gathered = False
+
+    inflight = InflightHierarchical(
+        res=res, op=op, n_total=n_total, size=size, pad=pad, dtype=dtype,
+        gathered=gathered, slow_done=not res.slow_axes, shard=shard)
+
+    if res.slow_axes and res.slow.wire == "int8":
+        # The bandwidth-heavy slow wire hop (the all_to_all carrying
+        # int8 payloads) is issued at start so the overlap scheduler
+        # can hide it; the dequant-accumulate half rides finish.
+        from ..quant.collectives import quantized_allreduce_start
+
+        inflight.quant_state = quantized_allreduce_start(
+            shard, res.slow_axes[0], op=ReduceOp.SUM)
+        inflight.shard = None
+        inflight.slow_done = True   # finish side: quant finish only
+    return inflight
+
+
+def _fast_size(res: ResolvedTransport) -> int:
+    from ..ops import device as dev
+
+    n = 1
+    for a in res.fast_axes:
+        n *= dev._axis_size_static(a)
+    return n
+
+
+def hierarchical_allreduce_finish(inflight: InflightHierarchical,
+                                  postscale_factor: float = 1.0):
+    """Slow-tier exchange/finish + fast allgather + single AVERAGE
+    division + postscale + final cast — inverse bookend of
+    :func:`hierarchical_allreduce_start`.
+
+    The plain-wire slow psum lives HERE (a bare psum has no
+    start/finish split; keeping every remaining collective psum-family
+    and after the overlap scheduler's pin barrier restores replication
+    over the full reduce group — barriers erase replication tracking).
+    """
+    from ..ops import device as dev
+
+    res = inflight.res
+    if inflight.quant_state is not None:
+        from ..quant.collectives import quantized_allreduce_finish
+
+        shard = quantized_allreduce_finish(inflight.quant_state)
+    else:
+        shard = inflight.shard
+        if not inflight.slow_done:
+            slow = res.slow
+            cast_slow = _WIRE_DTYPES.get(slow.wire)
+            hop = shard
+            if cast_slow is not None and hop.dtype != cast_slow:
+                hop = hop.astype(cast_slow)
+            n_slow = 1
+            for a in res.slow_axes:
+                n_slow *= dev._axis_size_static(a)
+            _record_hop("allreduce", "+".join(res.slow_axes),
+                        inflight.dtype, slow.wire,
+                        2 * _ring_bytes(int(shard.shape[0]),
+                                        jnp.dtype(hop.dtype).itemsize,
+                                        n_slow))
+            hop = lax.psum(hop, res.slow_axes)
+            shard = hop.astype(shard.dtype) if hop.dtype != shard.dtype \
+                else hop
+    if not inflight.gathered:
+        for a in reversed(res.fast_axes):
+            k = dev._axis_size_static(a)
+            _record_hop("allgather", a, inflight.dtype, res.fast.wire,
+                        _ring_bytes(int(shard.shape[0]) * k,
+                                    jnp.dtype(shard.dtype).itemsize, k))
+            shard = dev.invariant_allgather_shards(shard, a)
+    out = shard
+    if inflight.pad:
+        out = out[:inflight.size]
+    if inflight.op == ReduceOp.AVERAGE:
+        out = out / inflight.n_total
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, out.dtype)
+    return out.astype(inflight.dtype)
+
+
+def hierarchical_allreduce_flat(flat, res: ResolvedTransport,
+                                op: ReduceOp = ReduceOp.AVERAGE,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0):
+    """Allreduce one flat float vector over a hierarchically-resolved
+    reduce group (the bucket-level primitive ``fused_allreduce`` routes
+    to when ``HVDT_TRANSPORT`` is live).  Composition of ``start`` and
+    ``finish`` — calling this traces the identical monolithic program
+    the overlap scheduler pipelines."""
+    return hierarchical_allreduce_finish(
+        hierarchical_allreduce_start(flat, res, op, prescale_factor),
+        postscale_factor)
+
+
+def pin_inflight(inflight: InflightHierarchical,
+                 pin) -> InflightHierarchical:
+    """Barrier the inflight's traced arrays with the NEXT bucket's
+    payload token (never its result — done→issue serialization would
+    kill the overlap), so this bucket's finish is scheduled under the
+    next bucket's flight window.
+
+    Only pinned when the finish half still contains psum-family
+    collectives over EVERY reduce axis (barriers erase replication
+    tracking; the finish must re-establish it — see
+    :class:`InflightHierarchical`): i.e. only for the reduce-scatter
+    fast tier, whose finish allgather covers the fast axes and whose
+    slow psum / quant finish covers the slow ones.  A fused (``tree``)
+    fast tier established fast-axis replication BEFORE the pin point,
+    so it keeps the existing plain-bucket behavior: issue-order pinned
+    via the payload chain only."""
+    if pin is None or inflight.gathered:
+        return inflight
+    out = dataclasses.replace(inflight)
+    if inflight.quant_state is not None:
+        qs = inflight.quant_state
+        q2, s2, _ = lax.optimization_barrier((qs.q_recv, qs.s_recv, pin))
+        out.quant_state = dataclasses.replace(qs, q_recv=q2, s_recv=s2)
+    else:
+        shard2, _ = lax.optimization_barrier((inflight.shard, pin))
+        out.shard = shard2
+    return out
+
+
+def wire_bytes_estimate(res: ResolvedTransport, count: int,
+                        itemsize: int) -> int:
+    """Per-rank wire bytes one hierarchical allreduce of ``count``
+    elements moves across both tiers (ring accounting: a data-moving
+    hop over an axis of size k carries (k-1)/k of its payload) — the
+    accounting the overlap scheduler's hidden/total byte counters and
+    the bench rows carry.  Must be called where the group's axes are
+    bound (trace time); outside a trace the tier sizes degrade to 1 and
+    the estimate to 0."""
+    fast_n, slow_n = tier_sizes(res)
+    fast_item = {"bf16": 2, "fp16": 2}.get(res.fast.wire, itemsize)
+    if res.fast.algorithm == "tree":
+        total = 2 * _ring_bytes(count, fast_item, fast_n)  # fused AR
+        shard = count
+    else:
+        total = 2 * _ring_bytes(count, fast_item, fast_n)  # RS + AG
+        shard = max(1, count // max(1, fast_n))
+    if slow_n > 1 and res.slow is not None:
+        if res.slow.wire == "int8":
+            from ..quant import kernels as qk
+
+            total += int(qk.wire_bytes(shard, qk.quant_block_size()))
+        else:
+            slow_item = {"bf16": 2, "fp16": 2}.get(res.slow.wire, itemsize)
+            total += 2 * _ring_bytes(shard, slow_item, slow_n)
+    return int(total)
+
+
+def tier_sizes(res: ResolvedTransport) -> Tuple[int, int]:
+    """(fast, slow) tier sizes for a resolved group with bound axes;
+    falls back to (1, 1) outside a trace where axes are unbound."""
+    from ..ops import device as dev
+
+    try:
+        fast = 1
+        for a in res.fast_axes:
+            fast *= dev._axis_size_static(a)
+        slow = 1
+        for a in res.slow_axes:
+            slow *= dev._axis_size_static(a)
+        return fast, slow
+    except Exception:
+        return 1, 1

@@ -1,0 +1,306 @@
+"""Per-mesh-axis transport policy: grammar, resolution, env engagement.
+
+``HVDT_TRANSPORT`` grammar (strict — unknown vocabulary raises at
+``hvd.init()``, same early-validation idiom as ``HVDT_COMPRESSION``)::
+
+    HVDT_TRANSPORT = entry ("," entry)*  |  "auto"
+    entry          = axis ":" algorithm ":" wire [":" threshold]
+    axis           = "ici" | "dcn"            (transport class)
+                   | dp|pp|fsdp|ep|sp|tp      (exact mesh-axis name)
+    algorithm      = "ring" | "tree" | "2d_ring"
+    wire           = "f32" | "bf16" | "fp16" | "int8"
+    threshold      = digits [K|M|G]           (fusion bucket bytes)
+
+e.g. ``ici:ring:f32:64M,dcn:tree:int8:8M`` — big buckets ride the
+bandwidth-optimal reduce-scatter/allgather split on ICI at f32 while the
+cross-pod shard exchange goes latency-optimal tree at ~1 B/element.
+``auto`` derives the sane default from the mesh topology convention
+(parallel/mesh.py: innermost axis = ICI, outer = DCN): ICI rings at f32
+with the global fusion threshold, DCN trees at f32 with 8 MiB buckets.
+
+Class entries (``ici``/``dcn``) key on :func:`parallel.mesh.
+axis_transport_class`; exact mesh-axis names win over their class.
+Thresholds are parsed strictly (garbage raises at init) and clamped
+through ``ops.device._validated_threshold`` at use, so a ``0`` entry
+degrades to the registry default with a warning instead of planning
+one-leaf buckets.
+
+Algorithm semantics on the XLA data plane (we pick the *decomposition*;
+XLA/libtpu picks the wire-level schedule within each collective):
+
+* ``ring`` — bandwidth-optimal: reduce-scatter + allgather split over
+  the axis, so the slow-axis hop moves 1/n of the bytes;
+* ``tree`` — latency-optimal: one fused collective over the axis (no
+  RS/AG split — XLA lowers small all-reduces to trees), right for
+  small tensors where the split's extra launches dominate;
+* ``2d_ring`` — the reduce-scatter spreads over the TWO innermost
+  axes (when the reduce group has ≥ 3 axes) so each ICI ring carries
+  1/(n1·n2) of the slow-axis payload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import threading
+from typing import Dict, Optional, Tuple, Union
+
+from ..common.logging_util import get_logger
+from ..parallel import mesh as _mesh
+
+log = get_logger(__name__)
+
+__all__ = ["AxisPolicy", "ResolvedTransport", "TransportPolicy",
+           "parse_transport", "get_policy", "resolve_axis",
+           "bucket_threshold", "enabled", "reset", "validate_env",
+           "ALGORITHMS", "WIRES", "VALID_AXES"]
+
+ALGORITHMS: Tuple[str, ...] = ("ring", "tree", "2d_ring")
+WIRES: Tuple[str, ...] = ("f32", "bf16", "fp16", "int8")
+VALID_AXES: Tuple[str, ...] = _mesh.TRANSPORT_CLASSES + _mesh.CANONICAL_AXES
+
+_AUTO_DCN_THRESHOLD = 8 * 1024 * 1024
+_SIZE_RE = re.compile(r"^(\d+)([KkMmGg]?)$")
+_SIZE_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30}
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisPolicy:
+    """One axis entry: algorithm + wire dtype + optional fusion threshold."""
+
+    algorithm: str = "ring"
+    wire: str = "f32"
+    threshold_bytes: Optional[int] = None
+
+    def describe(self) -> str:
+        t = (f":{self.threshold_bytes}"
+             if self.threshold_bytes is not None else "")
+        return f"{self.algorithm}:{self.wire}{t}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedTransport:
+    """A policy applied to one concrete reduce group (tuple of bound mesh
+    axes, outermost first).  ``hierarchical`` when the group splits into
+    a slow tier and a fast tier; ``flat`` when a single-axis group only
+    carries a per-axis wire/threshold override."""
+
+    kind: str                       # "hierarchical" | "flat"
+    axes: Tuple[str, ...]
+    fast_axes: Tuple[str, ...]
+    slow_axes: Tuple[str, ...]
+    fast: AxisPolicy
+    slow: Optional[AxisPolicy]
+    threshold_bytes: Optional[int]
+
+
+def _parse_threshold(tok: str, entry: str) -> int:
+    m = _SIZE_RE.match(tok.strip())
+    if not m:
+        raise ValueError(
+            f"invalid HVDT_TRANSPORT threshold {tok!r} in entry "
+            f"{entry!r}; expected digits with an optional K/M/G suffix "
+            f"(e.g. 64M)")
+    return int(m.group(1)) * _SIZE_MULT[m.group(2).lower()]
+
+
+def parse_transport(spec: str) -> Dict[str, AxisPolicy]:
+    """Parse an ``HVDT_TRANSPORT`` spec into {axis: AxisPolicy}.
+
+    Strict: unknown axis/algorithm/wire names and garbage thresholds
+    raise ``ValueError`` listing the valid vocabulary — consumed by
+    ``hvd.init()`` so a typo fails every worker at init, not at the
+    first traced step on some rank.
+    """
+    entries: Dict[str, AxisPolicy] = {}
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            continue
+        fields = [f.strip().lower() for f in entry.split(":")]
+        if len(fields) not in (3, 4):
+            raise ValueError(
+                f"invalid HVDT_TRANSPORT entry {entry!r}; expected "
+                f"axis:algorithm:wire[:threshold] (e.g. ici:ring:f32:64M)")
+        axis, algorithm, wire = fields[:3]
+        if axis not in VALID_AXES:
+            raise ValueError(
+                f"unknown HVDT_TRANSPORT axis {axis!r}; valid: "
+                f"{', '.join(VALID_AXES)}")
+        if algorithm not in ALGORITHMS:
+            raise ValueError(
+                f"unknown HVDT_TRANSPORT algorithm {algorithm!r} for axis "
+                f"{axis!r}; valid: {', '.join(ALGORITHMS)}")
+        if wire not in WIRES:
+            raise ValueError(
+                f"unknown HVDT_TRANSPORT wire {wire!r} for axis {axis!r}; "
+                f"valid: {', '.join(WIRES)}")
+        if axis == _mesh.TRANSPORT_ICI and wire == "int8":
+            raise ValueError(
+                "HVDT_TRANSPORT: int8 rides the slow (dcn) axis — the "
+                "fast-axis reduce-scatter leg has no int8 wire format; "
+                "put int8 on dcn (e.g. dcn:tree:int8:8M)")
+        if axis in entries:
+            raise ValueError(
+                f"duplicate HVDT_TRANSPORT axis {axis!r}")
+        threshold = (_parse_threshold(fields[3], entry)
+                     if len(fields) == 4 else None)
+        entries[axis] = AxisPolicy(algorithm, wire, threshold)
+    if not entries:
+        raise ValueError(
+            "empty HVDT_TRANSPORT spec; expected "
+            "axis:algorithm:wire[:threshold] entries or 'auto'")
+    return entries
+
+
+class TransportPolicy:
+    """Per-axis transport choices plus the resolution logic that applies
+    them to a concrete reduce group."""
+
+    def __init__(self, entries: Dict[str, AxisPolicy], spec: str = ""):
+        self.entries = dict(entries)
+        self.spec = spec
+
+    @classmethod
+    def parse(cls, spec: str) -> "TransportPolicy":
+        spec = spec.strip()
+        if spec.lower() == "auto":
+            return cls.auto()
+        return cls(parse_transport(spec), spec)
+
+    @classmethod
+    def auto(cls) -> "TransportPolicy":
+        """The topology-derived default (parallel/mesh.py convention:
+        innermost axis = ICI, outer axes = DCN): bandwidth-optimal ring
+        at f32 on ICI with the global fusion threshold; latency-lean
+        tree at f32 with 8 MiB buckets on DCN.  Numerics-neutral — only
+        the schedule changes, never the math."""
+        return cls({
+            _mesh.TRANSPORT_ICI: AxisPolicy("ring", "f32", None),
+            _mesh.TRANSPORT_DCN: AxisPolicy("tree", "f32",
+                                            _AUTO_DCN_THRESHOLD),
+        }, "auto")
+
+    def _lookup(self, axis: str, cls_name: str) -> Optional[AxisPolicy]:
+        """Exact mesh-axis entry wins over its transport class."""
+        pol = self.entries.get(axis)
+        if pol is None:
+            pol = self.entries.get(cls_name)
+        return pol
+
+    def resolve(self, axis: Union[str, Tuple[str, ...]]
+                ) -> Optional[ResolvedTransport]:
+        """Apply this policy to a reduce group.
+
+        Multi-axis groups (outermost first, the mesh convention) go
+        hierarchical: the innermost axis (two innermost under
+        ``2d_ring``) is the fast reduce-scatter tier, everything outer
+        is the slow shard-exchange tier.  Single-axis groups resolve to
+        a flat override when an entry (exact name, else the ``ici``
+        class — one axis is one ICI domain) exists; ``None`` means the
+        policy has nothing to say and the call site keeps its exact
+        pre-existing path.
+        """
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        if len(axes) >= 2:
+            fast = self._lookup(axes[-1], _mesh.TRANSPORT_ICI) \
+                or AxisPolicy()
+            width = 2 if (fast.algorithm == "2d_ring"
+                          and len(axes) > 2) else 1
+            slow_axes, fast_axes = _mesh.split_transport_axes(axes, width)
+            slow = self._lookup(slow_axes[0], _mesh.TRANSPORT_DCN) \
+                or AxisPolicy("tree")
+            if slow.wire == "int8" and len(slow_axes) != 1:
+                raise ValueError(
+                    f"int8 slow-axis wire needs exactly one slow axis, "
+                    f"got {slow_axes} (quantized allreduce reduces over "
+                    f"ONE mesh axis)")
+            threshold = (fast.threshold_bytes
+                         if fast.threshold_bytes is not None
+                         else slow.threshold_bytes)
+            return ResolvedTransport(
+                kind="hierarchical", axes=axes, fast_axes=fast_axes,
+                slow_axes=slow_axes, fast=fast, slow=slow,
+                threshold_bytes=threshold)
+        pol = self._lookup(axes[0], _mesh.TRANSPORT_ICI)
+        if pol is None:
+            return None
+        return ResolvedTransport(
+            kind="flat", axes=axes, fast_axes=axes, slow_axes=(),
+            fast=pol, slow=None, threshold_bytes=pol.threshold_bytes)
+
+    def describe(self) -> str:
+        body = ",".join(f"{a}:{p.describe()}"
+                        for a, p in sorted(self.entries.items()))
+        return f"TransportPolicy({body})"
+
+
+# ---------------------------------------------------------------------------
+# Process-wide policy (env-gated, cached on the raw env string so per-test
+# monkeypatching rebuilds it — the telemetry.instrument.get_recorder idiom)
+# ---------------------------------------------------------------------------
+
+_TRUTHY_OFF = ("", "0", "off", "none", "false", "no")
+
+_lock = threading.Lock()
+_cached_env: Optional[str] = "\0unset"   # sentinel != any real env value
+_cached_policy: Optional[TransportPolicy] = None
+
+
+def enabled() -> bool:
+    """Whether the transport-policy layer is on (``HVDT_TRANSPORT``)."""
+    return os.environ.get("HVDT_TRANSPORT",
+                          "").strip().lower() not in _TRUTHY_OFF
+
+
+def get_policy() -> Optional[TransportPolicy]:
+    """The process-wide transport policy, or ``None`` when off.
+
+    The disabled steady state costs one environ read and a string
+    compare; data-plane call sites branch on ``is None`` and keep their
+    exact pre-existing flat path.  A malformed spec raises here (and so
+    at ``hvd.init()`` through :func:`validate_env`)."""
+    global _cached_env, _cached_policy
+    raw = os.environ.get("HVDT_TRANSPORT")
+    if raw != _cached_env:
+        with _lock:
+            if raw != _cached_env:
+                _cached_policy = (TransportPolicy.parse(raw)
+                                  if enabled() else None)
+                _cached_env = raw
+    return _cached_policy
+
+
+def resolve_axis(axis) -> Optional[ResolvedTransport]:
+    """Resolve the active policy against a reduce group; ``None`` when
+    the layer is off or the policy has no entry for the group."""
+    pol = get_policy()
+    return None if pol is None else pol.resolve(axis)
+
+
+def bucket_threshold(axis, explicit: Optional[int] = None) -> Optional[int]:
+    """The fusion threshold a bucketed exchange over ``axis`` should
+    plan with: an explicit caller/autotuner value always wins, else the
+    policy's per-axis threshold, else ``None`` (the env default —
+    ``ops.device._validated_threshold`` applies its clamping either
+    way)."""
+    if explicit is not None:
+        return explicit
+    res = resolve_axis(axis)
+    return None if res is None else res.threshold_bytes
+
+
+def reset() -> None:
+    """Drop the cached policy (test isolation)."""
+    global _cached_env, _cached_policy
+    with _lock:
+        _cached_env = "\0unset"
+        _cached_policy = None
+
+
+def validate_env() -> Optional[TransportPolicy]:
+    """Early validation for ``hvd.init()``: parse ``HVDT_TRANSPORT`` NOW
+    so unknown vocabulary fails at init with the valid lists, not at the
+    first traced step on some worker (the ``HVDT_COMPRESSION`` idiom)."""
+    return get_policy()

@@ -202,8 +202,11 @@ class TestCollectiveCounters:
             rtol=1e-6)
         c = telemetry_on.get("hvdt_collective_bytes_total")
         # per-shard bucket: (1, 64) f32 = 256 B, recorded at trace time
+        # (jit-path records carry the reduce-axis label)
         assert c.value(op="allreduce", dtype="float32", wire="float32",
-                       path="jit") == 64 * 4
+                       path="jit", axis="dp") == 64 * 4
+        wb = telemetry_on.get("hvdt_wire_bytes_total")
+        assert wb.value(axis="dp", wire="float32") == 64 * 4
         fill = telemetry_on.get("hvdt_fusion_fill_ratio")
         assert fill.count >= 1
 
@@ -217,8 +220,10 @@ class TestCollectiveCounters:
         shard_map(body, mesh=mesh8, in_specs=(P("dp"),), out_specs=P())(x)
         c = telemetry_on.get("hvdt_collective_bytes_total")
         # per-shard 256 elems: 256 B payload + one f32 block scale
+        # (jit-path records carry the reduce-axis label)
         assert c.value(op="allreduce", dtype="float32",
-                       wire="int8_blockwise", path="jit") == 256 + 4
+                       wire="int8_blockwise", path="jit",
+                       axis="dp") == 256 + 4
 
 
 # ---------------------------------------------------------------------------

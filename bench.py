@@ -49,7 +49,8 @@ def _save_last_good(line: str) -> None:
             return
         if d.get("steps_per_call") or d.get("fused_optimizer") \
                 or d.get("fault_plan") or d.get("telemetry") \
-                or d.get("overlap") or d.get("transport"):
+                or d.get("overlap") or d.get("transport") \
+                or d.get("zero_stage") or d.get("remat"):
             # A/B probe variants, chaos runs, and telemetry-instrumented
             # runs are not the headline metric — caching one would
             # contaminate the outage-fallback evidence (telemetry adds
@@ -122,6 +123,29 @@ def _parse_args(argv=None):
                          "'ici:ring:f32:8M,dcn:tree:int8:8M' or 'auto'. "
                          "Recorded in the JSON outside the last-good "
                          "headline cache.")
+    ap.add_argument("--zero", default="",
+                    choices=("", "grads", "states", "params"),
+                    help="A/B leg: ZeRO-sharded gradient exchange "
+                         "(HVDT_ZERO, ops/zero.py) on a mesh-bound dp "
+                         "axis — 'grads' swaps the fused allreduce for "
+                         "the reduce-scatter + allgather split, "
+                         "'states' shards the optimizer moments 1/n "
+                         "with shard-local fused updates + delta "
+                         "allgather, 'params' keeps parameters sharded "
+                         "between steps (gathered on demand per step). "
+                         "JSON gains zero_stage / "
+                         "optimizer_state_bytes; kept out of the "
+                         "last-good headline cache.")
+    ap.add_argument("--remat", default="",
+                    choices=("", "none", "full", "dots"),
+                    help="A/B leg: activation rematerialization "
+                         "(HVDT_REMAT) — wraps the loss in "
+                         "jax.checkpoint ('full': save only inputs; "
+                         "'dots': dots_with_no_batch_dims_saveable "
+                         "policy, guarded for jax builds without it). "
+                         "The second half of the memory-for-MFU trade "
+                         "next to --zero; JSON gains remat; kept out "
+                         "of the last-good cache.")
     ap.add_argument("--serve", action="store_true",
                     help="Serving micro-benchmark instead of training: "
                          "an in-process ModelServer (MLP, shape-bucketed "
@@ -267,6 +291,17 @@ def _run_child(args) -> None:
         os.environ.setdefault("HVDT_TELEMETRY", "1")
         os.environ.setdefault("HVDT_FUSION_THRESHOLD",
                               str(8 * 1024 * 1024))
+    if args.zero:
+        # ZeRO leg: route the gradient exchange + optimizer update
+        # through the reduce-scatter wire / sharded state (ops/zero.py)
+        # on the mesh-bound dp axis below; telemetry on so the memory
+        # gauges (hvdt_optimizer_state_bytes) feed the JSON.
+        os.environ["HVDT_ZERO"] = args.zero
+        os.environ.setdefault("HVDT_TELEMETRY", "1")
+        os.environ.setdefault("HVDT_FUSION_THRESHOLD",
+                              str(8 * 1024 * 1024))
+    if args.remat:
+        os.environ.setdefault("HVDT_REMAT", args.remat)
 
     dev = jax.devices()[0]
     print(f"benchmarking on {dev.platform}:{dev.device_kind}"
@@ -275,7 +310,21 @@ def _run_child(args) -> None:
 
     cfg = ResNetConfig(num_classes=1000, dtype=jnp.bfloat16)
     params, stats = resnet50_init(jax.random.PRNGKey(0), cfg)
-    if args.fused_optimizer:
+    loss_fn = resnet_loss
+    if args.remat and args.remat != "none":
+        # Activation rematerialization leg: trade recompute FLOPs for
+        # activation HBM (the complement of --zero's state sharding).
+        from horovod_tpu.models import checkpoint_policy
+
+        _pol = checkpoint_policy(args.remat)
+        if _pol == "full":
+            loss_fn = jax.checkpoint(resnet_loss, static_argnums=(4,))
+        elif _pol is not None:
+            loss_fn = jax.checkpoint(resnet_loss, policy=_pol,
+                                     static_argnums=(4,))
+    if args.fused_optimizer or args.zero in ("states", "params"):
+        # ZeRO states/params shard the update itself, so the optimizer
+        # family must be known (the fused_sgd hyperparameter tag).
         from horovod_tpu.ops.optim_kernels import fused_sgd
 
         opt = fused_sgd(0.01, momentum=0.9)
@@ -291,11 +340,12 @@ def _run_child(args) -> None:
 
     def one_step(params, stats, opt_state, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
-            resnet_loss, has_aux=True)(params, stats, images, labels, cfg)
+            loss_fn, has_aux=True)(params, stats, images, labels, cfg)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
 
-    if args.overlap or args.transport:
+    zero_tx = None
+    if args.overlap or args.transport or args.zero:
         # Overlap / transport A/B legs: run the step inside a mesh-bound
         # shard_map so the gradient exchange actually exists (single-chip
         # runs bind a 1-device axis; the schedule, barriers and
@@ -346,16 +396,51 @@ def _run_child(args) -> None:
         elif "check_vma" in _sig:
             _smap_kw["check_vma"] = False
 
+        param_template = params
+        if args.zero:
+            from horovod_tpu.ops import zero as hvd_zero
+
+            zero_tx = hvd_opt.DistributedOptimizer(
+                opt, axis=grad_axis,
+                zero=hvd_zero.ZeroSpec(
+                    args.zero, axis=grad_axis, num_shards=ndev)
+                if args.zero in ("states", "params") else "grads")
+            opt_state = zero_tx.init(params)
+            if args.zero == "params":
+                # Params live sharded between steps; the step gathers
+                # them on demand (here: once per step — per-layer
+                # on-demand gathering is the GSPMD/fsdp path,
+                # parallel/sharding.fsdp_shardings).
+                params = zero_tx.shard_params(param_template)
+
         def _sharded_step(params, stats, opt_state, images, labels):
             def body(params, stats, opt_state, images, labels):
+                if args.zero == "params":
+                    full = zero_tx.gather_params(params, param_template)
+                else:
+                    full = params
                 (loss, new_stats), grads = jax.value_and_grad(
-                    resnet_loss, has_aux=True)(params, stats, images,
-                                               labels, cfg)
-                grads = hvd_opt.allreduce_gradients(grads, axis=grad_axis)
+                    loss_fn, has_aux=True)(full, stats, images,
+                                           labels, cfg)
                 new_stats = hvd_dev.allreduce(new_stats, grad_axis,
                                               ReduceOp.AVERAGE)
                 loss = hvd_dev.allreduce(loss, grad_axis,
                                          ReduceOp.AVERAGE)
+                if zero_tx is not None:
+                    # ZeRO leg: the transform owns both the exchange
+                    # (reduce-scatter wire) and — for states/params —
+                    # the shard-local fused update.
+                    updates, opt_state = zero_tx.update(
+                        grads, opt_state,
+                        params=(params if args.zero == "params"
+                                else full))
+                    if args.zero == "params":
+                        new_params = jax.tree.map(jnp.add, params,
+                                                  updates)
+                    else:
+                        new_params = optax.apply_updates(full, updates)
+                    return new_params, new_stats, opt_state, loss
+                grads = hvd_opt.allreduce_gradients(grads, axis=grad_axis)
                 updates, opt_state = opt.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), new_stats,
                         opt_state, loss)
@@ -586,6 +671,9 @@ def _run_child(args) -> None:
         **({"compile_cache": cache_dir} if cache_dir else {}),
         **(_overlap_doc() if args.overlap else {}),
         **(_transport_doc(args.transport) if args.transport else {}),
+        **(_zero_doc(args, zero_tx, params, opt_state) if args.zero
+           else {}),
+        **({"remat": args.remat} if args.remat else {}),
         **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
            if args.steps_per_call != 1 else {}),
@@ -644,6 +732,33 @@ def _transport_doc(spec: str) -> dict:
         except Exception:
             pass
     return doc
+
+
+def _zero_doc(args, zero_tx, params, opt_state) -> dict:
+    """The --zero leg's JSON fields: the stage and the per-rank
+    post-sharding memory accounting (the ZeRO evidence —
+    optimizer_state_bytes shrinks ~n× at stages states/params).  Also
+    feeds the hvdt_param_bytes / hvdt_optimizer_state_bytes telemetry
+    gauges.  Rides outside the last-good headline cache."""
+    from horovod_tpu.telemetry.step_stats import (record_memory_accounting,
+                                                  tree_bytes)
+
+    n = int(getattr(getattr(zero_tx, "spec", None), "num_shards", 0)
+            or 1)
+    opt_bytes = tree_bytes(opt_state)
+    param_bytes = tree_bytes(params)
+    if args.zero in ("states", "params"):
+        # State stacks are [n, shard_len]; a rank holds one row.
+        opt_bytes //= max(1, n)
+    if args.zero == "params":
+        param_bytes //= max(1, n)
+    record_memory_accounting(param_bytes=param_bytes,
+                             optimizer_state_bytes=opt_bytes,
+                             zero_stage=args.zero)
+    return {"zero_stage": args.zero,
+            "zero_num_shards": n,
+            "optimizer_state_bytes": int(opt_bytes),
+            "param_bytes": int(param_bytes)}
 
 
 def _profiled_hbm_util(compiled, params, stats, opt_state, images,
@@ -742,7 +857,9 @@ def main() -> None:
             "--steps-per-call", str(args.steps_per_call)] \
         + (["--fused-optimizer"] if args.fused_optimizer else []) \
         + (["--overlap"] if args.overlap else []) \
-        + (["--transport", args.transport] if args.transport else [])
+        + (["--transport", args.transport] if args.transport else []) \
+        + (["--zero", args.zero] if args.zero else []) \
+        + (["--remat", args.remat] if args.remat else [])
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

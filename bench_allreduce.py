@@ -223,6 +223,123 @@ def bench_hier_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
     return min(times)
 
 
+def bench_rs_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
+                 warmup: int, leg: str):
+    """Per-op seconds for one leg of the reduce-scatter sweep on the dp
+    mesh: ``allreduce`` = the flat psum, ``rs_ag`` = the explicit
+    reduce-scatter + invariant-allgather split (the HVDT_ZERO=grads
+    wire), ``rs`` = the reduce-scatter hop alone (what the deeper ZeRO
+    stages pay per step when the allgather is deferred into the
+    parameter-delta path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.ops import device as hdev
+
+    n = mesh.devices.size
+    count = max(n, nbytes // jnp.dtype(dtype).itemsize)
+    count -= count % n
+    x = jax.device_put(jnp.ones((n, count), dtype),
+                       NamedSharding(mesh, P("dp")))
+    pcast = getattr(lax, "pcast", None)
+
+    def body(xl):
+        def one(_, acc):
+            flat = acc.reshape(-1)
+            if leg == "allreduce":
+                red = lax.psum(flat, "dp") * (1.0 / n)
+            elif leg == "rs_ag":
+                shard = hdev.reduce_scatter_flat(flat, "dp")
+                red = hdev.allgather_flat_shards(shard, "dp") * (1.0 / n)
+            else:   # rs: the wire hop alone; tile back so the carry
+                    # chains (labelled approximate — the tile is local)
+                shard = hdev.reduce_scatter_flat(flat, "dp")
+                red = jnp.tile(shard, n) * (1.0 / n)
+            red = red.reshape(acc.shape)
+            return (pcast(red, ("dp",), to="varying")
+                    if pcast is not None else red)
+
+        return lax.fori_loop(0, inner, one, xl)
+
+    f = jax.jit(_shard_map()(body, mesh=mesh, in_specs=P("dp"),
+                             out_specs=P("dp")))
+
+    def run_and_wait():
+        float(jnp.sum(f(x)[..., :1].astype(jnp.float32)))
+
+    for _ in range(warmup):
+        run_and_wait()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        run_and_wait()
+        times.append((time.perf_counter() - t0) / inner)
+    return min(times)
+
+
+def _run_reduce_scatter(args) -> None:
+    """--reduce-scatter: measure the ZeRO wire split against the flat
+    allreduce per message size and emit ``rs_ag_speedup_vs_allreduce``
+    rows — the measured seed ``HVDT_AUTOTUNE_ZERO_SEED`` reads (the
+    autotuner's replicated-vs-sharded starting leg comes from this
+    file, not a guess — mirrors HVDT_AUTOTUNE_TRANSPORT_SEED)."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n = mesh.devices.size
+    dev0 = jax.devices()[0]
+    print(f"# reduce-scatter sweep on {n}x "
+          f"{dev0.platform}:{dev0.device_kind} "
+          f"(rs_ag = explicit RS+AG split, the HVDT_ZERO wire)",
+          file=sys.stderr)
+
+    rows = []
+    size = args.min_bytes
+    while size <= args.max_bytes:
+        t = {leg: bench_rs_jit(mesh, size, args.dtype, args.inner,
+                               args.iters, args.warmup, leg)
+             for leg in ("allreduce", "rs_ag", "rs")}
+        speedup = (t["allreduce"] / t["rs_ag"]
+                   if t["rs_ag"] > 0 else None)
+        rows.append({
+            "bytes": size,
+            "allreduce_us": t["allreduce"] * 1e6,
+            "rs_ag_us": t["rs_ag"] * 1e6,
+            "rs_us": t["rs"] * 1e6,
+            "rs_ag_algbw_gbps": size / t["rs_ag"] / 1e9,
+            "rs_ag_speedup_vs_allreduce": speedup,
+            "deferred_ag_fraction": (1.0 - t["rs"] / t["rs_ag"]
+                                     if t["rs_ag"] > 0 else None),
+        })
+        print(f"{_fmt_bytes(size):>8}  allreduce "
+              f"{t['allreduce']*1e6:>9.1f}us  rs+ag "
+              f"{t['rs_ag']*1e6:>9.1f}us  rs {t['rs']*1e6:>9.1f}us  "
+              f"speedup {speedup:>5.2f}x", file=sys.stderr)
+        size *= 4
+
+    peak = max(rows, key=lambda r: r["rs_ag_algbw_gbps"])
+    summary = {
+        "metric": "reduce_scatter_sweep",
+        "value": round(peak["rs_ag_speedup_vs_allreduce"], 3),
+        "unit": "speedup_vs_allreduce",
+        "n_devices": int(n),
+        "platform": dev0.platform,
+        "at_bytes": peak["bytes"],
+        "rs_ag_speedup_vs_allreduce_at_peak": round(
+            peak["rs_ag_speedup_vs_allreduce"], 3),
+        "rows": rows,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
 def _run_hierarchical(args) -> None:
     """--hierarchical: the per-(axis, algorithm, wire, size) sweep of
     the transport-policy data plane, with the measured
@@ -401,6 +518,12 @@ def main() -> None:
                     help="also write the sweep JSON to this file "
                          "(axis / algorithm / bytes_on_wire / GB/s / "
                          "speedup rows)")
+    ap.add_argument("--reduce-scatter", action="store_true",
+                    help="measure the explicit reduce-scatter + "
+                         "allgather split (the HVDT_ZERO wire) against "
+                         "the flat allreduce; emits "
+                         "rs_ag_speedup_vs_allreduce rows (the "
+                         "HVDT_AUTOTUNE_ZERO_SEED input)")
     ap.add_argument("--hierarchical", action="store_true",
                     help="two-level transport-policy sweep on an "
                          "(outer x inner) mesh: per-(axis, algorithm, "
@@ -421,6 +544,9 @@ def main() -> None:
 
     if args.np > 1:
         _run_eager_multiproc(args)
+        return
+    if args.reduce_scatter:
+        _run_reduce_scatter(args)
         return
     if args.hierarchical or args.transport:
         _run_hierarchical(args)

@@ -7,7 +7,8 @@
 #       (compileall + optimizer-kernel + serving-subsystem +
 #       quantized-collective + resilience-chaos + telemetry +
 #       tracing/flight-recorder-forensics + overlap-scheduling +
-#       transport-policy/hierarchical-collective tests on CPU) —
+#       transport-policy/hierarchical-collective +
+#       zero-sharding/reduce-scatter-wire tests on CPU) —
 #       the pre-merge gate.
 set -eu
 only=""

@@ -132,6 +132,13 @@ def __getattr__(name):
             from .ops import overlap
 
             return overlap
+        if name == "zero":
+            # ZeRO-sharded gradient exchange / optimizer state
+            # (reduce-scatter wire, shard-local fused updates,
+            # allgather-on-demand parameters).
+            from .ops import zero
+
+            return zero
         if name in ("elastic", "timeline", "models", "parallel", "runner",
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
                     "data", "native", "orchestrate", "interop",

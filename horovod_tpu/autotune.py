@@ -166,6 +166,7 @@ class ParameterManager:
     QUANT_CANDIDATES = (0.0, 1.0)
     OVERLAP_SCHEDULE_CANDIDATES = (0.0, 1.0)
     TRANSPORT_CANDIDATES = (0.0, 1.0)
+    ZERO_CANDIDATES = (0.0, 1.0)
 
     def __init__(self,
                  warmup_samples: Optional[int] = None,
@@ -176,7 +177,8 @@ class ParameterManager:
                  tune_fused_optimizer: Optional[bool] = None,
                  tune_quant: Optional[bool] = None,
                  tune_overlap: Optional[bool] = None,
-                 tune_transport: Optional[bool] = None):
+                 tune_transport: Optional[bool] = None,
+                 tune_zero: Optional[bool] = None):
         self.warmup = (warmup_samples if warmup_samples is not None
                        else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
         self.steps_per_sample = (
@@ -221,6 +223,19 @@ class ParameterManager:
         self.tune_transport = (
             tune_transport if tune_transport is not None
             else config.get_bool("HVDT_AUTOTUNE_TRANSPORT"))
+        # Optional seventh dimension: replicated-vs-ZeRO-sharded
+        # exchange/update (ops/zero.py) — reduce-scatter wire + sharded
+        # state trades an extra allgather against n-fold-smaller
+        # optimizer HBM (bigger batches), so the GP prices it jointly
+        # with bucketing and wire.  Both legs keep ONE sharded state
+        # tree (the replicated leg exchanges via allreduce and slices
+        # its shard — same layout, different wire), so the hot swap is
+        # a re-jit only.  The starting leg is MEASURED when
+        # HVDT_AUTOTUNE_ZERO_SEED points at a bench_allreduce
+        # --reduce-scatter sweep (rs_ag_speedup_vs_allreduce_at_peak
+        # > 1).
+        self.tune_zero = (tune_zero if tune_zero is not None
+                          else config.get_bool("HVDT_AUTOTUNE_ZERO"))
         # Column layout: [log2_bucket, overlap] (+fused) (+quant)
         # (+overlap_schedule) (+transport).
         self._quant_col = (2 + int(self.tune_fused)) if self.tune_quant \
@@ -232,6 +247,10 @@ class ParameterManager:
             2 + int(self.tune_fused) + int(self.tune_quant)
             + int(self.tune_overlap)
         ) if self.tune_transport else None
+        self._zero_col = (
+            2 + int(self.tune_fused) + int(self.tune_quant)
+            + int(self.tune_overlap) + int(self.tune_transport)
+        ) if self.tune_zero else None
         import itertools
 
         dims = [self.LOG2_BUCKET_CANDIDATES, self.OVERLAP_CANDIDATES]
@@ -243,6 +262,8 @@ class ParameterManager:
             dims.append(self.OVERLAP_SCHEDULE_CANDIDATES)
         if self.tune_transport:
             dims.append(self.TRANSPORT_CANDIDATES)
+        if self.tune_zero:
+            dims.append(self.ZERO_CANDIDATES)
         grid = np.array(list(itertools.product(*dims)), float)
         self._bo = BayesianOptimizer(grid, noise=noise)
         start = [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0]
@@ -254,6 +275,8 @@ class ParameterManager:
             start.append(float(_env_overlap()))
         if self.tune_transport:
             start.append(float(_env_transport()))
+        if self.tune_zero:
+            start.append(float(_env_zero()))
         self._current = np.array(start)
         self._sample = _Sample(self._current)
         self._samples_done = 0
@@ -302,6 +325,14 @@ class ParameterManager:
         if self.tune_transport:
             return bool(self._current[self._transport_col] >= 0.5)
         return _env_transport()
+
+    @property
+    def zero_sharding(self) -> bool:
+        """Current replicated-vs-ZeRO-sharded choice; outside the tuned
+        dimension it reports the HVDT_ZERO / seed-file env default."""
+        if self.tune_zero:
+            return bool(self._current[self._zero_col] >= 0.5)
+        return _env_zero()
 
     @property
     def tuning_complete(self) -> bool:
@@ -369,6 +400,35 @@ def _env_overlap() -> bool:
     from .ops.overlap import enabled
 
     return enabled()
+
+
+def _env_zero() -> bool:
+    """The environment's replicated-vs-sharded default (the zero
+    dimension's starting leg): HVDT_ZERO set, else the MEASURED verdict
+    of a bench_allreduce --reduce-scatter sweep named by
+    HVDT_AUTOTUNE_ZERO_SEED (rs_ag_speedup_vs_allreduce_at_peak > 1 ⇒
+    start sharded) — the policies-are-measured loop, mirroring
+    _env_transport."""
+    from .ops.zero import enabled as zero_enabled
+
+    try:
+        if zero_enabled():
+            return True
+    except ValueError:
+        return False
+    seed = config.get_str("HVDT_AUTOTUNE_ZERO_SEED").strip()
+    if not seed:
+        return False
+    import json
+
+    try:
+        with open(seed) as fh:
+            doc = json.load(fh)
+        return float(doc.get("rs_ag_speedup_vs_allreduce_at_peak",
+                             0.0)) > 1.0
+    except (OSError, ValueError, TypeError) as e:
+        log.warning("zero autotune seed %s unreadable: %s", seed, e)
+        return False
 
 
 def _env_transport() -> bool:
@@ -485,8 +545,11 @@ class BenchmarkAutotuner:
                if self.pm.tune_overlap else "")
         tr = (f" transport={'hier' if self.pm.transport_policy else 'flat'}"
               if self.pm.tune_transport else "")
+        zr = (f" zero={'sharded' if self.pm.zero_sharding else 'repl'}"
+              if self.pm.tune_zero else "")
         return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
-                f"overlap={self.pm.overlap_buckets}{fused}{quant}{ovl}{tr} "
+                f"overlap={self.pm.overlap_buckets}"
+                f"{fused}{quant}{ovl}{tr}{zr} "
                 f"({self.pm._samples_done} samples)")
 
 
@@ -554,6 +617,17 @@ class AutotunedStep:
     leg seeded from ``HVDT_TRANSPORT`` or the measured
     ``HVDT_AUTOTUNE_TRANSPORT_SEED`` bench verdict.
 
+    With ``HVDT_AUTOTUNE_ZERO=1`` the space gains a
+    replicated-vs-ZeRO-sharded dimension (ops/zero.py): builders
+    accepting a ``zero`` keyword are rebuilt as
+    ``builder(threshold_bytes, zero=bool)`` — hot-swappable because
+    both legs keep ONE sharded state tree (the replicated leg is the
+    allreduce + own-shard-slice wire, ``zero_transform(...,
+    rs_wire=False)``; tests/test_zero.py pins the contract), with the
+    STARTING leg seeded from ``HVDT_ZERO`` or the measured
+    ``HVDT_AUTOTUNE_ZERO_SEED`` bench_allreduce --reduce-scatter
+    verdict.
+
     Args:
       builder: ``builder(threshold_bytes | None) -> step_callable``
         (optionally also accepting ``fused=bool``).
@@ -580,11 +654,13 @@ class AutotunedStep:
             self._accepts_quant = "quant" in sig or var_kw
             self._accepts_overlap = "overlap" in sig or var_kw
             self._accepts_transport = "transport" in sig or var_kw
+            self._accepts_zero = "zero" in sig or var_kw
         except (TypeError, ValueError):
             self._accepts_fused = False
             self._accepts_quant = False
             self._accepts_overlap = False
             self._accepts_transport = False
+            self._accepts_zero = False
         # Pin every tuned A/B dimension's starting leg at build 0 so the
         # opt-state structure established before tuning matches every
         # later rebuild (both fused legs keep one state tree —
@@ -603,6 +679,9 @@ class AutotunedStep:
         if (self.enabled and self._accepts_transport
                 and config.get_bool("HVDT_AUTOTUNE_TRANSPORT")):
             build_kw["transport"] = _env_transport()
+        if (self.enabled and self._accepts_zero
+                and config.get_bool("HVDT_AUTOTUNE_ZERO")):
+            build_kw["zero"] = _env_zero()
         self._step = builder(None, **build_kw)
         self._tree_example = tree_example
         self._steps_per_sample = steps_per_sample
@@ -639,6 +718,8 @@ class AutotunedStep:
             kw["overlap"] = pm.overlap_schedule
         if pm.tune_transport and self._accepts_transport:
             kw["transport"] = pm.transport_policy
+        if pm.tune_zero and self._accepts_zero:
+            kw["zero"] = pm.zero_sharding
         return self._builder(self._tuner.bucket_bytes, **kw)
 
     @staticmethod

@@ -223,6 +223,16 @@ def init(
             log.info("transport policy from env: %s",
                      _env_transport.describe())
 
+        # ZeRO stage env selection (HVDT_ZERO): validate NOW so an
+        # unknown stage fails at init with the valid list, not at the
+        # first optimizer build on some worker (same idiom as above).
+        from ..ops import zero as _zero
+
+        _env_zero_stage = _zero.validate_env()
+        if _env_zero_stage is not None:
+            log.info("ZeRO state sharding from env: stage=%s",
+                     _env_zero_stage)
+
         env_size = config.get_int("HVDT_SIZE")
         env_rank = config.get_int("HVDT_RANK")
         coord = coordinator_address or config.get_str("HVDT_COORDINATOR_ADDR")

@@ -113,6 +113,50 @@ KNOBS: Dict[str, Knob] = {
            "the autotuner's transport dimension STARTS on the "
            "hierarchical leg — policies are seeded from measurements, "
            "not guesses."),
+        # --- ZeRO-sharded gradient exchange / optimizer state
+        #     (ops/zero.py: reduce-scatter wire, shard-local fused
+        #     updates, allgather-on-demand parameters) ---
+        _k("HVDT_ZERO", "", str,
+           "ZeRO-style state-sharding stage: 'grads' swaps the fused "
+           "allreduce for an explicit reduce-scatter + invariant-"
+           "allgather split (same wire bytes, deferrable allgather; any "
+           "optax optimizer); 'states' reduce-scatters gradients and "
+           "runs the single-HBM-pass optimizer update on each rank's "
+           "1/n shard of the moments, allgathering only the parameter "
+           "deltas (optimizer HBM shrinks ~n x; requires fused_adam/"
+           "fused_sgd); 'params' additionally keeps the parameters "
+           "sharded between steps (allgather-on-demand via the fsdp "
+           "sharding rules).  Unset/'off' (default) keeps the "
+           "replicated path as the identical code objects "
+           "(zero.get_zero() is None, zero wrappers); unknown stages "
+           "fail hvd.init() with the valid list."),
+        _k("HVDT_AUTOTUNE_ZERO", False, _parse_bool,
+           "Add a replicated-vs-ZeRO-sharded dimension (0/1) to the "
+           "autotune search space; the step builder is rebuilt with "
+           "zero=... at each knob change (autotune.AutotunedStep), "
+           "hot-swappable because both legs keep ONE sharded state "
+           "tree (the replicated leg exchanges via allreduce and "
+           "slices its shard — same layout, different wire).  Starting "
+           "point: HVDT_ZERO set, or the measured "
+           "HVDT_AUTOTUNE_ZERO_SEED verdict."),
+        _k("HVDT_AUTOTUNE_ZERO_SEED", "", str,
+           "Path to a bench_allreduce.py --reduce-scatter --json-out "
+           "file; when its measured rs_ag_speedup_vs_allreduce_at_peak "
+           "exceeds 1.0 the autotuner's zero dimension STARTS on the "
+           "sharded leg — seeded from measurements, not guesses "
+           "(mirrors HVDT_AUTOTUNE_TRANSPORT_SEED)."),
+        # --- activation rematerialization (models/: jax.checkpoint
+        #     policy on the transformer block — the second half of the
+        #     memory-for-MFU trade next to HVDT_ZERO) ---
+        _k("HVDT_REMAT", "", str,
+           "Activation rematerialization for the transformer block: "
+           "'none'/'' (default) saves all activations; 'full' saves "
+           "only block inputs (min HBM, +1/3 FLOPs); 'dots' uses "
+           "jax.checkpoint_policies.dots_with_no_batch_dims_saveable "
+           "(save matmul outputs, recompute elementwise+attention — "
+           "falls back to 'full' with a warning on jax builds without "
+           "the policy).  Consumed by models.remat_from_env / bench.py "
+           "--remat; unknown values raise with the valid list."),
         # --- cache (ref: HOROVOD_CACHE_CAPACITY common.h:114) ---
         _k("HVDT_CACHE_CAPACITY", 1024, int,
            "Response-cache capacity (negotiated-collective descriptors)."),

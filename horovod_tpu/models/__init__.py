@@ -16,6 +16,8 @@ from .transformer import (  # noqa: F401
     transformer_loss,
     transformer_logical_axes,
     transformer_flops_per_token,
+    remat_from_env,
+    checkpoint_policy,
 )
 from .resnet import (  # noqa: F401
     ResNetConfig,

@@ -37,7 +37,7 @@ from ..parallel.ring_attention import ring_attention
 __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
     "transformer_loss", "transformer_logical_axes",
-    "transformer_flops_per_token",
+    "transformer_flops_per_token", "remat_from_env", "checkpoint_policy",
 ]
 
 
@@ -439,6 +439,63 @@ def _moe_mlp(p, x, cfg: TransformerConfig):
     return out.reshape(b, l, d), aux
 
 
+def _dots_policy():
+    """The ``dots_with_no_batch_dims_saveable`` checkpoint policy, or
+    ``None`` on jax builds that don't ship it (the container's 0.4.37
+    has it, but the guard keeps HVDT_REMAT=dots from crashing older/
+    newer builds that rename it)."""
+    policies = getattr(jax, "checkpoint_policies", None)
+    return getattr(policies, "dots_with_no_batch_dims_saveable", None)
+
+
+_REMAT_MODES = ("none", "full", "dots")
+
+
+def checkpoint_policy(mode: Optional[str] = None):
+    """Resolve an ``HVDT_REMAT`` mode to a ``jax.checkpoint`` wrapper
+    argument: ``None`` (no remat), the string sentinel ``"full"`` (plain
+    ``jax.checkpoint``), or a policy callable (``dots``).  ``mode=None``
+    reads the env knob; unknown modes raise with the valid list; a
+    ``dots`` request on a build without the policy degrades to ``full``
+    with a warning (never a crash)."""
+    from ..common import config
+    from ..common.logging_util import get_logger
+
+    if mode is None:
+        mode = config.get_str("HVDT_REMAT")
+    mode = (mode or "none").strip().lower() or "none"
+    if mode not in _REMAT_MODES:
+        raise ValueError(
+            f"unknown HVDT_REMAT mode {mode!r}; valid: "
+            f"{', '.join(_REMAT_MODES)}")
+    if mode == "none":
+        return None
+    if mode == "dots":
+        pol = _dots_policy()
+        if pol is None:
+            get_logger(__name__).warning(
+                "HVDT_REMAT=dots requested but this jax build has no "
+                "dots_with_no_batch_dims_saveable policy; falling back "
+                "to remat='full'")
+            return "full"
+        return pol
+    return "full"
+
+
+def remat_from_env(cfg: TransformerConfig,
+                   mode: Optional[str] = None) -> TransformerConfig:
+    """Apply the ``HVDT_REMAT`` knob (``none|full|dots``) to a config —
+    the memory-for-MFU trade surfaced as ``bench.py --remat`` /
+    ``hvdtrun --remat``.  Returns ``cfg`` unchanged for ``none`` (and
+    the ``dots``→``full`` fallback is resolved here so the config names
+    the policy that will actually run)."""
+    pol = checkpoint_policy(mode)
+    if pol is None:
+        return dataclasses.replace(cfg, remat=False)
+    policy_name = "full" if pol == "full" else "dots"
+    return dataclasses.replace(cfg, remat=True, remat_policy=policy_name)
+
+
 def _block(p, x, positions, cfg: TransformerConfig):
     x = x + _attention(p, _rmsnorm(x, p["ln1"]), positions, cfg)
     if cfg.num_experts:
@@ -452,10 +509,19 @@ def _scan_blocks(block_params, x, positions, cfg: TransformerConfig):
     body = functools.partial(_block, positions=positions, cfg=cfg)
     if cfg.remat:
         if cfg.remat_policy == "dots":
-            body = jax.checkpoint(
-                body,
-                policy=jax.checkpoint_policies
-                .dots_with_no_batch_dims_saveable)
+            pol = _dots_policy()
+            if pol is None:
+                # Guarded for jax builds without the named policy
+                # (HVDT_REMAT=dots on such a build degrades to 'full'
+                # at config time; a hand-built config degrades here).
+                from ..common.logging_util import get_logger
+
+                get_logger(__name__).warning(
+                    "remat_policy='dots' unavailable on this jax "
+                    "build; using 'full'")
+                body = jax.checkpoint(body)
+            else:
+                body = jax.checkpoint(body, policy=pol)
         elif cfg.remat_policy == "full":
             body = jax.checkpoint(body)
         else:

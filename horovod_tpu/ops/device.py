@@ -42,6 +42,9 @@ __all__ = [
     "fused_allreduce_buckets",
     "hierarchical_allreduce",
     "invariant_allgather_shards",
+    "reduce_scatter_flat",
+    "allgather_flat_shards",
+    "shard_owner_index",
 ]
 
 AxisName = Union[str, Tuple[str, ...]]
@@ -616,6 +619,50 @@ def invariant_allgather_shards(shard, axis: AxisName):
     full = jnp.zeros((n * chunk,) + shard.shape[1:], shard.dtype)
     full = lax.dynamic_update_slice_in_dim(full, shard, idx * chunk, axis=0)
     return lax.psum(full, axis)
+
+
+def _rs_hop_order(axis: AxisName) -> Tuple[str, ...]:
+    """Sequential reduce-scatter hop order over a reduce group:
+    innermost (ICI) axis first, so the full payload rides the fast
+    links and only the 1/n_fast shard crosses the slow outer tier (the
+    mesh convention: outer axes are the slow ones)."""
+    return tuple(reversed(_axes_tuple(axis)))
+
+
+def reduce_scatter_flat(flat, axis: AxisName):
+    """Tiled reduce-scatter of a flat vector over a (possibly
+    multi-axis) reduce group: one ``psum_scatter`` hop per axis in
+    :func:`_rs_hop_order`.  ``flat``'s length must divide by the group
+    size.  Rank ``shard_owner_index(axis)`` receives its contiguous
+    1/n chunk of the fully reduced vector — the ZeRO wire primitive
+    (ops/zero.py) and the ``bench_allreduce --reduce-scatter`` leg."""
+    shard = flat
+    for a in _rs_hop_order(axis):
+        shard = lax.psum_scatter(shard, a, tiled=True)
+    return shard
+
+
+def allgather_flat_shards(shard, axis: AxisName):
+    """Inverse of :func:`reduce_scatter_flat`: invariant zero-embed +
+    psum reassembly per axis in reverse hop order, so the result is
+    *replicated* over the whole group (P() out_specs / optax.MultiSteps
+    type stability — see :func:`invariant_allgather_shards`)."""
+    full = shard
+    for a in reversed(_rs_hop_order(axis)):
+        full = invariant_allgather_shards(full, a)
+    return full
+
+
+def shard_owner_index(axis: AxisName):
+    """Linearized chunk index this rank owns after
+    :func:`reduce_scatter_flat` (most-significant digit = first RS
+    hop).  Trace-time value; ``axis`` must be bound."""
+    idx = None
+    for a in _rs_hop_order(axis):
+        k = _axis_size_static(a)
+        i = lax.axis_index(a)
+        idx = i if idx is None else idx * k + i
+    return idx
 
 
 def hierarchical_allreduce(x, inner_axis: AxisName = "ici",

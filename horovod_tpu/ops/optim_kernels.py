@@ -256,6 +256,13 @@ def fused_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
                     mu=jax.tree.unflatten(treedef, out_m),
                     nu=jax.tree.unflatten(treedef, out_v)))
 
+    # Hyperparameter tag for the ZeRO router (ops/zero.py):
+    # DistributedOptimizer(..., zero="states"/"params") shards this
+    # update's math, so it must know the family + coefficients.
+    update_fn._hvdt_optim_spec = {
+        "kind": "adam", "learning_rate": learning_rate, "b1": b1,
+        "b2": b2, "eps": eps, "eps_root": eps_root,
+        "weight_decay": weight_decay, "use_kernels": use_kernels}
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -367,6 +374,10 @@ def fused_sgd(learning_rate, momentum: float = 0.0,
                            * g.astype(jnp.float32)).astype(g.dtype),
                 updates), state)
 
+        update_plain._hvdt_optim_spec = {
+            "kind": "sgd", "learning_rate": learning_rate,
+            "momentum": 0.0, "nesterov": False,
+            "use_kernels": use_kernels}
         return optax.GradientTransformation(init_plain, update_plain)
 
     def init_fn(params):
@@ -389,4 +400,9 @@ def fused_sgd(learning_rate, momentum: float = 0.0,
         return (jax.tree.unflatten(treedef, out_d),
                 optax.TraceState(trace=jax.tree.unflatten(treedef, out_m)))
 
+    # ZeRO router tag (see fused_adam).
+    update_fn._hvdt_optim_spec = {
+        "kind": "sgd", "learning_rate": learning_rate,
+        "momentum": momentum, "nesterov": nesterov,
+        "use_kernels": use_kernels}
     return optax.GradientTransformation(init_fn, update_fn)

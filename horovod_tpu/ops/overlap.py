@@ -464,6 +464,16 @@ def overlap_value_and_grad(stage_fns: Sequence[Callable],
             "int8", "int8_blockwise")
         wd = None if quant_wire else wire_dtype
 
+        # ZeRO composition (ops/zero.py): with HVDT_ZERO live, each VJP
+        # segment's exchange rides the reduce-scatter wire (rs_exchange:
+        # per-bucket reduce-scatter + invariant allgather, itself
+        # payload-chain pinned when this scheduler is on) — the traced
+        # program interleaves reduce-scatters with backward compute,
+        # the lowered-HLO contract tests/test_zero.py pins.
+        from . import zero as _zero
+
+        zero_stage = _zero.stage()
+
         grads: List[Any] = [None] * len(stage_fns)
         token = None
         ct = jnp.ones_like(loss)
@@ -472,7 +482,17 @@ def overlap_value_and_grad(stage_fns: Sequence[Callable],
                 g_p, ct = vjps[i](ct)
             if reduce_grads:
                 leaves, treedef = jax.tree.flatten(g_p)
-                if leaves:
+                if leaves and zero_stage is not None:
+                    g_p = _zero.rs_exchange(
+                        g_p, axis, op, threshold_bytes=threshold,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        wire_dtype=wd if not quant_wire
+                        else "int8_blockwise")
+                    token = _payload_token(jnp.ravel(leaves[0]))
+                    if i > 0:
+                        ct, _ = lax.optimization_barrier((ct, token))
+                elif leaves:
                     cells, token = _exchange_leaves(
                         leaves, axis, op, threshold, prescale_factor,
                         postscale_factor, wd, quant_wire, token)

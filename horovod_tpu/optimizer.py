@@ -65,13 +65,27 @@ def microbatch_gradients(grad_fn, params, batch, num_microbatches: int,
 
     micro = jax.tree.map(reshape, batch)
 
+    # Accumulate float gradients in f32 regardless of the compute dtype:
+    # summing k bf16 micro-gradients in bf16 loses low bits every add
+    # (8 mantissa bits — by 8 microbatches the accumulated drift is
+    # visible in the loss trajectory; tests/test_zero.py pins the
+    # regression).  One widen per micro-step, one cast back at the end.
+    def acc_dtype(t):
+        return (jnp.float32
+                if jnp.issubdtype(jnp.result_type(t), jnp.floating)
+                else jnp.result_type(t))
+
     def body(acc, mb):
         g = grad_fn(params, mb)
-        return jax.tree.map(jnp.add, acc, g), None
+        return jax.tree.map(
+            lambda a, gg: a + gg.astype(a.dtype), acc, g), None
 
-    zero = jax.tree.map(jnp.zeros_like, params)
+    zero = jax.tree.map(
+        lambda t: jnp.zeros(t.shape, acc_dtype(t)), params)
     total, _ = jax.lax.scan(body, zero, micro)
-    total = jax.tree.map(lambda t: t / num_microbatches, total)
+    total = jax.tree.map(
+        lambda t, p: (t / num_microbatches).astype(
+            jnp.result_type(p)), total, params)
     from .ops.compression import Compression as _C
 
     return allreduce_gradients(total, axis=axis, op=op,
@@ -120,7 +134,8 @@ def allreduce_gradients(grads, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
                         compression: Compressor = Compression.none,
                         threshold_bytes: Optional[int] = None,
                         prescale_factor: float = 1.0,
-                        postscale_factor: float = 1.0):
+                        postscale_factor: float = 1.0,
+                        _exchange: Optional[Any] = None):
     """Functional gradient allreduce for custom train steps.
 
     The building block DistributedOptimizer uses; exposed for users who
@@ -177,9 +192,12 @@ def allreduce_gradients(grads, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
         # monolithic fused_allreduce for the dependency-ordered bucket
         # schedule; off/unset returns fused_allreduce ITSELF (identity
         # contract — the pre-existing code object, zero wrappers).
+        # ``_exchange`` is the ZeRO hook: the grads-stage comm
+        # transformation passes ops.zero.rs_exchange here so the same
+        # gradient-aware varying logic drives the reduce-scatter wire.
         from .ops.overlap import exchange_fn
 
-        reduced = exchange_fn()(
+        reduced = (_exchange or exchange_fn())(
             [leaves[i] for i in varying_idx], axis=axis, op=op,
             threshold_bytes=threshold_bytes,
             prescale_factor=prescale_factor,
@@ -198,9 +216,22 @@ def DistributedGradientTransformation(
         compression: Compressor = Compression.none,
         threshold_bytes: Optional[int] = None,
         prescale_factor: float = 1.0,
-        postscale_factor: float = 1.0):
-    """An optax transformation that allreduces incoming gradients."""
+        postscale_factor: float = 1.0,
+        zero: Optional[Any] = None):
+    """An optax transformation that allreduces incoming gradients.
+
+    ``zero`` (default: the ``HVDT_ZERO`` env stage) at ``grads`` or
+    beyond swaps the fused-allreduce wire for the explicit
+    reduce-scatter + invariant-allgather split (ops/zero.rs_exchange —
+    same reduced values, deferrable allgather); unset keeps the
+    pre-existing replicated exchange as the identical code objects.
+    """
     import optax
+
+    from .ops import zero as _zero
+
+    stage = _zero.resolve_stage(zero)
+    exchange = None if stage is None else _zero.rs_exchange
 
     def init_fn(params):
         del params
@@ -212,7 +243,8 @@ def DistributedGradientTransformation(
             updates, axis=axis, op=op, compression=compression,
             threshold_bytes=threshold_bytes,
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+            postscale_factor=postscale_factor,
+            _exchange=exchange)
         return updates, state
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -226,7 +258,8 @@ def DistributedOptimizer(optimizer,
                          backward_passes_per_step: int = 1,
                          threshold_bytes: Optional[int] = None,
                          prescale_factor: float = 1.0,
-                         postscale_factor: float = 1.0):
+                         postscale_factor: float = 1.0,
+                         zero: Optional[Any] = None):
     """Wrap an optax optimizer so gradients are averaged across the mesh
     axis before the update (ref: torch/optimizer.py:516 DistributedOptimizer
     factory; same call-shape philosophy: wrap and use as usual).
@@ -255,11 +288,33 @@ def DistributedOptimizer(optimizer,
         (``HVDT_COMPRESSION`` / ``HVDT_QUANT`` — Compression.from_env).
       backward_passes_per_step: accumulate this many micro-batch gradients
         locally between collectives (ref: gradient_aggregation.py).
+      zero: ZeRO state-sharding stage (ops/zero.py) — ``"grads"`` (the
+        reduce-scatter wire, any optax optimizer), ``"states"``
+        (sharded moments + shard-local fused update + delta allgather;
+        requires ``hvd.fused_adam``/``hvd.fused_sgd``), ``"params"``
+        (params sharded between steps), a ``zero.ZeroSpec`` for explicit
+        ``num_shards``/threshold, or None (default) to read
+        ``HVDT_ZERO``.  Unset/off keeps the replicated chain as the
+        identical pre-existing code objects (identity-tested).
     """
     import optax
 
+    from .ops import zero as _zero
+
+    _stage = _zero.resolve_stage(zero)
     if compression is None:
         compression = Compression.from_env()
+    if _stage in ("states", "params"):
+        zspec = zero if isinstance(zero, _zero.ZeroSpec) else None
+        return _zero.zero_from_optimizer(
+            optimizer, stage=_stage, axis=axis, op=op,
+            num_shards=(zspec.num_shards if zspec else None),
+            threshold_bytes=(threshold_bytes if threshold_bytes is not None
+                             else (zspec.threshold_bytes if zspec
+                                   else None)),
+            wire_dtype=compression.wire_dtype,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
     from .telemetry.instrument import get_recorder
 
     _rec = get_recorder()
@@ -277,7 +332,7 @@ def DistributedOptimizer(optimizer,
     comm = DistributedGradientTransformation(
         axis=axis, op=op, compression=compression,
         threshold_bytes=threshold_bytes, prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor)
+        postscale_factor=postscale_factor, zero=_stage)
     if backward_passes_per_step > 1:
         # Communication precedes accumulation so every value MultiSteps
         # holds across its internal lax.cond is replicated (type-stable

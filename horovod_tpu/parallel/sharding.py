@@ -18,6 +18,7 @@ from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP
 __all__ = [
     "pcast_to_union",
     "transformer_rules", "logical_to_mesh", "named_sharding", "batch_spec",
+    "fsdp_shardings",
 ]
 
 MeshAxes = Union[None, str, Tuple[str, ...]]
@@ -108,6 +109,32 @@ def batch_spec(mesh: Optional[Mesh] = None, *, seq_sharded: bool = False,
         rules = transformer_rules()
     logical = ("batch", "seq" if seq_sharded else None)
     return logical_to_mesh(logical, rules, mesh)
+
+
+def fsdp_shardings(mesh: Mesh, logical_tree,
+                   rules: Optional[Mapping[str, MeshAxes]] = None):
+    """Per-leaf ``NamedSharding``s that shard parameters over the
+    ``fsdp`` mesh axis — the ZeRO-3 "params" layout for the GSPMD-auto
+    path (``HVDT_ZERO=params``, ops/zero.py).
+
+    ``logical_tree`` is a same-structure pytree of logical axis tuples
+    (e.g. ``models.transformer_logical_axes``); rules default to
+    ``transformer_rules(fsdp=True)``, so ``embed`` dims land on
+    ``AXIS_FSDP``.  ``jax.device_put`` params with these shardings and
+    a jitted forward allgathers each layer's weights **on demand, per
+    layer** — XLA inserts the gather right before the first use and
+    frees the full tensor after the last, which is exactly the
+    deferred-materialization half of ZeRO-3 (the manual-shard_map half
+    lives in ``ops.zero.ZeroTransformation.gather_params``).
+    """
+    import jax
+
+    if rules is None:
+        rules = transformer_rules(fsdp=True)
+    return jax.tree.map(
+        lambda logical: NamedSharding(
+            mesh, logical_to_mesh(logical, rules, mesh)),
+        logical_tree, is_leaf=lambda x: isinstance(x, tuple))
 
 
 def pcast_to_union(x, *operands, extra=()):

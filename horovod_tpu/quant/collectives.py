@@ -47,6 +47,8 @@ from . import kernels as qk
 
 __all__ = ["quantized_allreduce_flat", "quantized_allreduce",
            "quantized_allreduce_start", "quantized_allreduce_finish",
+           "quantized_reduce_scatter_start",
+           "quantized_reduce_scatter_finish",
            "InflightQuantized", "eager_quantized_allreduce", "INT8_WIRE"]
 
 # Sentinel a Compressor exposes as ``wire_dtype`` to select this path in
@@ -198,6 +200,39 @@ def quantized_allreduce_finish(inflight: InflightQuantized,
     if total != size:
         out = out[:size]
     return out.astype(dtype)
+
+
+def quantized_reduce_scatter_start(flat, axis="dp",
+                                   op: ReduceOp = ReduceOp.SUM,
+                                   block_size: Optional[int] = None,
+                                   prescale_factor: float = 1.0
+                                   ) -> InflightQuantized:
+    """The int8-wire **reduce-scatter** half of the two-stage collective
+    — stage 1-2 only (quantize + wire-format all_to_all).  Identical to
+    :func:`quantized_allreduce_start`; named separately because the
+    ZeRO exchange (ops/zero.py) consumes the *shard*, never the
+    reassembled vector: the established quant seam splits exactly at the
+    reduce-scatter / dequant-accumulate boundary."""
+    return quantized_allreduce_start(flat, axis, op, block_size,
+                                     prescale_factor)
+
+
+def quantized_reduce_scatter_finish(inflight: InflightQuantized):
+    """Stage 3 only: dequantize-accumulate this rank's shard in f32 and
+    return it (``[inflight.shard]`` elements, this rank's contiguous
+    chunk of the padded vector) — no requantize, no reassembly.  The
+    shard carries only stage-1 quantization error (each rank's block
+    scale / 2); the ZeRO update consumes it directly and allgathers
+    exact parameter deltas instead of a requantized gradient."""
+    block, n = inflight.block, inflight.n
+    shard = inflight.shard
+    q_recv, s_recv = inflight.q_recv, inflight.s_recv
+    contrib = (q_recv.reshape(n, shard // block, block).astype(jnp.float32)
+               * s_recv[:, :, None])
+    acc = jnp.sum(contrib, axis=0).reshape(-1)
+    if inflight.op == ReduceOp.AVERAGE:
+        acc = acc * (1.0 / n)
+    return acc
 
 
 def quantized_allreduce_flat(flat, axis="dp",

@@ -116,6 +116,19 @@ KNOB_FLAGS: List[_Flag] = [
           "reduce groups then run the hierarchical allreduce "
           "(fast-axis reduce-scatter -> slow-axis shard exchange -> "
           "allgather); workers validate the grammar in hvd.init()."),
+    _Flag("--zero", "zero", "HVDT_ZERO", "params", "zero",
+          "ZeRO state-sharding stage on every worker (ops/zero.py): "
+          "grads (reduce-scatter + allgather wire split), states "
+          "(sharded optimizer moments, shard-local fused updates, "
+          "parameter-delta allgather — optimizer HBM ~1/n), or params "
+          "(parameters sharded between steps, gathered on demand).  "
+          "Workers validate the stage in hvd.init()."),
+    _Flag("--remat", "remat", "HVDT_REMAT", "params", "remat",
+          "Activation rematerialization for the transformer block "
+          "(none|full|dots): jax.checkpoint policy applied by "
+          "models.remat_from_env — the memory-for-MFU trade next to "
+          "--zero ('dots' falls back to 'full' on jax builds without "
+          "the policy)."),
     # --- autotune ---
     _Flag("--autotune", "autotune", "HVDT_AUTOTUNE", "autotune", "enabled",
           "Enable Bayesian autotuning of fusion knobs.", is_bool=True,

@@ -274,6 +274,17 @@ def bind_process_gauges(registry: Optional[MetricsRegistry] = None) -> None:
         except Exception:
             return float("nan")
 
+    def _hbm_peak() -> float:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if not stats:
+                return float("nan")
+            return float(stats.get("peak_bytes_in_use", float("nan")))
+        except Exception:
+            return float("nan")
+
     reg.gauge(
         "hvdt_process_rss_bytes",
         "Resident set size of this worker process (live /proc probe; "
@@ -288,6 +299,23 @@ def bind_process_gauges(registry: Optional[MetricsRegistry] = None) -> None:
         "Live device memory in use (jax.Device.memory_stats; nan on CPU "
         "backends and jax builds where memory_stats returns None)"
     ).set_function(_hbm)
+    reg.gauge(
+        "hvdt_hbm_peak_bytes",
+        "Peak device memory in use since process start "
+        "(jax.Device.memory_stats peak_bytes_in_use; nan where "
+        "unavailable) — pair with hvdt_param_bytes / "
+        "hvdt_optimizer_state_bytes to see the ZeRO/remat headroom"
+    ).set_function(_hbm_peak)
+    # Memory-accounting gauges (fed by step_stats.record_memory_
+    # accounting — ops/zero.py and bench.py report per-rank
+    # post-sharding bytes): registered here so they exist on /metrics
+    # from init, NaN until the training loop reports.
+    from .step_stats import _MEMORY_GAUGE_DOCS
+
+    for name, doc in _MEMORY_GAUGE_DOCS.items():
+        g = reg.gauge(name, doc)
+        if g.value() == 0.0:
+            g.set(float("nan"))
 
 
 def collect_driver_snapshots(kv_server) -> Dict[int, Dict[str, Any]]:

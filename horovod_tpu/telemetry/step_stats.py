@@ -31,7 +31,8 @@ from typing import Dict, Optional
 from .metrics import Gauge, MetricsRegistry, default_registry
 
 __all__ = ["StepTimer", "GoodputLedger", "peak_flops_for",
-           "bind_resilience_gauges", "PEAK_BY_DEVICE_KIND"]
+           "bind_resilience_gauges", "record_memory_accounting",
+           "tree_bytes", "PEAK_BY_DEVICE_KIND"]
 
 # bf16 peak FLOP/s and HBM byte/s by TPU generation (device_kind
 # substring, lowercase) — promoted from bench.py so MFU math has one
@@ -272,3 +273,71 @@ def bind_resilience_gauges(registry: Optional[MetricsRegistry] = None
         "hvdt_emergency_checkpoints",
         "Preemption-guard emergency checkpoints taken in this process"
     ).set_function(_emergency)
+
+
+def tree_bytes(tree) -> int:
+    """Total array bytes of a pytree (host-side shape math, no device
+    access) — the feed for the memory-accounting gauges."""
+    import numpy as np
+
+    total = 0
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape or (1,))) * np.dtype(dtype).itemsize
+    return int(total)
+
+
+_MEMORY_GAUGE_DOCS = {
+    "hvdt_param_bytes":
+        "Per-rank parameter bytes (post-sharding: the replicated full "
+        "tree, or 1/n of it under HVDT_ZERO=params)",
+    "hvdt_optimizer_state_bytes":
+        "Per-rank optimizer-state bytes (post-sharding: ~1/n of the "
+        "replicated moments under HVDT_ZERO=states/params — the "
+        "ZeRO memory win, observable from one scrape)",
+}
+
+
+def record_memory_accounting(param_bytes: Optional[float] = None,
+                             optimizer_state_bytes: Optional[float] = None,
+                             *, params=None, opt_state=None,
+                             num_shards: int = 1,
+                             zero_stage: str = "off",
+                             registry: Optional[MetricsRegistry] = None
+                             ) -> None:
+    """Feed the per-rank memory-accounting gauges (``hvdt_param_bytes``,
+    ``hvdt_optimizer_state_bytes``).
+
+    Callers pass either precomputed byte counts or the live pytrees
+    (``params=`` / ``opt_state=``, measured with :func:`tree_bytes` and
+    divided by ``num_shards`` for sharded layouts).  No-op when the
+    telemetry subsystem is off — the gauges themselves are registered
+    (NaN) by ``hvd.init()``'s :func:`..telemetry.exporter.
+    bind_process_gauges` so they always appear on /metrics."""
+    from . import instrument
+
+    if instrument.get_recorder() is None and registry is None:
+        return
+    reg = registry if registry is not None else default_registry()
+    n = max(1, int(num_shards))
+    if param_bytes is None and params is not None:
+        param_bytes = tree_bytes(params)
+        if zero_stage == "params":
+            param_bytes //= n
+    if optimizer_state_bytes is None and opt_state is not None:
+        optimizer_state_bytes = tree_bytes(opt_state)
+        if zero_stage in ("states", "params"):
+            optimizer_state_bytes //= n
+    if param_bytes is not None:
+        reg.gauge("hvdt_param_bytes",
+                  _MEMORY_GAUGE_DOCS["hvdt_param_bytes"]).set(
+                      float(param_bytes))
+    if optimizer_state_bytes is not None:
+        reg.gauge("hvdt_optimizer_state_bytes",
+                  _MEMORY_GAUGE_DOCS["hvdt_optimizer_state_bytes"]).set(
+                      float(optimizer_state_bytes))

@@ -8,7 +8,8 @@
 #       quantized-collective + resilience-chaos + telemetry +
 #       tracing/flight-recorder-forensics + overlap-scheduling +
 #       transport-policy/hierarchical-collective +
-#       zero-sharding/reduce-scatter-wire tests on CPU) —
+#       zero-sharding/reduce-scatter-wire +
+#       pod-granular-elastic/multipod-recovery tests on CPU) —
 #       the pre-merge gate.
 set -eu
 only=""

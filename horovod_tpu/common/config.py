@@ -273,6 +273,39 @@ KNOBS: Dict[str, Knob] = {
            "Socket-mesh bootstrap attempts for the native TCP data plane "
            "(shared exponential backoff between tries): peers of a "
            "restarted rank come up at different times."),
+        # --- pod-granular elastic control plane (runner/elastic/pods.py) ---
+        _k("HVDT_POD", "", str,
+           "Pod (TPU slice) id this worker belongs to.  Set per slot by "
+           "the elastic launcher from the discovery script's "
+           "'host[:slots][@pod]' column; read by pod-scoped fault-plan "
+           "entries (pod_crash/pod_partition) and published in the "
+           "telemetry KV snapshot so the driver can aggregate per pod."),
+        _k("HVDT_POD_SIZE", 0, int,
+           "Slots per pod.  Driver side: chunk undeclared discovery "
+           "hosts (in order) into pods of this many slots — the "
+           "alternative to the @pod discovery column.  Worker side: the "
+           "ici extent of the two-level (dcn, ici) mesh contract "
+           "(parallel.mesh.pod_mesh_spec).  0 = per-host pods (the flat "
+           "PR-4 semantics)."),
+        _k("HVDT_POD_EXIT_WINDOW_S", 10.0, float,
+           "Pod exit-correlation window: failure exits of one pod's "
+           "ranks within this many seconds collapse into ONE pod-"
+           "removal event — one blacklist entry, one cooldown clock — "
+           "instead of N independent recovery decisions for what is a "
+           "single correlated slice loss."),
+        _k("HVDT_POD_DRAIN_GRACE_S", 60.0, float,
+           "How long a preemption-drained pod stays excluded from pod "
+           "assignment while waiting for the platform to reclaim its "
+           "hosts; after the grace it becomes placeable again rather "
+           "than stranded (a drain is advisory, not a blacklist)."),
+        _k("HVDT_POD_STRAGGLER_EVICT", 0, int,
+           "Pod-straggler eviction rung: a pod whose median step time "
+           "exceeds HVDT_STRAGGLER_THRESHOLD x the cross-pod median for "
+           "this many consecutive telemetry windows is evicted "
+           "(cooldown blacklist + pod-granular resize down) instead of "
+           "dragging every synchronous step.  0 = disabled.  Needs "
+           "HVDT_TELEMETRY on the workers (the driver aggregates their "
+           "KV snapshots)."),
         # --- logging (ref: HOROVOD_LOG_LEVEL) ---
         _k("HVDT_LOG_LEVEL", "warning", str,
            "trace|debug|info|warning|error|fatal"),

@@ -177,15 +177,23 @@ class ElasticRayExecutor:
         results: Dict[int, Dict[int, Any]] = {}
         results_lock = threading.Lock()
 
+        pending_state = {"n": 0}
+
         def rendezvous_cb(slots: List[SlotInfo], gen: int) -> None:
             spec = "\n".join(
                 f"{s.rank},{s.hostname},{s.local_rank},{s.cross_rank},"
                 f"{s.size},{s.local_size},{s.cross_size}" for s in slots)
             server.put_local(f"/rendezvous/{gen}/spec", spec.encode())
+            # Same pending-base contract as runner/elastic/driver.py:
+            # workers of generation gen baseline against the counter as
+            # of their rendezvous, not whatever it reads at first commit.
+            server.put_local(f"/rendezvous/{gen}/pending_base",
+                             str(pending_state["n"]).encode())
             server.put_local("/rendezvous/version", str(gen).encode())
             server.put_local("/cluster/size", str(len(slots)).encode())
 
         def hosts_updated_cb(n: int) -> None:
+            pending_state["n"] = n
             server.put_local("/rendezvous/pending", str(n).encode())
 
         def spawn_fn(slot: SlotInfo, gen: int) -> int:

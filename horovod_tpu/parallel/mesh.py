@@ -45,7 +45,7 @@ __all__ = [
     "AXIS_DP", "AXIS_FSDP", "AXIS_PP", "AXIS_TP", "AXIS_SP", "AXIS_EP",
     "CANONICAL_AXES", "TRANSPORT_ICI", "TRANSPORT_DCN",
     "TRANSPORT_CLASSES", "axis_transport_class", "split_transport_axes",
-    "MeshSpec", "make_mesh", "mesh_shape_for",
+    "MeshSpec", "make_mesh", "mesh_shape_for", "pod_mesh_spec",
 ]
 
 
@@ -83,6 +83,38 @@ def split_transport_axes(axes: Sequence[str], fast_width: int = 1
         raise ValueError("empty reduce group")
     width = max(1, min(int(fast_width), len(axes) - 1 or 1))
     return axes[:-width], axes[-width:]
+
+
+def pod_mesh_spec(num_pods: Optional[int] = None,
+                  pod_size: Optional[int] = None) -> "MeshSpec":
+    """The two-level data-parallel mesh of the elastic pod contract:
+    axes ``("dcn", "ici")`` sized ``(num_pods, pod_size)``.
+
+    Defaults come from the pod-aware launcher's worker env
+    (``HVDT_NUM_PODS`` / ``HVDT_POD_SIZE``, runner/hosts.SlotInfo.to_env
+    — republished per generation at ``/rendezvous/<gen>/pods``), so a
+    worker rebuilds the right hierarchy after every pod-granular resize.
+    The axis NAMES are the transport classes: ``split_transport_axes``
+    puts ``ici`` in the fast tier and ``dcn`` in the slow one, and the
+    PR-8 policy grammar matches them directly — cross-pod gradient
+    exchange rides the ``dcn`` policy (int8 + error feedback under
+    ``HVDT_TRANSPORT=...,dcn:tree:int8:8M``) with no extra wiring.
+    """
+    import os
+
+    if num_pods is None:
+        num_pods = int(os.environ.get("HVDT_NUM_PODS", "1") or 1)
+    if pod_size is None:
+        pod_size = int(os.environ.get("HVDT_POD_SIZE", "0") or 0)
+        if pod_size <= 0:
+            pod_size = int(os.environ.get("HVDT_SIZE", "1") or 1) \
+                // max(1, num_pods)
+    if num_pods < 1 or pod_size < 1:
+        raise ValueError(
+            f"pod mesh needs num_pods >= 1 and pod_size >= 1, got "
+            f"({num_pods}, {pod_size})")
+    return MeshSpec(axes=((TRANSPORT_DCN, int(num_pods)),
+                          (TRANSPORT_ICI, int(pod_size))))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -25,10 +25,21 @@ i.e. comma-separated ``kind@key=value:key=value`` entries.  Kinds:
   torn-write / disk-rot case the manifest verification must catch.
 * ``kv_drop`` — raise ``ConnectionError`` from rendezvous-KV client ops
   with probability ``p``: a flaky control network.
+* ``pod_crash``  — ``crash`` scoped to a pod: every rank whose
+  ``HVDT_POD`` matches ``pod=`` dies, e.g.
+  ``pod_crash@step=10:pod=podB`` — the correlated whole-slice loss that
+  dominates multi-pod fleets (the elastic driver must collapse it into
+  ONE pod-removal event).
+* ``pod_partition`` — the pod drops off the network for ``secs``: its
+  ranks block at the injection point, so peers see stalled heartbeats /
+  collectives, e.g. ``pod_partition@step=10:pod=podB:secs=20``.
 
 Match keys: ``step`` (fires once at the first point whose step >= it —
 commits are periodic, so exact equality would silently never fire),
-``rank`` (default: any), ``point`` (override the kind's default
+``rank`` (default: any; accepts sets and ranges — ``rank=1,3`` /
+``rank=0-3`` / ``rank=1,4-6`` — so targeted multi-rank faults and pod
+faults share one parser), ``pod`` (default: any; matched against the
+firing rank's ``HVDT_POD``), ``point`` (override the kind's default
 injection point), ``p`` (probability per hit, deterministic under
 ``HVDT_FAULT_SEED``), ``times`` (max fires; default 1 for step-matched
 faults, unlimited for probabilistic ones), plus per-kind params
@@ -51,6 +62,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import random
+import re
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -58,11 +70,12 @@ from ..common.exceptions import HorovodInternalError
 from ..common.logging_util import get_logger
 
 __all__ = ["InjectedFault", "FaultSpec", "FaultInjector", "parse_plan",
-           "get_injector", "instrument", "configure"]
+           "parse_rank_set", "get_injector", "instrument", "configure"]
 
 log = get_logger(__name__)
 
-KINDS = ("crash", "hang", "exc", "corrupt_ckpt", "kv_drop")
+KINDS = ("crash", "hang", "exc", "corrupt_ckpt", "kv_drop",
+         "pod_crash", "pod_partition")
 
 # Default injection point per kind (spec may override with point=).
 _DEFAULT_POINT = {
@@ -71,6 +84,8 @@ _DEFAULT_POINT = {
     "exc": "step",
     "corrupt_ckpt": "checkpoint.save",
     "kv_drop": "kv",
+    "pod_crash": "step",
+    "pod_partition": "step",
 }
 
 
@@ -81,12 +96,43 @@ class InjectedFault(HorovodInternalError):
     injected case specifically."""
 
 
+def parse_rank_set(val: Any) -> frozenset:
+    """``1`` / ``"1,3"`` / ``"0-3"`` / ``"1,4-6"`` → frozenset of ranks
+    (shared by targeted multi-rank faults and tests)."""
+    if isinstance(val, int):
+        return frozenset((val,))
+    if isinstance(val, (set, frozenset, list, tuple)):
+        return frozenset(int(v) for v in val)
+    out = set()
+    for part in str(val).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lo, sep, hi = part.partition("-")
+        try:
+            if sep:
+                lo_i, hi_i = int(lo), int(hi)
+                if hi_i < lo_i:
+                    raise ValueError
+                out.update(range(lo_i, hi_i + 1))
+            else:
+                out.add(int(part))
+        except ValueError:
+            raise ValueError(
+                f"bad rank set {val!r}: expected ranks like '1', '1,3' "
+                f"or '0-3', got {part!r}") from None
+    if not out:
+        raise ValueError(f"bad rank set {val!r}: empty")
+    return frozenset(out)
+
+
 @dataclasses.dataclass
 class FaultSpec:
     kind: str
     point: str
     step: Optional[int] = None
-    rank: Optional[int] = None
+    rank: Any = None        # int | "1,3" | "0-3" | iterable; see ranks
+    pod: Optional[str] = None
     p: Optional[float] = None
     secs: float = 30.0
     code: int = 1
@@ -97,16 +143,26 @@ class FaultSpec:
         if self.kind not in KINDS:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; valid: {', '.join(KINDS)}")
+        self.ranks: Optional[frozenset] = (
+            parse_rank_set(self.rank) if self.rank is not None else None)
+        if self.ranks is not None and len(self.ranks) == 1:
+            # Singleton sets stay a plain int on .rank — the pre-set-
+            # grammar surface every existing caller reads.
+            self.rank = next(iter(self.ranks))
         if self.times is None:
             self.times = 1 if self.p is None else None  # None = unlimited
 
     def matches(self, point: str, step: Optional[int],
-                rank: Optional[int], rng: random.Random) -> bool:
+                rank: Optional[int], rng: random.Random,
+                pod: Optional[str] = None) -> bool:
         if self.times is not None and self.fired >= self.times:
             return False
         if point != self.point:
             return False
-        if self.rank is not None and rank != self.rank:
+        if self.ranks is not None and rank not in self.ranks:
+            return False
+        if self.pod is not None and (pod is None
+                                     or str(pod) != str(self.pod)):
             return False
         if self.step is not None and (step is None or step < self.step):
             return False
@@ -115,15 +171,29 @@ class FaultSpec:
         return True
 
 
+def _split_entries(plan: str) -> List[str]:
+    """Split the comma-separated plan into entries, keeping rank
+    sets/ranges intact: a fragment that is purely digits/ranges (no
+    ``@``, no ``=``) continues the previous entry's rank list —
+    ``crash@step=12:rank=1,3-5,hang@step=30`` is two entries."""
+    entries: List[str] = []
+    for frag in plan.split(","):
+        frag = frag.strip()
+        if not frag:
+            continue
+        if entries and re.fullmatch(r"[\d]+(-[\d]+)?", frag):
+            entries[-1] += f",{frag}"
+        else:
+            entries.append(frag)
+    return entries
+
+
 def parse_plan(plan: str) -> List[FaultSpec]:
     """Parse the comma-separated plan grammar into specs (see module
     docstring).  Raises ValueError on malformed entries — a silently
     dropped fault would void the chaos run's evidence."""
     specs: List[FaultSpec] = []
-    for entry in plan.split(","):
-        entry = entry.strip()
-        if not entry:
-            continue
+    for entry in _split_entries(plan):
         kind, _, rest = entry.partition("@")
         kind = kind.strip()
         kwargs: Dict[str, Any] = {}
@@ -136,15 +206,19 @@ def parse_plan(plan: str) -> List[FaultSpec]:
                         f"got {pair!r}")
                 key = key.strip()
                 val = val.strip()
-                if key in ("step", "rank", "code", "times"):
+                if key in ("step", "code", "times"):
                     kwargs[key] = int(val)
+                elif key == "rank":
+                    kwargs[key] = parse_rank_set(val)
                 elif key in ("p", "secs"):
                     kwargs[key] = float(val)
-                elif key == "point":
+                elif key in ("point", "pod"):
                     kwargs[key] = val
                 else:
                     raise ValueError(
-                        f"fault plan entry {entry!r}: unknown key {key!r}")
+                        f"fault plan entry {entry!r}: unknown key {key!r}; "
+                        f"valid: step, rank, pod, point, p, secs, code, "
+                        f"times")
         point = kwargs.pop("point", None) or _DEFAULT_POINT.get(kind)
         if point is None:
             raise ValueError(f"fault plan entry {entry!r}: unknown fault "
@@ -159,6 +233,12 @@ def _env_rank() -> Optional[int]:
         return int(raw) if raw is not None else None
     except ValueError:
         return None
+
+
+def _env_pod() -> Optional[str]:
+    """The firing rank's pod id (launcher contract HVDT_POD; the
+    discovery ``@pod`` column on the host side)."""
+    return os.environ.get("HVDT_POD") or None
 
 
 class FaultInjector:
@@ -219,14 +299,17 @@ class FaultInjector:
         return sum(self.counters.values())
 
     def fire(self, point: str, step: Optional[int] = None,
-             rank: Optional[int] = None, **ctx: Any) -> None:
+             rank: Optional[int] = None, pod: Optional[str] = None,
+             **ctx: Any) -> None:
         """Run every armed spec matching this injection point.  ``ctx``
         carries point-specific payload (``path=`` for checkpoint
         corruption)."""
         if rank is None:
             rank = _env_rank()
+        if pod is None:
+            pod = _env_pod()
         for i, spec in enumerate(self.specs):
-            if spec.matches(point, step, rank, self._rng):
+            if spec.matches(point, step, rank, self._rng, pod=pod):
                 spec.fired += 1
                 self.counters[spec.kind] = self.counters.get(spec.kind, 0) + 1
                 self._journal(i)
@@ -249,12 +332,17 @@ class FaultInjector:
                  rank: Optional[int], ctx: Dict[str, Any]) -> None:
         log.warning("FAULT INJECTION: %s at point=%s step=%s rank=%s",
                     spec.kind, point, step, rank)
-        if spec.kind == "crash":
+        if spec.kind in ("crash", "pod_crash"):
             # os._exit, not sys.exit: a real crash runs no finalizers, no
             # atexit checkpointing, no graceful shutdown — that is the
-            # point.
+            # point.  pod_crash is the same hard death, pod-scoped: each
+            # rank of the matched pod dies at its own injection point,
+            # producing the correlated whole-slice loss.
             self._exit(spec.code)
-        elif spec.kind == "hang":
+        elif spec.kind in ("hang", "pod_partition"):
+            # pod_partition: the matched pod's ranks block here — peers
+            # outside the pod observe stalled heartbeats/collectives,
+            # exactly what a network partition of the slice looks like.
             self._sleep(spec.secs)
         elif spec.kind == "exc":
             raise InjectedFault(

@@ -34,6 +34,9 @@ YAML shape (mirrors the reference's config sections)::
       disabled: false
       warning_time_seconds: 60
       shutdown_time_seconds: 0
+    elastic:
+      pod_size: 4
+      pod_straggler_evict: 3
     telemetry:
       enabled: true
       metrics_port: 9090
@@ -181,8 +184,23 @@ KNOB_FLAGS: List[_Flag] = [
     _Flag("--fault-plan", "fault_plan", "HVDT_FAULT_PLAN",
           "resilience", "fault_plan",
           "Deterministic fault-injection plan for chaos runs, e.g. "
-          "'crash@step=12:rank=1,kv_drop@p=0.1' "
+          "'crash@step=12:rank=1,3' (rank sets/ranges), "
+          "'pod_crash@step=10:pod=podB,kv_drop@p=0.1' "
           "(resilience/faults.py grammar)."),
+    # --- elastic / pods ---
+    _Flag("--pod-size", "pod_size", "HVDT_POD_SIZE",
+          "elastic", "pod_size",
+          "Slots per pod for the pod-granular elastic control plane: "
+          "groups discovery hosts without an @pod column into pods of "
+          "this many slots; resize/blacklist/recovery then happen at "
+          "pod granularity and workers get the two-level (dcn, ici) "
+          "mesh contract (HVDT_NUM_PODS/HVDT_POD_SIZE).", type=int),
+    _Flag("--pod-straggler-evict", "pod_straggler_evict",
+          "HVDT_POD_STRAGGLER_EVICT", "elastic", "pod_straggler_evict",
+          "Evict a pod whose median step time exceeds the straggler "
+          "threshold for this many consecutive telemetry windows "
+          "(0 = off; needs --telemetry so workers publish snapshots).",
+          type=int),
     _Flag("--blacklist-cooldown", "blacklist_cooldown",
           "HVDT_ELASTIC_BLACKLIST_COOLDOWN_S", "resilience",
           "blacklist_cooldown_s",

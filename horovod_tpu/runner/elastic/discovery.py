@@ -83,13 +83,21 @@ class DiscoveredHosts:
 
 class HostManager:
     """Runs the discovery function, applies the blacklist, reports diffs
-    (ref: discovery.py HostManager.update_available_hosts)."""
+    (ref: discovery.py HostManager.update_available_hosts).
+
+    Blacklisting is **pod-granular**: a pod (declared via the discovery
+    script's ``@pod`` column, ``host[:slots][@pod]``) shares one
+    :class:`HostState`, so one correlated pod loss costs one cooldown
+    clock — N ranks of a dying slice must not double the cooldown N
+    times.  Hosts with no declared pod key their state by hostname,
+    which is exactly the PR-4 per-host behavior."""
 
     def __init__(self, discover: Callable[[], List[HostInfo]],
                  default_slots: int = 1):
         self._discover = discover
         self._default_slots = default_slots
-        self._states: Dict[str, HostState] = {}
+        self._states: Dict[str, HostState] = {}   # keyed per pod
+        self._pod_of: Dict[str, str] = {}         # hostname -> pod key
         self.current = DiscoveredHosts([])
 
     @classmethod
@@ -109,21 +117,42 @@ class HostManager:
                 if line:
                     h = HostInfo.from_string(line)
                     if h.slots == 1 and ":" not in line:
-                        h = HostInfo(h.hostname, default_slots)
+                        h = HostInfo(h.hostname, default_slots, h.pod)
                     hosts.append(h)
             return hosts
         return cls(discover, default_slots)
 
+    def pod_of(self, hostname: str) -> str:
+        """The blacklist key for ``hostname``: its declared pod, or the
+        hostname itself when no pod was declared."""
+        return self._pod_of.get(hostname, hostname)
+
     def blacklist(self, hostname: str) -> None:
-        self._states.setdefault(hostname, HostState()).blacklist()
+        self.blacklist_pod(self.pod_of(hostname))
+
+    def blacklist_pod(self, pod: str) -> None:
+        self._states.setdefault(pod, HostState()).blacklist()
 
     def is_blacklisted(self, hostname: str) -> bool:
-        st = self._states.get(hostname)
+        return self.is_pod_blacklisted(self.pod_of(hostname))
+
+    def is_pod_blacklisted(self, pod: str) -> bool:
+        st = self._states.get(pod)
         return st is not None and st.is_blacklisted
+
+    def pod_failures(self, pod: str) -> int:
+        """Blacklist entries recorded against ``pod`` — the audit the
+        pod-removal correlation is judged by (one correlated pod loss
+        must cost exactly one entry)."""
+        st = self._states.get(pod)
+        return st.failures if st is not None else 0
 
     def update_available_hosts(self) -> bool:
         """Re-run discovery; returns True if the usable host set changed."""
         raw = self._discover()
+        for h in raw:
+            if h.pod:
+                self._pod_of[h.hostname] = h.pod
         usable = [h for h in raw if not self.is_blacklisted(h.hostname)]
         snapshot = DiscoveredHosts(usable)
         changed = snapshot != self.current

@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional
 from .. import hosts as hosts_mod
 from ..http_kv import RendezvousServer, new_secret
 from ..safe_shell_exec import safe_execute
+from . import pods as pods_mod
 from .discovery import HostManager
 from .registration import WorkerStateRegistry, READY, SUCCESS, FAILURE
 
@@ -64,7 +65,9 @@ class ElasticDriver:
                  discovery_interval: float = _DISCOVERY_INTERVAL_S,
                  kv_server: Optional[RendezvousServer] = None,
                  hosts_updated_cb: Optional[Callable[[int], None]] = None,
-                 elastic_timeout: float = 600.0):
+                 elastic_timeout: float = 600.0,
+                 pod_slots: int = 0,
+                 pod_tracker: Optional[pods_mod.PodTracker] = None):
         self._hm = host_manager
         self._kv = kv_server
         self._hosts_updated_cb = hosts_updated_cb
@@ -74,6 +77,12 @@ class ElasticDriver:
         self._spawn_fn = spawn_fn or (lambda slot, gen: 0)
         self._interval = discovery_interval
         self._elastic_timeout = elastic_timeout
+        # Pod-granular control plane (runner/elastic/pods.py): exit
+        # correlation, preemption drains, straggler eviction.  With no
+        # declared pods and pod_slots=0 everything degenerates to the
+        # flat per-host semantics.
+        self._pod_slots = pod_slots
+        self._pods = pod_tracker or pods_mod.PodTracker()
         self.registry = WorkerStateRegistry(self._on_barrier,
                                             reset_limit=reset_limit)
         self._lock = threading.Lock()
@@ -129,6 +138,7 @@ class ElasticDriver:
             if changed:
                 self._notify_hosts_updated()
             self._poll_worker_registry()
+            self._check_pod_stragglers()
 
     def _poll_worker_registry(self) -> None:
         """Feed KV-reported worker states (workers put
@@ -166,7 +176,11 @@ class ElasticDriver:
         HVDT_TELEMETRY_PUBLISH_S when HVDT_TELEMETRY is on).  Returns
         {rank: snapshot_dict}; empty when no KV or nothing published —
         the driver-side half of the observability subsystem
-        (telemetry/exporter.collect_driver_snapshots)."""
+        (telemetry/exporter.collect_driver_snapshots).  Each snapshot
+        carries the worker's pod id plus its kv_retries_total /
+        kv_errors_total counters, so control-plane flakiness is visible
+        fleet-wide from the driver; the snapshots also feed the
+        pod-straggler eviction rung (_check_pod_stragglers)."""
         if self._kv is None:
             return {}
         from ...telemetry.exporter import collect_driver_snapshots
@@ -195,6 +209,37 @@ class ElasticDriver:
 
         return collect_server_events(self._kv)
 
+    def _check_pod_stragglers(self) -> None:
+        """The pod-granular escalation rung over the PR-5 straggler
+        gauges: aggregate per-rank step-time medians from the telemetry
+        snapshots into per-pod medians; a pod slower than threshold x
+        the cross-pod median for HVDT_POD_STRAGGLER_EVICT consecutive
+        windows is EVICTED — blacklisted (cooldown applies, so a
+        recovered pod can rejoin) and the run resizes down to the
+        remaining pod multiple instead of limping at the slow pod's
+        pace."""
+        if self._pods.evict_windows <= 0 or self._kv is None:
+            return
+        snaps = self.telemetry_snapshots()
+        if not snaps or not self._pods.snapshots_fingerprint(snaps):
+            return
+        rank_pod = {s.rank: s.pod for s in self.assignments}
+        by_pod: Dict[str, List[float]] = {}
+        for rank, snap in snaps.items():
+            ms = snap.get("step_time_p50_ms")
+            pod = snap.get("pod") or rank_pod.get(rank)
+            if ms and pod:
+                by_pod.setdefault(pod, []).append(float(ms))
+        medians = {p: sorted(v)[(len(v) - 1) // 2]
+                   for p, v in by_pod.items()}
+        for pod in self._pods.observe_step_medians(medians):
+            print(f"elastic: pod {pod} evicted as straggler "
+                  f"(median step {medians[pod]:.1f} ms over "
+                  f"{self._pods.evict_windows} windows)", file=sys.stderr)
+            self._hm.blacklist_pod(pod)
+            self._hm.update_available_hosts()
+            self._notify_hosts_updated()
+
     def _notify_hosts_updated(self) -> None:
         with self._cond:
             self._cond.notify_all()
@@ -206,19 +251,28 @@ class ElasticDriver:
         if self._hosts_updated_cb is not None:
             self._hosts_updated_cb(n)
 
+    def _usable_slots(self) -> int:
+        """Slots assignable at pod granularity: whole same-size pods
+        only, minus drained (preempted) pods — so the rendezvous wait
+        doesn't end on a half-discovered pod it can't place."""
+        return pods_mod.usable_slots(self._hm.current.hosts,
+                                     self._pod_slots,
+                                     self._pods.drained_pods())
+
     def wait_for_available_slots(self, min_np: int,
                                  timeout: float = 600.0) -> None:
-        """(ref: driver.py:145) block until discovery reports >= min_np."""
+        """(ref: driver.py:145) block until discovery reports >= min_np
+        pod-assignable slots."""
         deadline = time.monotonic() + timeout
         with self._cond:
-            while self._hm.current.available_slots < min_np:
+            while self._usable_slots() < min_np:
                 if self._shutdown.is_set():
                     raise RuntimeError("driver shut down while waiting")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
                         f"timed out waiting for {min_np} slots; discovered "
-                        f"{self._hm.current.available_slots}")
+                        f"{self._usable_slots()}")
                 self._cond.wait(min(remaining, self._interval))
 
     # -- rendezvous / spawn ------------------------------------------------
@@ -229,9 +283,17 @@ class ElasticDriver:
         with self._lock:
             self._generation += 1
             gen = self._generation
-            self._assignments = hosts_mod.get_host_assignments(
-                self._hm.current.hosts, self._min_np, self._max_np)
+            self._assignments = pods_mod.plan_assignments(
+                self._hm.current.hosts, self._min_np, self._max_np,
+                pod_slots=self._pod_slots,
+                exclude=self._pods.drained_pods())
             self.registry.reset(len(self._assignments))
+        layout = pods_mod.pod_layout(self._assignments)
+        print(f"elastic: rendezvous generation {gen}: "
+              f"{len(self._assignments)} slots in {layout['num_pods']} "
+              f"pod(s) x {layout['pod_size']} "
+              f"(dcn={layout['mesh']['dcn']}, ici={layout['mesh']['ici']})",
+              file=sys.stderr)
         if self._rendezvous_cb:
             self._rendezvous_cb(self._assignments, gen)
         for slot in self._assignments:
@@ -260,6 +322,7 @@ class ElasticDriver:
         with self._lock:
             if gen != self._generation:
                 return   # stale worker from a previous generation
+        pod = slot.pod or self._hm.pod_of(slot.hostname)
         if code == RESTART_EXIT_CODE:
             # Worker observed a membership change and exited for respawn:
             # it is READY for the next rendezvous, not failed.
@@ -267,20 +330,33 @@ class ElasticDriver:
             return
         if code == PREEMPT_EXIT_CODE:
             # Clean preemption exit (resilience/preempt.py): the worker
-            # checkpointed and its host is going away.  No blacklist, no
-            # failure count — just re-rendezvous; discovery drops the
-            # host once the platform reclaims it.
-            print(f"elastic: rank {slot.rank} preempted on "
-                  f"{slot.hostname} (clean removal)", file=sys.stderr)
+            # checkpointed and its host is going away.  Preemption
+            # reclaims whole slices, so ONE rank's grace-window exit
+            # drains its entire pod: the next rendezvous won't place
+            # workers on the pod's other hosts even while discovery
+            # still lists them.  No blacklist, no failure count.
+            if self._pods.drain(pod):
+                print(f"elastic: pod {pod} draining (rank {slot.rank} "
+                      f"preempted on {slot.hostname}, clean removal)",
+                      file=sys.stderr)
             self.registry.record_ready(slot.rank)
             return
         if code == 0:
             self.registry.record_success(slot.rank)
         else:
-            # Failed worker ⇒ suspect host (ref: driver.py:297 exit
-            # handling + discovery blacklist).
-            self._hm.blacklist(slot.hostname)
-            self._hm.update_available_hosts()
+            # Failed worker ⇒ suspect POD (ref: driver.py:297 exit
+            # handling + discovery blacklist).  Exits of one pod's ranks
+            # within HVDT_POD_EXIT_WINDOW_S are one correlated loss:
+            # the first opens the pod-removal event and blacklists the
+            # pod ONCE; the rest fold into it (no cooldown doubling, no
+            # N independent recovery decisions).
+            if self._pods.record_failure(pod):
+                print(f"elastic: pod-removal event for pod {pod} "
+                      f"(rank {slot.rank} on {slot.hostname} exited "
+                      f"{code}); correlated exits within the window "
+                      f"fold into this event", file=sys.stderr)
+                self._hm.blacklist_pod(pod)
+                self._hm.update_available_hosts()
             self.registry.record_failure(slot.rank)
 
     # -- barrier -----------------------------------------------------------
@@ -356,14 +432,31 @@ def run_elastic(args) -> int:
         addr = _nic_addr(args.nics.split(",")) or addr
     coordinator_port = args.coordinator_port
 
+    pending_state = {"n": 0}
+
     def rendezvous_cb(slots: List[hosts_mod.SlotInfo], gen: int) -> None:
+        import json as _json
+
         spec = "\n".join(
             f"{s.rank},{s.hostname},{s.local_rank},{s.cross_rank},"
-            f"{s.size},{s.local_size},{s.cross_size}" for s in slots)
+            f"{s.size},{s.local_size},{s.cross_size},"
+            f"{s.pod},{s.pod_index},{s.pod_rank}" for s in slots)
         server.put_local(f"/rendezvous/{gen}/spec", spec.encode())
+        # Freeze the pending-updates counter as of this rendezvous so
+        # generation-gen workers baseline against it (worker.py init):
+        # membership changes during their boot window stay visible.
+        server.put_local(f"/rendezvous/{gen}/pending_base",
+                         str(pending_state["n"]).encode())
+        # Two-level rendezvous: the (dcn, ici) pod layout next to the
+        # flat spec — what a worker needs to build the hierarchical
+        # mesh (parallel.mesh.pod_mesh_spec) whose cross-pod axis rides
+        # the dcn transport policy.
+        server.put_local(f"/rendezvous/{gen}/pods", _json.dumps(
+            pods_mod.pod_layout(slots)).encode())
         server.put_local("/rendezvous/version", str(gen).encode())
 
     def hosts_updated_cb(n: int) -> None:
+        pending_state["n"] = n
         server.put_local("/rendezvous/pending", str(n).encode())
 
     def spawn_fn(slot: hosts_mod.SlotInfo, gen: int) -> int:
@@ -383,11 +476,26 @@ def run_elastic(args) -> int:
         prefix = f"[{slot.rank}]" if args.verbose else ""
         return safe_execute(cmd, env=env, prefix=prefix)
 
+    def _int_knob(name: str) -> int:
+        raw = knob_env.get(name) or os.environ.get(name) or "0"
+        try:
+            return int(raw)
+        except ValueError:
+            return 0
+
+    tracker = pods_mod.PodTracker(
+        evict_windows=_int_knob("HVDT_POD_STRAGGLER_EVICT") or None)
+    # kv_server wires the driver-side KV consumers: worker state
+    # publishes (/registry), telemetry snapshot aggregation, and the
+    # pod-straggler eviction rung those snapshots feed.
     driver = ElasticDriver(hm, min_np, max_np, spawn_fn,
                            reset_limit=args.reset_limit,
+                           kv_server=server,
                            hosts_updated_cb=hosts_updated_cb,
                            elastic_timeout=getattr(args, "elastic_timeout",
-                                                   600.0))
+                                                   600.0),
+                           pod_slots=_int_knob("HVDT_POD_SIZE"),
+                           pod_tracker=tracker)
     try:
         driver.start(rendezvous_cb)
         code = driver.wait()

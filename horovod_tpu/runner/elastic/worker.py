@@ -46,8 +46,24 @@ class WorkerNotificationManager:
         if self._generation is None:
             self._generation = int(os.environ.get("HVDT_GENERATION", 0))
         # Baseline the pending-updates counter: host changes that led to
-        # OUR generation's rendezvous are already accounted for.
-        self._last_pending = self._read_pending()
+        # OUR generation's rendezvous are already accounted for.  Prefer
+        # the generation-scoped base the driver froze AT our rendezvous
+        # (/rendezvous/<gen>/pending_base): baselining on the *current*
+        # counter instead would swallow any membership change that lands
+        # between our spawn and our first commit — e.g. a blacklisted
+        # pod rejoining after cooldown while this generation is still
+        # booting, which must trigger a scale-up, not be ignored.
+        base = None
+        if self._client is not None:
+            try:
+                raw = self._client.get(
+                    f"/rendezvous/{self._generation}/pending_base")
+            except (ConnectionError, OSError):
+                raw = None
+            if raw is not None:
+                base = int(raw)
+        self._last_pending = base if base is not None \
+            else self._read_pending()
 
     def _read_pending(self) -> int:
         if self._client is None:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 __all__ = ["HostInfo", "SlotInfo", "parse_hosts", "parse_host_files",
            "get_host_assignments", "rank_env_from_hosts"]
@@ -21,13 +21,20 @@ __all__ = ["HostInfo", "SlotInfo", "parse_hosts", "parse_host_files",
 class HostInfo:
     hostname: str
     slots: int
+    pod: Optional[str] = None
 
     @classmethod
     def from_string(cls, s: str) -> "HostInfo":
-        m = re.match(r"^(?P<host>[^:]+)(:(?P<slots>\d+))?$", s.strip())
+        """Parse ``host[:slots][@pod]`` — the optional ``@pod`` column is
+        how a discovery script declares which pod (TPU slice) a host
+        belongs to; hosts sharing a pod fail, resize, and blacklist as
+        one unit (runner/elastic/pods.py)."""
+        m = re.match(r"^(?P<host>[^:@]+)(:(?P<slots>\d+))?"
+                     r"(@(?P<pod>[A-Za-z0-9._-]+))?$", s.strip())
         if not m:
             raise ValueError(f"bad host string: {s!r}")
-        return cls(m.group("host"), int(m.group("slots") or 1))
+        return cls(m.group("host"), int(m.group("slots") or 1),
+                   m.group("pod"))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,11 +46,19 @@ class SlotInfo:
     size: int
     local_size: int
     cross_size: int
+    # Pod (two-level) topology: filled by the elastic driver's pod-aware
+    # assignment (runner/elastic/pods.py).  ``pod`` empty = the flat,
+    # pod-less contract (static launch) — to_env then omits HVDT_POD*.
+    pod: str = ""
+    pod_index: int = 0
+    pod_rank: int = 0
+    num_pods: int = 1
+    pod_size: int = 0
 
     def to_env(self) -> Dict[str, str]:
         """The launcher→worker env contract (analog of the reference's
         HOROVOD_RANK/... set at runner/gloo_run.py:65-76)."""
-        return {
+        env = {
             "HVDT_HOSTNAME": self.hostname,
             "HVDT_RANK": str(self.rank),
             "HVDT_SIZE": str(self.size),
@@ -52,6 +67,15 @@ class SlotInfo:
             "HVDT_CROSS_RANK": str(self.cross_rank),
             "HVDT_CROSS_SIZE": str(self.cross_size),
         }
+        if self.pod:
+            env.update({
+                "HVDT_POD": self.pod,
+                "HVDT_POD_INDEX": str(self.pod_index),
+                "HVDT_POD_RANK": str(self.pod_rank),
+                "HVDT_NUM_PODS": str(self.num_pods),
+                "HVDT_POD_SIZE": str(self.pod_size),
+            })
+        return env
 
 
 def parse_hosts(hosts_string: str) -> List[HostInfo]:

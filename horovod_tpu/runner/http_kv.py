@@ -41,6 +41,45 @@ __all__ = ["RendezvousServer", "KVClient", "new_secret"]
 
 _DIGEST_HEADER = "X-HVDT-Digest"
 
+# KV-client observability: until now a flaky control network was
+# *silent* — wait() retried under the hood and nothing counted the
+# failures.  With telemetry on, hvdt_kv_errors_total{op} counts every
+# failed client op and hvdt_kv_retries_total counts the bootstrap-wait
+# retries that papered over them; both land in the worker's KV snapshot,
+# so ElasticDriver.telemetry_snapshots() shows control-plane flakiness
+# fleet-wide.  Telemetry off keeps the zero-overhead contract
+# (_kv_metrics() is None — no registry, no counters, no labels).
+_kv_metrics_cache = None
+
+
+def _kv_metrics():
+    global _kv_metrics_cache
+    from ..telemetry import instrument
+    from ..telemetry.metrics import default_registry
+
+    if not instrument.enabled():
+        _kv_metrics_cache = None
+        return None
+    if _kv_metrics_cache is None:
+        reg = default_registry()
+        _kv_metrics_cache = (
+            reg.counter(
+                "hvdt_kv_retries_total",
+                "Rendezvous-KV bootstrap-wait retries after a failed or "
+                "empty probe (KVClient.wait backoff loop)"),
+            reg.counter(
+                "hvdt_kv_errors_total",
+                "Rendezvous-KV client op failures, labelled op="
+                "put|get|delete (connection refused/reset, non-200, "
+                "injected kv_drop faults)"))
+    return _kv_metrics_cache
+
+
+def _count_kv_error(op: str) -> None:
+    m = _kv_metrics()
+    if m is not None:
+        m[1].inc(op=op)
+
 
 def new_secret() -> bytes:
     return _secrets.token_bytes(32)
@@ -198,42 +237,57 @@ class KVClient:
             inj.fire(point)
 
     def put(self, key: str, value: bytes) -> None:
-        self._fault("kv")
-        c = self._conn()
         try:
-            c.request("PUT", urllib.parse.quote(key), body=value,
-                      headers={_DIGEST_HEADER: _digest(self.secret, value)})
-            r = c.getresponse()
-            r.read()
-            if r.status != 200:
-                raise ConnectionError(f"KV put {key}: HTTP {r.status}")
-        finally:
-            c.close()
+            self._fault("kv")
+            c = self._conn()
+            try:
+                c.request("PUT", urllib.parse.quote(key), body=value,
+                          headers={_DIGEST_HEADER: _digest(self.secret,
+                                                           value)})
+                r = c.getresponse()
+                r.read()
+                if r.status != 200:
+                    raise ConnectionError(f"KV put {key}: HTTP {r.status}")
+            finally:
+                c.close()
+        except (ConnectionError, OSError):
+            _count_kv_error("put")
+            raise
 
     def get(self, key: str) -> Optional[bytes]:
-        self._fault("kv")
-        c = self._conn()
         try:
-            c.request("GET", urllib.parse.quote(key),
-                      headers={_DIGEST_HEADER: _digest(self.secret, b"")})
-            r = c.getresponse()
-            body = r.read()
-            if r.status == 404:
-                return None
-            if r.status != 200:
-                raise ConnectionError(f"KV get {key}: HTTP {r.status}")
-            return body
-        finally:
-            c.close()
+            self._fault("kv")
+            c = self._conn()
+            try:
+                c.request("GET", urllib.parse.quote(key),
+                          headers={_DIGEST_HEADER: _digest(self.secret,
+                                                           b"")})
+                r = c.getresponse()
+                body = r.read()
+                if r.status == 404:
+                    return None
+                if r.status != 200:
+                    raise ConnectionError(f"KV get {key}: HTTP {r.status}")
+                return body
+            finally:
+                c.close()
+        except (ConnectionError, OSError):
+            _count_kv_error("get")
+            raise
 
     def delete(self, key: str) -> None:
-        c = self._conn()
         try:
-            c.request("DELETE", urllib.parse.quote(key),
-                      headers={_DIGEST_HEADER: _digest(self.secret, b"")})
-            c.getresponse().read()
-        finally:
-            c.close()
+            c = self._conn()
+            try:
+                c.request("DELETE", urllib.parse.quote(key),
+                          headers={_DIGEST_HEADER: _digest(self.secret,
+                                                           b"")})
+                c.getresponse().read()
+            finally:
+                c.close()
+        except (ConnectionError, OSError):
+            _count_kv_error("delete")
+            raise
 
     def wait(self, key: str, timeout: float = 60.0,
              poll: float = 0.5) -> bytes:
@@ -254,6 +308,9 @@ class KVClient:
                 val = None
             if val is not None:
                 return val
+            m = _kv_metrics()
+            if m is not None:
+                m[0].inc()
             if not b.sleep():
                 raise TimeoutError(f"KV key {key!r} not published "
                                    f"within {timeout}s")

@@ -24,6 +24,7 @@ scraping N worker endpoints itself.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -66,11 +67,26 @@ def snapshot_dict(registry: Optional[MetricsRegistry] = None
                        ("hvdt_examples_per_sec", "examples_per_sec"),
                        ("hvdt_goodput_fraction", "goodput_fraction"),
                        ("hvdt_straggler_rank", "straggler_rank"),
-                       ("hvdt_step_time_skew", "step_time_skew")):
+                       ("hvdt_step_time_skew", "step_time_skew"),
+                       ("hvdt_straggler_pod", "straggler_pod"),
+                       ("hvdt_pod_step_time_skew", "pod_step_time_skew")):
         g = reg.get(gname)
         if g is not None:
             v = g.value()
             out[key] = round(v, 4) if v == v else None   # NaN-safe
+    # Control-plane flakiness counters (runner/http_kv.py) — surfaced so
+    # ElasticDriver.telemetry_snapshots() sees KV retries/errors per
+    # worker without scraping N endpoints.
+    for cname, key in (("hvdt_kv_retries_total", "kv_retries_total"),
+                       ("hvdt_kv_errors_total", "kv_errors_total")):
+        c = reg.get(cname)
+        if c is not None:
+            out[key] = c.total()
+    # Pod membership (launcher contract): lets the driver aggregate
+    # snapshots per pod for the straggler-eviction rung.
+    pod = os.environ.get("HVDT_POD")
+    if pod:
+        out["pod"] = pod
     return out
 
 

@@ -1,0 +1,188 @@
+"""Multi-pod chaos-recovery training script for the pod battery.
+
+Same KV-heartbeat coupling as ``resilient_main.py`` (the container's
+CPU-only jax cannot run multiprocess XLA collectives; the control-plane
+machinery under test is identical either way), extended with the
+pod-granular legs:
+
+* log lines carry the worker's pod: ``rank size pod batch ts_ms``;
+* the learning rate is constant (not size-scaled) so the loss-continuity
+  witness ``w0 == total_batches * BASE_LR`` holds EXACTLY across
+  pod-granular resizes (4 -> 2 -> 4);
+* env-rank-0 maintains a ZeRO-sharded optimizer-state checkpoint
+  (``checkpoint.save_zero_state`` / ``restore_zero_state``) sharded to
+  the current world size: every generation with a changed dcn extent
+  restores through the PR-9 ``reshard_state`` path and verifies the
+  logical contents survived, appending ``zero <old> -> <new> ok`` to
+  the zero log.
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+from horovod_tpu.resilience.retry import Backoff  # noqa: E402
+
+BASE_LR = 0.1
+ZERO_LOGICAL = 600   # float32 elements in the sharded state's one bucket
+
+
+class LocalSyncJaxState(hvd.elastic.JaxState):
+    """Rank consistency from the shared disk commit (all ranks resume
+    the same ``path``) — no multiprocess data plane on CPU."""
+
+    def sync(self):
+        self.save()
+
+
+def _kv_client():
+    if "HVDT_RENDEZVOUS_ADDR" not in os.environ:
+        return None
+    from horovod_tpu.runner.http_kv import KVClient
+
+    return KVClient.from_env()
+
+
+def _wait_for_peers(kv, my_rank, size, need, timeout_s):
+    """Block until every peer's heartbeat reaches ``need``.  The timeout
+    must stay BELOW the JAX coordination service's own dead-task fatal
+    (~20 s): a survivor has to take the clean HorovodInternalError ->
+    exit-for-respawn path before the service SIGABRTs it."""
+    b = Backoff(first=0.05, cap=0.5, deadline_s=timeout_s)
+    while True:
+        behind = None
+        for r in range(size):
+            if r == my_rank:
+                continue
+            try:
+                raw = kv.get(f"/hb/{r}")
+            except (ConnectionError, OSError):
+                raw = None
+            if raw is None or int(raw) < need:
+                behind = r
+                break
+        if behind is None:
+            return
+        if not b.sleep():
+            raise HorovodInternalError(
+                f"peer {behind} heartbeat stalled below batch {need}")
+
+
+def _zero_roundtrip(zero_dir, zero_log, size):
+    """The dcn-extent resharding witness, run by env-rank-0 in a helper
+    SUBPROCESS before hvd.init() (the restore executes jax computations,
+    which must not precede jax.distributed.initialize in the worker; in
+    the child hvd is uninitialized, so the checkpoint helpers see rank
+    0 / size 1 — no barrier): restore the shared ZeRO state re-sharded
+    to this generation's world size, verify the logical vector
+    survived, save back in the new layout."""
+    from horovod_tpu import checkpoint as ckpt
+    from horovod_tpu.ops import zero as zero_mod
+
+    expect = np.arange(ZERO_LOGICAL, dtype=np.float32)
+    align = zero_mod.shard_align()
+
+    def fresh(n):
+        shard_len = -(-ZERO_LOGICAL // (n * align)) * align
+        flat = np.zeros(n * shard_len, np.float32)
+        flat[:ZERO_LOGICAL] = expect
+        state = zero_mod.ZeroSgdState(
+            trace=(flat.reshape(n, shard_len),))
+        meta = {"zero_stage": "states", "num_shards": n,
+                "threshold_bytes": 0, "align": align,
+                "buckets": [{"size": ZERO_LOGICAL,
+                             "shard_len": shard_len,
+                             "dtype": "float32"}]}
+        return state, meta
+
+    manifest = os.path.join(zero_dir, "zero_manifest.json")
+    if not os.path.exists(manifest):
+        state, meta = fresh(size)
+        ckpt.save_zero_state(zero_dir, state, meta)
+        with open(zero_log, "a") as f:
+            f.write(f"zero init shards={size}\n")
+        return
+    import json
+
+    with open(manifest) as f:
+        saved_shards = int(json.load(f)["meta"]["num_shards"])
+    state, meta, _ = ckpt.restore_zero_state(zero_dir, num_shards=size)
+    got = np.asarray(state.trace[0]).reshape(-1)[:ZERO_LOGICAL]
+    ok = (int(meta["num_shards"]) == size
+          and np.array_equal(got, expect))
+    with open(zero_log, "a") as f:
+        f.write(f"zero {saved_shards} -> {size} "
+                f"{'ok' if ok else 'BAD'}\n")
+    if saved_shards != size:
+        ckpt.save_zero_state(zero_dir, state, meta)
+
+
+def main():
+    log_path = os.environ["ELASTIC_TEST_LOG"]
+    state_path = os.environ["ELASTIC_TEST_STATE"]
+    zero_dir = os.environ["MULTIPOD_ZERO_DIR"]
+    zero_log = os.environ["MULTIPOD_ZERO_LOG"]
+    total_batches = int(os.environ.get("ELASTIC_TEST_BATCHES", "40"))
+    sleep_s = float(os.environ.get("ELASTIC_TEST_SLEEP", "0.1"))
+    hb_timeout_s = float(os.environ.get("ELASTIC_TEST_HB_TIMEOUT", "8"))
+
+    env_rank = int(os.environ.get("HVDT_RANK", 0))
+    env_size = int(os.environ.get("HVDT_SIZE", 1))
+    pod = os.environ.get("HVDT_POD", "?")
+    if "--zero-roundtrip" in sys.argv:
+        _zero_roundtrip(zero_dir, zero_log, env_size)
+        return 0
+    if env_rank == 0:
+        import subprocess
+
+        subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--zero-roundtrip"], check=True)
+
+    hvd.init()
+    state = LocalSyncJaxState(path=state_path,
+                              w=np.zeros(4, np.float32), batch=0)
+
+    def log_line(batch):
+        with open(log_path, "a") as f:
+            f.write(f"{hvd.rank()} {hvd.size()} {pod} {batch} "
+                    f"{int(time.time() * 1000)}\n")
+
+    @hvd.elastic.run
+    def train(state):
+        kv = _kv_client()
+        first_wait = True
+        while state.batch < total_batches:
+            # Constant LR: w0 tracks the batch count 1:1 regardless of
+            # the world size trajectory (4 -> 2 -> 4).
+            state.w = state.w + BASE_LR * np.ones(4, np.float32)
+            state.batch += 1
+            log_line(state.batch)
+            if kv is not None and hvd.size() > 1:
+                kv.put(f"/hb/{hvd.rank()}", str(state.batch).encode())
+                # First wait of a (re)spawned process tolerates the
+                # single-core boot stagger of its peers; steady-state
+                # waits keep the short dead-peer detection bound.
+                _wait_for_peers(kv, hvd.rank(), hvd.size(),
+                                state.batch - 1,
+                                hb_timeout_s * 3 if first_wait
+                                else hb_timeout_s)
+                first_wait = False
+            if state.batch % 5 == 0:
+                state.commit()   # pod_crash fires here on the doomed pod
+            time.sleep(sleep_s)
+
+    train(state)
+    hvd.shutdown()
+    if env_rank == 0:
+        print(f"final: batches={state.batch} w0={float(state.w[0]):.1f}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

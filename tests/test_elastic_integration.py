@@ -72,16 +72,28 @@ def _rows(path):
     return out
 
 
-def _wait_for_progress(proc, log_path, min_lines, timeout=300):
+def _wait_for_progress(proc, log_path, min_lines, timeout=300, stall=150):
     """300 s, not 120: this 1-core box runs the suite concurrently with
     background chip-watch probes (a down tunnel hangs each probe ~60 s);
     phase startup pays launcher + per-worker jax imports serially, so a
     contended window can exceed 120 s with nothing wrong (observed twice
-    in round-5 full-suite runs; the test passes alone in ~17 s)."""
+    in round-5 full-suite runs; the test passes alone in ~17 s).
+
+    ``stall`` bounds the DEAD case separately: when the row count has
+    not moved at all for that long (workers crashing before their first
+    log line — the CPU-backend multiprocess failure mode on this
+    container), waiting out the rest of the deadline only burns suite
+    budget; the run is failed immediately with the same verdict."""
     deadline = time.monotonic() + timeout
+    last_n, last_change = -1, time.monotonic()
     while time.monotonic() < deadline:
-        if os.path.exists(log_path) and len(_rows(log_path)) >= min_lines:
+        n = len(_rows(log_path)) if os.path.exists(log_path) else 0
+        if n >= min_lines:
             return
+        if n != last_n:
+            last_n, last_change = n, time.monotonic()
+        elif time.monotonic() - last_change > stall:
+            break
         time.sleep(0.2)
     proc.kill()
     pytest.fail("phase made no progress")

@@ -50,7 +50,8 @@ def _save_last_good(line: str) -> None:
         if d.get("steps_per_call") or d.get("fused_optimizer") \
                 or d.get("fault_plan") or d.get("telemetry") \
                 or d.get("overlap") or d.get("transport") \
-                or d.get("zero_stage") or d.get("remat"):
+                or d.get("zero_stage") or d.get("remat") \
+                or d.get("checkpoint_stall_ms"):
             # A/B probe variants, chaos runs, and telemetry-instrumented
             # runs are not the headline metric — caching one would
             # contaminate the outage-fallback evidence (telemetry adds
@@ -146,6 +147,11 @@ def _parse_args(argv=None):
                          "The second half of the memory-for-MFU trade "
                          "next to --zero; JSON gains remat; kept out "
                          "of the last-good cache.")
+    ap.add_argument("--ckpt-stall", action="store_true",
+                    help="measure the commit-point checkpoint stall of "
+                    "the trained state, sync vs async "
+                    "(HVDT_ASYNC_CKPT), and emit checkpoint_stall_ms "
+                    "in the JSON (outside the last-good cache)")
     ap.add_argument("--serve", action="store_true",
                     help="Serving micro-benchmark instead of training: "
                          "an in-process ModelServer (MLP, shape-bucketed "
@@ -674,6 +680,7 @@ def _run_child(args) -> None:
         **(_zero_doc(args, zero_tx, params, opt_state) if args.zero
            else {}),
         **({"remat": args.remat} if args.remat else {}),
+        **(_ckpt_stall_doc(params) if args.ckpt_stall else {}),
         **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
            if args.steps_per_call != 1 else {}),
@@ -684,6 +691,44 @@ def _run_child(args) -> None:
            if inj is not None else {}),
         **({"telemetry": telemetry_doc} if telemetry_doc else {}),
     }))
+
+
+def _ckpt_stall_doc(tree) -> dict:
+    """The --ckpt-stall leg: how long does the step loop stall for one
+    commit of the trained state, synchronous save vs ``save_async``
+    (submit-side only; the async write itself is drained before the
+    temp dirs are removed)?  Rides outside the last-good headline cache
+    (see _save_last_good)."""
+    import shutil as _shutil
+    import tempfile
+
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="hvdt-ckpt-stall-")
+    prev = os.environ.pop("HVDT_ASYNC_CKPT", None)
+    try:
+        mgr = CheckpointManager(os.path.join(root, "sync"))
+        t0 = time.perf_counter()
+        mgr.save(1, tree, force=True)
+        out["sync"] = round((time.perf_counter() - t0) * 1e3, 2)
+        os.environ["HVDT_ASYNC_CKPT"] = "1"
+        amgr = CheckpointManager(os.path.join(root, "async"))
+        t0 = time.perf_counter()
+        amgr.save_async(1, tree, force=True)
+        out["async"] = round((time.perf_counter() - t0) * 1e3, 2)
+        amgr.wait_for_async(120)
+        amgr.close()
+    except Exception as e:   # the probe must never sink the bench
+        print(f"ckpt-stall probe failed: {e!r}", file=sys.stderr)
+        return {}
+    finally:
+        if prev is None:
+            os.environ.pop("HVDT_ASYNC_CKPT", None)
+        else:
+            os.environ["HVDT_ASYNC_CKPT"] = prev
+        _shutil.rmtree(root, ignore_errors=True)
+    return {"checkpoint_stall_ms": out}
 
 
 def _overlap_doc() -> dict:
@@ -859,7 +904,8 @@ def main() -> None:
         + (["--overlap"] if args.overlap else []) \
         + (["--transport", args.transport] if args.transport else []) \
         + (["--zero", args.zero] if args.zero else []) \
-        + (["--remat", args.remat] if args.remat else [])
+        + (["--remat", args.remat] if args.remat else []) \
+        + (["--ckpt-stall"] if args.ckpt_stall else [])
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

@@ -9,8 +9,9 @@
 #       tracing/flight-recorder-forensics + overlap-scheduling +
 #       transport-policy/hierarchical-collective +
 #       zero-sharding/reduce-scatter-wire +
-#       pod-granular-elastic/multipod-recovery tests on CPU) —
-#       the pre-merge gate.
+#       pod-granular-elastic/multipod-recovery +
+#       continuous-goodput/async-checkpoint/peer-restore tests on
+#       CPU) — the pre-merge gate.
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

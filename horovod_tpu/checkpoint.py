@@ -17,10 +17,17 @@ user expects):
 * ``CheckpointManager`` — keep-N/interval policy around the above
   (ref: keras BestModelCheckpoint's save-frequency role), hardened for
   production failure modes: every save writes a per-step SHA-256
-  manifest and atomically advances a ``LAST_GOOD`` pointer;
-  ``restore_latest`` verifies the manifest and falls back step-by-step
-  to the newest intact checkpoint on corruption (counted, logged, never
-  a crash).
+  manifest (fsynced, directory-fsynced) and atomically advances a
+  ``LAST_GOOD`` pointer; ``restore_latest`` verifies the manifest and
+  falls back step-by-step to the newest intact checkpoint on corruption
+  (counted, logged, never a crash).
+* ``CheckpointManager.save_async`` — the continuous-goodput path
+  (``HVDT_ASYNC_CKPT``): the step loop pays only the device→host
+  snapshot (timed against ``HVDT_CKPT_SNAPSHOT_BUDGET_S``); a single
+  background writer thread (queue depth 1 — a newer snapshot supersedes
+  a queued older one) serializes, fsyncs, and only then advances
+  ``LAST_GOOD``.  With the knob unset ``save_async`` IS the synchronous
+  ``save`` (the faults/telemetry/overlap identity contract).
 """
 
 from __future__ import annotations
@@ -29,6 +36,8 @@ import hashlib
 import json
 import os
 import shutil
+import threading
+import time
 from typing import Any, Optional
 
 from .common.logging_util import get_logger
@@ -39,6 +48,23 @@ __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager",
 log = get_logger(__name__)
 
 _LAST_GOOD = "LAST_GOOD"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a rename inside it is durable (a crash after
+    ``os.replace`` but before the directory entry hits disk can otherwise
+    resurrect the old pointer — or point at a file that never made it).
+    Filesystems that refuse directory fsync are tolerated."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _named_dtype(name: str):
@@ -302,6 +328,17 @@ class CheckpointManager:
         # detected-and-skipped during restore fallback (never a crash).
         self.corrupt_detected = 0
         os.makedirs(self.directory, exist_ok=True)
+        from .common import config
+
+        self._async = config.get_bool("HVDT_ASYNC_CKPT")
+        self._snapshot_budget_s = config.get_float(
+            "HVDT_CKPT_SNAPSHOT_BUDGET_S")
+        self._writer: Optional[_AsyncCheckpointWriter] = None
+        if not self._async:
+            # Identity contract (faults/telemetry/overlap idiom): with
+            # the knob unset, save_async IS the synchronous save — same
+            # code object, no wrapper, no thread.
+            self.save_async = self.save
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:012d}")
@@ -354,7 +391,12 @@ class CheckpointManager:
 
     def _write_manifest(self, step: int) -> None:
         """Checksum every file of a just-written step (atomic rename, so
-        a crash mid-write leaves no half manifest)."""
+        a crash mid-write leaves no half manifest).  The manifest is
+        fsynced BEFORE the rename and the containing directory after it:
+        ``LAST_GOOD`` advances only past this call, so a host crash at
+        any moment can't leave the pointer naming a torn manifest."""
+        from .resilience import faults
+
         root = self._step_dir(step)
         files = {}
         for dirpath, _dirs, names in os.walk(root):
@@ -365,7 +407,15 @@ class CheckpointManager:
         tmp = f"{self._manifest_path(step)}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"step": step, "files": files}, f)
+            f.flush()
+            # The write/fsync seam: slow_disk@step=N:secs=S sleeps here,
+            # in whichever thread performs the durable write.
+            inj = faults.get_injector()
+            if inj is not None:
+                inj.fire("checkpoint.write", step=step)
+            os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path(step))
+        _fsync_dir(self.directory)
 
     def verify_step(self, step: int) -> bool:
         """True when the step's files match its manifest.  A step without
@@ -396,7 +446,10 @@ class CheckpointManager:
         tmp = os.path.join(self.directory, f".{_LAST_GOOD}.tmp.{os.getpid()}")
         with open(tmp, "w") as f:
             f.write(str(step))
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.directory, _LAST_GOOD))
+        _fsync_dir(self.directory)
 
     def last_good_step(self) -> Optional[int]:
         """Newest step whose save fully completed (manifest written and
@@ -412,6 +465,27 @@ class CheckpointManager:
         steps = [s for s in self.all_steps() if s < step]
         return (steps[-1] if steps else self.latest_step())
 
+    def _finalize_step(self, step: int) -> None:
+        """Durability tail shared by the sync save and the async writer:
+        manifest (fsync + dir fsync), the ``checkpoint.save`` fault
+        point, the ``LAST_GOOD`` advance, and keep-N pruning."""
+        self._write_manifest(step)
+        from .resilience import faults
+
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.fire("checkpoint.save", step=step,
+                     path=self._step_dir(step),
+                     manifest=self._manifest_path(step))
+        self._advance_last_good(step)
+        steps = self.all_steps()
+        for old in steps[:-self.max_to_keep]:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)
+            try:
+                os.remove(self._manifest_path(old))
+            except OSError:
+                pass
+
     def save(self, step: int, tree: Any, force: bool = False) -> bool:
         """Save if the interval says so (or force); prunes old steps.
         Returns True when a checkpoint was written.  On rank 0 the save
@@ -423,22 +497,114 @@ class CheckpointManager:
         save_checkpoint(self._step_dir(step), tree, step=step)
         rank, _ = _rank_size()
         if rank == 0:
-            self._write_manifest(step)
-            from .resilience import faults
-
-            inj = faults.get_injector()
-            if inj is not None:
-                inj.fire("checkpoint.save", step=step,
-                         path=self._step_dir(step))
-            self._advance_last_good(step)
-            steps = self.all_steps()
-            for old in steps[:-self.max_to_keep]:
-                shutil.rmtree(self._step_dir(old), ignore_errors=True)
-                try:
-                    os.remove(self._manifest_path(old))
-                except OSError:
-                    pass
+            self._finalize_step(step)
         return True
+
+    # -- async (non-blocking) saves ---------------------------------------
+
+    def save_async(self, step: int, tree: Any, force: bool = False) -> bool:
+        """Non-blocking save (``HVDT_ASYNC_CKPT``; otherwise this very
+        attribute is rebound to :meth:`save` in ``__init__``).
+
+        The calling thread pays only the device→host snapshot
+        (``jax.device_get`` of the committed tree), timed into
+        ``hvdt_ckpt_snapshot_seconds`` and checked against the
+        ``HVDT_CKPT_SNAPSHOT_BUDGET_S`` stall budget.  The snapshot is
+        handed to the single background writer (queue depth 1 — a newer
+        snapshot supersedes a queued older one, counted in
+        ``hvdt_ckpt_superseded_total``); the writer serializes, writes
+        the manifest, fsyncs, and only then advances ``LAST_GOOD``.
+
+        Rank-0-only, with **no collective barrier** — blocking peers on
+        a filesystem write is exactly what this path removes.  Returns
+        True when a snapshot was scheduled (on-interval or forced)."""
+        if not force and not self.should_save(step):
+            return False
+        rank, _ = _rank_size()
+        if rank != 0:
+            return True
+        import jax
+
+        t0 = time.perf_counter()
+        payload = {"tree": jax.device_get(tree), "step": int(step)}
+        snap_s = time.perf_counter() - t0
+        self._observe_snapshot(snap_s)
+        self._writer_handle().submit(step, payload)
+        return True
+
+    def _writer_handle(self) -> "_AsyncCheckpointWriter":
+        if self._writer is None:
+            self._writer = _AsyncCheckpointWriter(self)
+        return self._writer
+
+    def _observe_snapshot(self, seconds: float) -> None:
+        m = self._async_metrics()
+        m["snapshot"].observe(seconds)
+        if seconds > self._snapshot_budget_s:
+            m["over_budget"].inc()
+            log.warning(
+                "checkpoint snapshot took %.3fs, over the %.1fs "
+                "HVDT_CKPT_SNAPSHOT_BUDGET_S stall budget", seconds,
+                self._snapshot_budget_s)
+        ledger = _recovery_ledger()
+        if ledger is not None:
+            ledger.charge_phase("checkpoint_snapshot", seconds)
+
+    def _async_metrics(self):
+        metrics = getattr(self, "_async_metrics_cache", None)
+        if metrics is None:
+            from .telemetry.metrics import default_registry
+
+            reg = default_registry()
+            metrics = {
+                "snapshot": reg.summary(
+                    "hvdt_ckpt_snapshot_seconds",
+                    "Commit-point device->host checkpoint snapshot "
+                    "duration — the only stall the step loop pays under "
+                    "HVDT_ASYNC_CKPT"),
+                "write": reg.summary(
+                    "hvdt_ckpt_write_seconds",
+                    "Background checkpoint write duration (serialize + "
+                    "manifest + fsync + LAST_GOOD advance)"),
+                "over_budget": reg.counter(
+                    "hvdt_ckpt_snapshot_over_budget_total",
+                    "Snapshots exceeding HVDT_CKPT_SNAPSHOT_BUDGET_S"),
+                "superseded": reg.counter(
+                    "hvdt_ckpt_superseded_total",
+                    "Queued async snapshots replaced by a newer one "
+                    "before the writer got to them"),
+                "failures": reg.counter(
+                    "hvdt_ckpt_write_failures_total",
+                    "Background checkpoint writes that raised (logged; "
+                    "LAST_GOOD not advanced)"),
+            }
+            self._async_metrics_cache = metrics
+        return metrics
+
+    def _write_step_payload(self, step: int, payload: dict) -> None:
+        """Writer-thread body: Orbax write of an already-host-resident
+        payload (NO collective barrier — this runs off the step loop),
+        then the shared durability tail."""
+        path = self._step_dir(step)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        with _checkpointer() as ckptr:
+            ckptr.save(path, payload)
+        self._finalize_step(step)
+
+    def wait_for_async(self, timeout: Optional[float] = None) -> bool:
+        """Block until the background writer has drained (tests,
+        end-of-run flushes).  True when idle within ``timeout``;
+        trivially True when async mode is off or never used."""
+        if self._writer is None:
+            return True
+        return self._writer.wait_idle(timeout)
+
+    def close(self) -> None:
+        """Stop the background writer after draining pending work."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
@@ -493,3 +659,97 @@ class CheckpointManager:
                 log.warning("checkpoint step %d restore failed (%r); "
                             "falling back", cand, e)
         return None, None
+
+
+def _recovery_ledger():
+    """The process-wide recovery ledger, or None when telemetry is off
+    (zero-overhead contract — see telemetry/step_stats.recovery_ledger)."""
+    from .telemetry import step_stats
+
+    return step_stats.recovery_ledger()
+
+
+class _AsyncCheckpointWriter:
+    """Single background checkpoint writer with a depth-1 slot.
+
+    ``submit`` never blocks the caller: if an older snapshot is still
+    waiting for the writer, the newer one REPLACES it (at pod scale the
+    only checkpoint worth finishing is the newest — writing a stale one
+    first doubles the window where LAST_GOOD lags).  The write in flight
+    is never abandoned mid-file; superseding only touches the queued
+    slot.  Write errors are logged and counted, never raised into the
+    training loop, and LAST_GOOD stays on the previous good step.
+    """
+
+    def __init__(self, manager: CheckpointManager):
+        self._manager = manager
+        self._cond = threading.Condition()
+        self._pending: Optional[tuple] = None
+        self._busy = False
+        self._stopping = False
+        self.last_written_step: Optional[int] = None
+        self._thread = threading.Thread(
+            target=self._run, name="hvdt-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def submit(self, step: int, payload: dict) -> None:
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("async checkpoint writer is closed")
+            if self._pending is not None:
+                self._manager._async_metrics()["superseded"].inc()
+                log.info("async checkpoint: step %s superseded by step %s "
+                         "before write started", self._pending[0], step)
+            self._pending = (step, payload)
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stopping:
+                    self._cond.wait()
+                if self._pending is None:
+                    return
+                step, payload = self._pending
+                self._pending = None
+                self._busy = True
+            t0 = time.perf_counter()
+            try:
+                self._manager._write_step_payload(step, payload)
+                self.last_written_step = step
+            except Exception as e:  # noqa: BLE001 - must not kill training
+                self._manager._async_metrics()["failures"].inc()
+                log.warning("async checkpoint write of step %d failed "
+                            "(LAST_GOOD unchanged): %r", step, e)
+            finally:
+                elapsed = time.perf_counter() - t0
+                self._manager._async_metrics()["write"].observe(elapsed)
+                ledger = _recovery_ledger()
+                if ledger is not None:
+                    ledger.charge_phase("checkpoint_write", elapsed,
+                                        overlapped=True)
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        with self._cond:
+            while self._pending is not None or self._busy:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._cond.wait(remaining)
+            return True
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            log.warning("async checkpoint writer did not drain within "
+                        "%.1fs of close()", timeout)

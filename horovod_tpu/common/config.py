@@ -306,6 +306,31 @@ KNOBS: Dict[str, Knob] = {
            "dragging every synchronous step.  0 = disabled.  Needs "
            "HVDT_TELEMETRY on the workers (the driver aggregates their "
            "KV snapshots)."),
+        # --- continuous goodput (checkpoint.py / resilience/peer_store.py) ---
+        _k("HVDT_ASYNC_CKPT", False, _parse_bool,
+           "Asynchronous non-blocking checkpointing: "
+           "CheckpointManager.save_async takes a device->host snapshot "
+           "at the commit point and hands it to a background writer "
+           "thread (queue depth 1, a newer snapshot supersedes a queued "
+           "older one); the LAST_GOOD pointer advances only after the "
+           "manifest write + fsync completes.  Unset (default): "
+           "save_async IS the synchronous save (identity contract)."),
+        _k("HVDT_CKPT_SNAPSHOT_BUDGET_S", 1.0, float,
+           "Stall budget for the commit-point device->host checkpoint "
+           "snapshot (the only part of an async save the step loop "
+           "pays).  Snapshots are timed into the "
+           "hvdt_ckpt_snapshot_seconds summary; one exceeding the "
+           "budget logs a warning and increments "
+           "hvdt_ckpt_snapshot_over_budget_total."),
+        _k("HVDT_PEER_STORE", False, _parse_bool,
+           "In-memory peer-replicated snapshot tier: at every commit "
+           "point each rank publishes its committed snapshot over the "
+           "rendezvous KV and mirrors peer (rank+1) %% n's newest "
+           "snapshot in host RAM, so a single-rank or single-pod loss "
+           "restores surviving state over the KV/TCP path without "
+           "touching the filesystem (manifest-verified disk remains "
+           "the fallback tier).  Needs the elastic rendezvous env "
+           "(HVDT_RENDEZVOUS_ADDR) to be active."),
         # --- logging (ref: HOROVOD_LOG_LEVEL) ---
         _k("HVDT_LOG_LEVEL", "warning", str,
            "trace|debug|info|warning|error|fatal"),

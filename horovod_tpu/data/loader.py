@@ -28,7 +28,19 @@ class BaseDataLoader:
     """Iterable over batches (ref: data_loader_base.py BaseDataLoader).
 
     Subclasses implement ``_iterate``; ``_process_batch`` is the trainer
-    hook applied to every batch (kept for API parity)."""
+    hook applied to every batch (kept for API parity).
+
+    ``seek(cursor)`` arms the deterministic-resume fast-forward: the
+    NEXT iteration discards the first ``batch_idx`` batches unprocessed
+    (no ``_process_batch``, no device transfer) so recovery replays zero
+    already-committed batches.  The cursor is what
+    ``ElasticSampler.cursor()`` rides inside every checkpoint / peer
+    snapshot — ``epoch`` is the caller's to apply via ``set_epoch``
+    before re-iterating; the loader consumes ``batch_idx``.  One-shot:
+    the fast-forward applies to the next iteration only.
+    """
+
+    _seek_batches = 0
 
     def __len__(self) -> int:
         raise NotImplementedError
@@ -39,9 +51,55 @@ class BaseDataLoader:
     def _process_batch(self, batch: Any) -> Any:
         return batch
 
+    def seek(self, cursor) -> "BaseDataLoader":
+        """Arm a fast-forward to ``cursor`` (``{"epoch": e, "batch_idx":
+        b}``, an ``(epoch, batch_idx)`` tuple, or a bare batch index)
+        for the next iteration.  Returns self for chaining."""
+        if isinstance(cursor, dict):
+            batch_idx = cursor.get("batch_idx", 0)
+        elif isinstance(cursor, (tuple, list)):
+            batch_idx = cursor[1] if len(cursor) > 1 else cursor[0]
+        else:
+            batch_idx = cursor
+        batch_idx = int(batch_idx)
+        if batch_idx < 0:
+            raise ValueError(f"seek cursor batch_idx must be >= 0, "
+                             f"got {batch_idx}")
+        self._seek_batches = batch_idx
+        return self
+
     def __iter__(self) -> Iterator[Any]:
+        skip, self._seek_batches = self._seek_batches, 0
+        if skip:
+            t0 = time.perf_counter()
+            it = self._iterate()
+            skipped = 0
+            for _ in range(skip):
+                try:
+                    next(it)
+                except StopIteration:
+                    log.warning(
+                        "seek past the end of the loader: cursor asked "
+                        "for batch %d but the stream held %d", skip,
+                        skipped)
+                    return
+                skipped += 1
+            _charge_replay(time.perf_counter() - t0)
+            for batch in it:
+                yield self._process_batch(batch)
+            return
         for batch in self._iterate():
             yield self._process_batch(batch)
+
+
+def _charge_replay(seconds: float) -> None:
+    """Attribute fast-forward time to the recovery budget's ``replay``
+    phase (None-check when telemetry is off)."""
+    from ..telemetry import step_stats
+
+    ledger = step_stats.recovery_ledger()
+    if ledger is not None:
+        ledger.charge_phase("replay", seconds)
 
 
 class _Done:

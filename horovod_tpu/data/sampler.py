@@ -86,6 +86,7 @@ class ElasticSampler:
         self.seed = seed
         self.epoch = 0
         self.processed_num = 0
+        self.batch_idx = 0
         self._rank_override = rank
         self._size_override = size
         self.reset()
@@ -96,18 +97,32 @@ class ElasticSampler:
         docstring sampler.py:60-69)."""
         self.epoch = epoch
         self.processed_num = 0
+        self.batch_idx = 0
         self.reset()
 
     def record_batch(self, batch_idx: int, batch_size: int) -> None:
         """Record one processed global batch (all replicas advance)."""
         self.processed_num += batch_size * self.num_replicas
+        self.batch_idx = int(batch_idx) + 1
+
+    def cursor(self) -> Dict[str, int]:
+        """The ``(epoch, batch_idx)`` resume cursor that rides inside
+        every checkpoint / peer snapshot: ``batch_idx`` is the next
+        UNprocessed batch of ``epoch``, the position
+        ``BaseDataLoader.seek`` fast-forwards to so recovery replays
+        zero already-committed batches."""
+        return {"epoch": self.epoch, "batch_idx": self.batch_idx}
 
     def state_dict(self) -> Dict[str, int]:
-        return {"epoch": self.epoch, "processed_num": self.processed_num}
+        return {"epoch": self.epoch, "processed_num": self.processed_num,
+                "batch_idx": self.batch_idx}
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
         self.epoch = int(state["epoch"])
         self.processed_num = int(state["processed_num"])
+        # Pre-cursor checkpoints (PR <= 10) carry no batch_idx: resume
+        # conservatively at 0 rather than refusing the state.
+        self.batch_idx = int(state.get("batch_idx", 0))
         self.reset()
 
     def reset(self) -> None:

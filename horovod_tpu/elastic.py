@@ -165,13 +165,54 @@ class JaxState(ObjectState):
     writes the host-memory snapshot there atomically, and a freshly
     spawned worker finding the file resumes from it (rank consistency
     comes from the usual sync() broadcast).
+
+    With ``HVDT_PEER_STORE`` set, every commit ALSO publishes the
+    snapshot to the peer-replicated RAM tier (resilience/peer_store.py)
+    and a respawned worker restores from whichever tier holds the newer
+    commit — ties go to the peer tier, so a healthy recovery never
+    touches the filesystem.  ``restored_from`` records which tier served
+    (``"peer"`` / ``"disk"`` / None).
     """
 
     def __init__(self, path: Optional[str] = None, **kwargs: Any):
         self._state_path = path
+        self.restored_from: Optional[str] = None
         super().__init__(**kwargs)
-        if path and os.path.exists(path):
-            self._load_from_disk()
+        self._resume()
+
+    def _resume(self) -> None:
+        """Boot-time restore: newest of {peer RAM tier, disk commit}."""
+        from .resilience import peer_store as _peer_store
+        from .telemetry import step_stats
+
+        import time as _time
+
+        ledger = step_stats.recovery_ledger()
+        t0 = _time.perf_counter()
+        disk_saved = None
+        if self._state_path and os.path.exists(self._state_path):
+            with open(self._state_path, "rb") as f:
+                disk_saved = pickle.load(f)
+        ps = _peer_store.get_peer_store()
+        peer = ps.restore() if ps is not None else None
+        if peer is not None:
+            peer_saved, peer_step = peer
+            disk_step = disk_saved.get("batch") if isinstance(
+                disk_saved, dict) else None
+            if not isinstance(disk_step, int) or peer_step >= disk_step:
+                self._saved = peer_saved
+                self.restore()
+                self.restored_from = "peer"
+                log.info("elastic state resumed from the peer RAM tier "
+                         "at step %s", peer_step)
+                disk_saved = None
+        if disk_saved is not None:
+            self._saved = disk_saved
+            self.restore()
+            self.restored_from = "disk"
+            log.info("elastic state resumed from %s", self._state_path)
+        if ledger is not None and self.restored_from is not None:
+            ledger.charge_phase("restore", _time.perf_counter() - t0)
 
     def _payload_keys(self) -> List[str]:
         return [k for k in super()._payload_keys() if k != "path"]
@@ -185,15 +226,18 @@ class JaxState(ObjectState):
             pickle.dump(self._saved, f)
         os.replace(tmp, self._state_path)
 
-    def _load_from_disk(self) -> None:
-        with open(self._state_path, "rb") as f:
-            self._saved = pickle.load(f)
-        self.restore()
-        log.info("elastic state resumed from %s", self._state_path)
-
     def commit(self) -> None:
         self.save()
         self.persist()
+        # Peer tier rides the same commit point: publish this commit's
+        # snapshot over the rendezvous KV and refresh the watched peer's
+        # RAM replica (None-check when HVDT_PEER_STORE is unset).
+        from .resilience import peer_store as _peer_store
+
+        ps = _peer_store.get_peer_store()
+        if ps is not None:
+            step = getattr(self, "batch", None)
+            ps.commit(step if isinstance(step, int) else 0, self._saved)
         # After persist: an injected crash or a preemption exit at the
         # commit point leaves this commit restorable on disk.
         self._resilience_check()
@@ -267,7 +311,8 @@ def run(func: Callable) -> Callable:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 log.info("collective failure — restoring last commit")
-                state.restore()
+                with _recovery_phase("restore"):
+                    state.restore()
                 skip_sync = False
                 if _launcher_managed():
                     _exit_for_respawn(state)
@@ -276,9 +321,25 @@ def run(func: Callable) -> Callable:
                 skip_sync = e.skip_sync
                 if _launcher_managed():
                     _exit_for_respawn(state)
-            _reset(state)
+            with _recovery_phase("rendezvous"):
+                _reset(state)
 
     return wrapper
+
+
+def _recovery_phase(name: str):
+    """Recovery-budget attribution for the in-process retry path — a
+    null context when telemetry is off (the ledger's zero-overhead
+    contract; the launcher-managed path attributes in the respawned
+    process instead, see JaxState._resume)."""
+    import contextlib
+
+    from .telemetry import step_stats
+
+    ledger = step_stats.recovery_ledger()
+    if ledger is None:
+        return contextlib.nullcontext()
+    return ledger.phase(name)
 
 
 def _install_preemption_guard(state: State):

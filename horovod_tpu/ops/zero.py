@@ -100,6 +100,7 @@ __all__ = [
     "validate_env", "resolve_stage", "exchange_fn", "rs_exchange",
     "zero_transform", "zero_sgd", "zero_adam", "zero_from_optimizer",
     "state_metadata", "reshard_state", "shard_align",
+    "extract_shard_rows", "implant_shard_rows",
 ]
 
 STAGES: Tuple[str, ...] = ("off", "grads", "states", "params")
@@ -1099,6 +1100,54 @@ def state_metadata(tx: ZeroTransformation, params) -> Dict[str, Any]:
             for s, sl, dt in zip(plan.sizes, plan.shard_lens,
                                  plan.dtypes)],
     }
+
+
+def extract_shard_rows(state, shard_index: int) -> Dict[str, Any]:
+    """One rank's rows of every ``[n, shard_len]`` bucket stack, as
+    host numpy — the peer-replication payload (resilience/peer_store.py):
+    in the flat layout a peer copy of rank ``s`` is exactly row ``s`` of
+    each stack, one allgather slice, not a full-state clone.  Keys
+    follow the ``save_zero_state`` naming (``mu_0``, ``nu_0``,
+    ``trace_0``, ... plus ``count`` for Adam)."""
+    import numpy as np
+
+    s = int(shard_index)
+    rows: Dict[str, Any] = {}
+    if hasattr(state, "mu"):
+        rows["count"] = np.asarray(state.count)
+        stacks = [("mu", state.mu), ("nu", state.nu)]
+    else:
+        stacks = [("trace", state.trace)]
+    for name, bufs in stacks:
+        for bi, stack in enumerate(bufs):
+            rows[f"{name}_{bi}"] = np.asarray(stack[s])
+    return rows
+
+
+def implant_shard_rows(state, shard_index: int, rows: Dict[str, Any]):
+    """Inverse of :func:`extract_shard_rows`: a new state with row
+    ``shard_index`` of every bucket stack replaced by the replicated
+    rows (host-side; the caller re-places on device as usual)."""
+    import numpy as np
+
+    s = int(shard_index)
+
+    def patch(stacks, name):
+        out = []
+        for bi, stack in enumerate(stacks):
+            arr = np.asarray(stack).copy()
+            arr[s] = np.asarray(rows[f"{name}_{bi}"])
+            out.append(jnp.asarray(arr))
+        return tuple(out)
+
+    if hasattr(state, "mu"):
+        count = state.count
+        if "count" in rows:
+            count = jnp.asarray(np.asarray(rows["count"]))
+        return ZeroAdamState(count=count,
+                             mu=patch(state.mu, "mu"),
+                             nu=patch(state.nu, "nu"))
+    return ZeroSgdState(trace=patch(state.trace, "trace"))
 
 
 def _reshard_stack(stack, logical_size: int, new_n: int, align: int):

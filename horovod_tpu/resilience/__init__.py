@@ -18,12 +18,17 @@ harness that proves it (``tests/test_resilience.py``):
 * :mod:`~horovod_tpu.resilience.escalation` — the stall ladder
   (warn → abort collective → request elastic reset) the controller
   consumes.
+* :mod:`~horovod_tpu.resilience.peer_store` — the in-memory redundancy
+  tier (``HVDT_PEER_STORE``): commit-point snapshots replicated to peer
+  RAM over the rendezvous KV, so a lost rank restores without touching
+  the filesystem; a strict no-op when unset.
 """
 
 from .escalation import (ABORT, RESET, WARN, EscalationPolicy, Escalator,
                          request_elastic_reset)
 from .faults import (FaultInjector, FaultSpec, InjectedFault, configure,
                      get_injector, instrument, parse_plan)
+from .peer_store import PeerStore, get_peer_store
 from .preempt import PREEMPT_EXIT_CODE, Preempted, PreemptionGuard
 from .retry import Backoff, RetriesExhausted, retry
 
@@ -34,4 +39,5 @@ __all__ = [
     "PreemptionGuard", "Preempted", "PREEMPT_EXIT_CODE",
     "Escalator", "EscalationPolicy", "WARN", "ABORT", "RESET",
     "request_elastic_reset",
+    "PeerStore", "get_peer_store",
 ]

@@ -23,6 +23,9 @@ i.e. comma-separated ``kind@key=value:key=value`` entries.  Kinds:
 * ``corrupt_ckpt`` — flip bytes in a just-written checkpoint (fires at
   the ``checkpoint.save`` point, which passes the step directory): the
   torn-write / disk-rot case the manifest verification must catch.
+  ``mode=truncate_manifest`` instead truncates the step's integrity
+  manifest mid-file — the torn-manifest case a host crash between the
+  manifest write and its fsync leaves behind.
 * ``kv_drop`` — raise ``ConnectionError`` from rendezvous-KV client ops
   with probability ``p``: a flaky control network.
 * ``pod_crash``  — ``crash`` scoped to a pod: every rank whose
@@ -33,6 +36,11 @@ i.e. comma-separated ``kind@key=value:key=value`` entries.  Kinds:
 * ``pod_partition`` — the pod drops off the network for ``secs``: its
   ranks block at the injection point, so peers see stalled heartbeats /
   collectives, e.g. ``pod_partition@step=10:pod=podB:secs=20``.
+* ``slow_disk`` — sleep ``secs`` at the checkpoint writer's write/fsync
+  seam (``checkpoint.write`` point), e.g. ``slow_disk@step=8:secs=5``:
+  a degraded filesystem.  Under the synchronous save the step loop
+  stalls for the full sleep; under ``HVDT_ASYNC_CKPT`` only the
+  background writer does — the testable form of the non-blocking claim.
 
 Match keys: ``step`` (fires once at the first point whose step >= it —
 commits are periodic, so exact equality would silently never fire),
@@ -75,7 +83,7 @@ __all__ = ["InjectedFault", "FaultSpec", "FaultInjector", "parse_plan",
 log = get_logger(__name__)
 
 KINDS = ("crash", "hang", "exc", "corrupt_ckpt", "kv_drop",
-         "pod_crash", "pod_partition")
+         "pod_crash", "pod_partition", "slow_disk")
 
 # Default injection point per kind (spec may override with point=).
 _DEFAULT_POINT = {
@@ -86,6 +94,7 @@ _DEFAULT_POINT = {
     "kv_drop": "kv",
     "pod_crash": "step",
     "pod_partition": "step",
+    "slow_disk": "checkpoint.write",
 }
 
 
@@ -136,6 +145,7 @@ class FaultSpec:
     p: Optional[float] = None
     secs: float = 30.0
     code: int = 1
+    mode: str = "payload"   # corrupt_ckpt: payload | truncate_manifest
     times: Optional[int] = None   # None = resolved default (see __post_init__)
     fired: int = 0
 
@@ -143,6 +153,10 @@ class FaultSpec:
         if self.kind not in KINDS:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; valid: {', '.join(KINDS)}")
+        if self.mode not in ("payload", "truncate_manifest"):
+            raise ValueError(
+                f"unknown corrupt_ckpt mode {self.mode!r}; valid: "
+                f"payload, truncate_manifest")
         self.ranks: Optional[frozenset] = (
             parse_rank_set(self.rank) if self.rank is not None else None)
         if self.ranks is not None and len(self.ranks) == 1:
@@ -212,13 +226,13 @@ def parse_plan(plan: str) -> List[FaultSpec]:
                     kwargs[key] = parse_rank_set(val)
                 elif key in ("p", "secs"):
                     kwargs[key] = float(val)
-                elif key in ("point", "pod"):
+                elif key in ("point", "pod", "mode"):
                     kwargs[key] = val
                 else:
                     raise ValueError(
                         f"fault plan entry {entry!r}: unknown key {key!r}; "
                         f"valid: step, rank, pod, point, p, secs, code, "
-                        f"times")
+                        f"mode, times")
         point = kwargs.pop("point", None) or _DEFAULT_POINT.get(kind)
         if point is None:
             raise ValueError(f"fault plan entry {entry!r}: unknown fault "
@@ -339,21 +353,47 @@ class FaultInjector:
             # rank of the matched pod dies at its own injection point,
             # producing the correlated whole-slice loss.
             self._exit(spec.code)
-        elif spec.kind in ("hang", "pod_partition"):
+        elif spec.kind in ("hang", "pod_partition", "slow_disk"):
             # pod_partition: the matched pod's ranks block here — peers
             # outside the pod observe stalled heartbeats/collectives,
             # exactly what a network partition of the slice looks like.
+            # slow_disk: same sleep, fired at the checkpoint writer's
+            # write/fsync seam — whoever performs the write (the step
+            # loop under sync saves, the background writer thread under
+            # HVDT_ASYNC_CKPT) eats the stall.
             self._sleep(spec.secs)
         elif spec.kind == "exc":
             raise InjectedFault(
                 f"injected fault at point={point} step={step} rank={rank}")
         elif spec.kind == "corrupt_ckpt":
-            path = ctx.get("path")
-            if path:
-                corrupt_checkpoint_dir(path)
+            if spec.mode == "truncate_manifest":
+                manifest = ctx.get("manifest")
+                if manifest:
+                    truncate_file(manifest)
+            else:
+                path = ctx.get("path")
+                if path:
+                    corrupt_checkpoint_dir(path)
         elif spec.kind == "kv_drop":
             raise ConnectionError(
                 f"injected kv drop at point={point} (p={spec.p})")
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> bool:
+    """Truncate ``path`` mid-file (the torn-write a crash between write
+    and fsync leaves) — shared by the ``corrupt_ckpt`` truncate-manifest
+    mode and tests.  Returns True when the file was actually cut."""
+    try:
+        size = os.path.getsize(path)
+        if size <= 1:
+            return False
+        with open(path, "r+b") as f:
+            f.truncate(max(1, int(size * keep_fraction)))
+    except OSError:
+        return False
+    log.warning("FAULT INJECTION: truncated %s to %d%% of %d bytes",
+                path, int(keep_fraction * 100), size)
+    return True
 
 
 def corrupt_checkpoint_dir(path: str) -> Optional[str]:

@@ -34,6 +34,10 @@ YAML shape (mirrors the reference's config sections)::
       disabled: false
       warning_time_seconds: 60
       shutdown_time_seconds: 0
+    resilience:
+      async_ckpt: true
+      peer_store: true
+      ckpt_snapshot_budget_s: 1.0
     elastic:
       pod_size: 4
       pod_straggler_evict: 3
@@ -187,6 +191,24 @@ KNOB_FLAGS: List[_Flag] = [
           "'crash@step=12:rank=1,3' (rank sets/ranges), "
           "'pod_crash@step=10:pod=podB,kv_drop@p=0.1' "
           "(resilience/faults.py grammar)."),
+    _Flag("--async-ckpt", "async_ckpt", "HVDT_ASYNC_CKPT",
+          "resilience", "async_ckpt",
+          "Asynchronous non-blocking checkpointing on every worker: "
+          "commit-point device->host snapshot + background writer; "
+          "LAST_GOOD advances only after manifest fsync "
+          "(checkpoint.py save_async).", is_bool=True, to_env=_bool_env),
+    _Flag("--peer-store", "peer_store", "HVDT_PEER_STORE",
+          "resilience", "peer_store",
+          "Peer-replicated in-memory snapshot tier: commit snapshots "
+          "ride the rendezvous KV and mirror in peer RAM, so a lost "
+          "rank/pod restores without touching the filesystem "
+          "(resilience/peer_store.py).", is_bool=True, to_env=_bool_env),
+    _Flag("--ckpt-snapshot-budget-s", "ckpt_snapshot_budget_s",
+          "HVDT_CKPT_SNAPSHOT_BUDGET_S", "resilience",
+          "ckpt_snapshot_budget_s",
+          "Stall budget (seconds) for the commit-point checkpoint "
+          "snapshot under --async-ckpt; overruns are warned and "
+          "counted.", type=float),
     # --- elastic / pods ---
     _Flag("--pod-size", "pod_size", "HVDT_POD_SIZE",
           "elastic", "pod_size",

@@ -278,6 +278,12 @@ class ElasticDriver:
     # -- rendezvous / spawn ------------------------------------------------
 
     def _rendezvous(self) -> None:
+        # Recovery-budget attribution, driver side: the rendezvous phase
+        # starts the moment a new generation is needed and ends when
+        # every slot of the new world has been handed to a spawner.
+        # Workers attribute their own boot restore/replay; the driver
+        # owns the slot-wait + assignment + publish window.
+        t0 = time.monotonic()
         self.wait_for_available_slots(self._min_np,
                                       timeout=self._elastic_timeout)
         with self._lock:
@@ -298,6 +304,17 @@ class ElasticDriver:
             self._rendezvous_cb(self._assignments, gen)
         for slot in self._assignments:
             self._start_worker(slot, gen)
+        self.last_rendezvous_seconds = time.monotonic() - t0
+        self.rendezvous_seconds_total = getattr(
+            self, "rendezvous_seconds_total", 0.0) \
+            + self.last_rendezvous_seconds
+        if gen > 1:
+            # Generation 1 is job boot, not recovery; later generations
+            # are the rendezvous leg of a recovery and are printed so
+            # scenario harnesses (and operators reading driver logs) can
+            # audit the budget without scraping worker metrics.
+            print(f"elastic: generation {gen} rendezvous took "
+                  f"{self.last_rendezvous_seconds:.2f}s", file=sys.stderr)
 
     def _start_worker(self, slot: hosts_mod.SlotInfo, gen: int) -> None:
         def _run():

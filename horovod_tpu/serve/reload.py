@@ -76,6 +76,11 @@ class CheckpointWatcher:
             "serve_reload_failures_total",
             "Reload attempts that failed (serving continues on the "
             "previous weights)")
+        self._skipped_unverified = self.metrics.counter(
+            "serve_skipped_unverified_total",
+            "Steps skipped because their integrity manifest failed "
+            "verification (fell back to the previous good step without "
+            "charging the reload-failure backoff)")
         self._step_gauge = self.metrics.gauge(
             "serve_checkpoint_step", "Step of the currently served weights")
         last_good = self.metrics.gauge(
@@ -96,18 +101,35 @@ class CheckpointWatcher:
         return float(step) if step is not None else -1.0
 
     def check_once(self) -> Optional[int]:
-        """One poll: reload if a newer step exists.  Returns the step
-        loaded, or None when already current / nothing to load / the
-        restore failed (failure is counted and logged, never raised —
-        the polling loop and the serving path share this method)."""
+        """One poll: reload if a newer *verified* step exists.  Returns
+        the step loaded, or None when already current / nothing to load
+        / the restore failed (failure is counted and logged, never
+        raised — the polling loop and the serving path share this
+        method).
+
+        A step whose integrity manifest fails verification is SKIPPED —
+        counted in ``serve_skipped_unverified_total`` and logged, but
+        not charged against the reload-failure backoff: a corrupt
+        newest step means "fall back to the previous good step now",
+        not "probe the directory ever more slowly"."""
         try:
-            latest = self.manager.latest_step()
+            candidates = self.manager.all_steps()
         except OSError as e:
             log.warning("serve reload: cannot list %s: %r",
                         self.manager.directory, e)
             return None
-        if latest is None or (self.current_step is not None
-                              and latest <= self.current_step):
+        latest = None
+        for cand in reversed(candidates):
+            if self.current_step is not None and cand <= self.current_step:
+                break
+            if self.manager.verify_step(cand):
+                latest = cand
+                break
+            self._skipped_unverified.inc()
+            log.warning("serve reload: step %d failed manifest "
+                        "verification; falling back to an older step",
+                        cand)
+        if latest is None:
             return None
         path = self.manager.step_path(latest)
         try:

@@ -1,0 +1,171 @@
+"""Continuous-goodput chaos scenario worker (tests/test_goodput.py).
+
+Same KV-heartbeat coupling as ``resilient_main.py`` (the container's
+CPU-only jax cannot run multiprocess XLA collectives; the recovery
+machinery under test is identical either way), extended with the
+continuous-goodput legs this battery proves:
+
+* **peer-tier recovery**: ``HVDT_PEER_STORE=1`` — every commit publishes
+  the snapshot over the rendezvous KV; a respawned rank resumes from the
+  RAM tier (``restore <rank> peer ...`` log line, peer-restore counter
+  attached) without touching the filesystem.
+* **async checkpointing**: ``HVDT_ASYNC_CKPT=1`` — env-rank-0 drives a
+  ``CheckpointManager.save_async`` alongside the elastic commits; the
+  background writer must land a verified ``LAST_GOOD`` under the elastic
+  launcher (``ckpt`` log line).
+* **deterministic data resume**: batch ids come from an
+  ``AsyncDataLoader`` fast-forwarded with ``seek(state.batch)`` at boot,
+  and every consumed id is logged (``data`` lines) — the test asserts
+  the per-rank id stream is gap-free and replay-free across the kill.
+* **recovery budget**: every line carries ts_ms; the test asserts
+  kill -> first-new-committed-batch wall clock under the 30 s budget.
+
+Log grammar (one record per line)::
+
+    data <rank> <size> <bid> <ts_ms>
+    restore <rank> <tier> <batch> <peer_total> <ts_ms>
+    ckpt <rank> <last_good_step> <ts_ms>
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: E402
+from horovod_tpu.data.loader import AsyncDataLoader  # noqa: E402
+from horovod_tpu.resilience.retry import Backoff  # noqa: E402
+
+BASE_LR = 0.1
+
+
+class LocalSyncJaxState(hvd.elastic.JaxState):
+    """Rank consistency from the shared commit tiers (peer KV + disk) —
+    no multiprocess data plane on CPU."""
+
+    def sync(self):
+        self.save()
+
+
+def _kv_client():
+    if "HVDT_RENDEZVOUS_ADDR" not in os.environ:
+        return None
+    from horovod_tpu.runner.http_kv import KVClient
+
+    return KVClient.from_env()
+
+
+def _wait_for_peers(kv, my_rank, size, need, timeout_s):
+    """Block until every peer's heartbeat reaches ``need``; a stalled
+    peer surfaces as HorovodInternalError, the dead-collective signal."""
+    b = Backoff(first=0.05, cap=0.5, deadline_s=timeout_s)
+    while True:
+        behind = None
+        for r in range(size):
+            if r == my_rank:
+                continue
+            try:
+                raw = kv.get(f"/hb/{r}")
+            except (ConnectionError, OSError):
+                raw = None
+            if raw is None or int(raw) < need:
+                behind = r
+                break
+        if behind is None:
+            return
+        if not b.sleep():
+            raise HorovodInternalError(
+                f"peer {behind} heartbeat stalled below batch {need}")
+
+
+def main():
+    log_path = os.environ["ELASTIC_TEST_LOG"]
+    ckpt_dir = os.environ["GOODPUT_CKPT_DIR"]
+    total_batches = int(os.environ.get("ELASTIC_TEST_BATCHES", "20"))
+    sleep_s = float(os.environ.get("ELASTIC_TEST_SLEEP", "0.15"))
+    hb_timeout_s = float(os.environ.get("ELASTIC_TEST_HB_TIMEOUT", "7"))
+    env_rank = int(os.environ.get("HVDT_RANK", 0))
+    env_size = int(os.environ.get("HVDT_SIZE", 1))
+    # Per-RANK disk commits: each rank's disk tier must hold its own
+    # last commit, or a faster peer's shared write would shadow the dead
+    # rank's peer snapshot and force a disk restore (the peer tier wins
+    # ties, and per-rank files make commit steps tie exactly).
+    state_path = os.environ["ELASTIC_TEST_STATE"] + f".rank{env_rank}"
+
+    # The cross-rank coupling here is ENTIRELY the rendezvous-KV
+    # heartbeat (the layers under test — peer store, async checkpoint,
+    # data cursor — never issue an XLA collective), so skip the JAX
+    # coordination service: its leader-death SIGABRT would race the
+    # clean HorovodInternalError -> exit-for-respawn path when rank 0
+    # (the leader) exits first.  The coordination-service integration is
+    # covered by resilient_main.py / multipod_main.py.
+    os.environ.pop("HVDT_COORDINATOR_ADDR", None)
+    hvd.init()
+    state = LocalSyncJaxState(path=state_path,
+                              w=np.zeros(4, np.float32), batch=0)
+
+    def log_line(*fields):
+        with open(log_path, "a") as f:
+            f.write(" ".join(str(x) for x in fields)
+                    + f" {int(time.time() * 1000)}\n")
+
+    if state.restored_from is not None:
+        from horovod_tpu.resilience import peer_store
+
+        ps = peer_store.get_peer_store()
+        total = ps.restore_count() if ps is not None else 0
+        log_line("restore", env_rank, state.restored_from, state.batch,
+                 total)
+
+    mgr = None
+    if env_rank == 0:
+        from horovod_tpu.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(ckpt_dir, save_interval_steps=5)
+
+    @hvd.elastic.run
+    def train(state):
+        kv = _kv_client()
+        loader = AsyncDataLoader(list(range(total_batches)),
+                                 async_loader_queue_size=8)
+        # Deterministic resume: fast-forward past every batch already
+        # committed — the replay-free contract under test.
+        loader.seek({"epoch": 0, "batch_idx": state.batch})
+        first_wait = True
+        for bid in loader:
+            # Constant LR: w0 tracks the batch count 1:1, so replay or
+            # a gap shows up in the final w0 as well as the data log.
+            state.w = state.w + BASE_LR * np.ones(4, np.float32)
+            state.batch = bid + 1
+            log_line("data", env_rank, env_size, bid)
+            if kv is not None and env_size > 1:
+                kv.put(f"/hb/{env_rank}", str(state.batch).encode())
+                _wait_for_peers(kv, env_rank, env_size,
+                                state.batch - 1,
+                                hb_timeout_s * 3 if first_wait
+                                else hb_timeout_s)
+                first_wait = False
+            if mgr is not None:
+                mgr.save_async(state.batch, {"w": state.w,
+                                             "batch": state.batch})
+            state.commit()   # crash/pod_crash faults fire here
+            time.sleep(sleep_s)
+        loader.close()
+
+    train(state)
+    if mgr is not None:
+        mgr.wait_for_async(30)
+        log_line("ckpt", env_rank, mgr.last_good_step())
+        mgr.close()
+    hvd.shutdown()
+    if env_rank == 0:
+        print(f"final: batches={state.batch} w0={float(state.w[0]):.1f}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

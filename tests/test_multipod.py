@@ -755,6 +755,15 @@ fi
         seen[r] = max(seen.get(r, 0), b)
     # Exact loss continuity: constant LR, every batch applied once.
     assert "final: batches=80 w0=8.0" in text
+    # Recovery-time budget: from pod B's death (last size-4 batch-10
+    # line) to the shrunk world making NEW progress (first size-2
+    # batch-11 line) must stay under the 30 s SLO — whole-pod loss is
+    # exactly the case the budget is for.
+    t_kill = min(ts for _, s, _, b, ts in rows if b == 10)
+    t_recovered = min(ts for _, s, _, b, ts in rows if s == 2 and b == 11)
+    recovery_s = (t_recovered - t_kill) / 1000.0
+    assert recovery_s < 30.0, (
+        f"pod-loss recovery took {recovery_s:.1f}s (budget 30s)")
     # ZeRO resharding across the changed dcn extent, both directions.
     with open(zero_log) as f:
         zl = f.read()

@@ -639,21 +639,24 @@ class TestServeReloadHardening:
             poll_interval_s=0.05)
         assert watcher.check_once() == 1
         assert watcher._fail_streak == 0
-        # Corrupt the next step: reload fails, streak grows, serving
-        # stays on step 1.
+        # Corrupt the next step: its manifest verification fails, so the
+        # watcher SKIPS it (counted, but no failure streak — a corrupt
+        # newest step must not slow the poll down) and keeps serving
+        # step 1.
         mgr.save(2, {"x": jnp.ones(2) * 2.0}, force=True)
         corrupt_checkpoint_dir(mgr.step_path(2))
         assert watcher.check_once() is None
-        assert watcher._fail_streak == 1
+        assert watcher._fail_streak == 0
         assert watcher.current_step == 1
-        # A good step arrives: reload succeeds, streak resets.
+        # A good step arrives: reload succeeds immediately.
         mgr.save(3, {"x": jnp.ones(2) * 3.0}, force=True)
         assert watcher.check_once() == 3
         assert watcher._fail_streak == 0
         assert seen == [1, 3]
         text = watcher.metrics.render()
         assert "serve_last_good_step 3" in text
-        assert "serve_reload_failures_total 1" in text
+        assert "serve_skipped_unverified_total 1" in text
+        assert "serve_reload_failures_total 0" in text
 
     def test_reload_fault_point(self, hvd, tmp_path, monkeypatch):
         from horovod_tpu.checkpoint import CheckpointManager
@@ -854,3 +857,12 @@ def test_injected_crash_recovers_with_step_continuity(tmp_path):
     # Loss continuity: every batch applied its update exactly once
     # across crash/restore/replay (w0 == 30 batches * lr 0.2).
     assert "final: batches=30 w0=6.0" in out.decode()
+    # Recovery-time budget: "we recovered" is not enough — the wall
+    # clock from rank 1's death (its last pre-crash batch-10 line) to
+    # its first NEW batch (11) must stay under the 30 s SLO.
+    r1_rows = sorted((ts, b) for r, _, b, _, ts in rows if r == 1)
+    t_kill = min(ts for ts, b in r1_rows if b == 10)
+    t_recovered = min(ts for ts, b in r1_rows if b == 11)
+    recovery_s = (t_recovered - t_kill) / 1000.0
+    assert recovery_s < 30.0, (
+        f"rank 1 recovery took {recovery_s:.1f}s (budget 30s)")

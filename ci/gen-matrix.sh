@@ -10,7 +10,8 @@
 #       transport-policy/hierarchical-collective +
 #       zero-sharding/reduce-scatter-wire +
 #       pod-granular-elastic/multipod-recovery +
-#       continuous-goodput/async-checkpoint/peer-restore tests on
+#       continuous-goodput/async-checkpoint/peer-restore +
+#       elastic-serving-control-plane/router/autoscaler tests on
 #       CPU) — the pre-merge gate.
 set -eu
 only=""

@@ -409,6 +409,52 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_SERVE_RELOAD_INTERVAL_S", 10.0, float,
            "Seconds between checkpoint-directory polls for hot weight "
            "reload (serve/reload.py CheckpointWatcher)."),
+        # --- elastic serving control plane (serve/router.py +
+        #     serve/autoscale.py on the pod-aware elastic machinery) ---
+        _k("HVDT_SERVE_HEARTBEAT_S", 2.0, float,
+           "Replica heartbeat period to the rendezvous KV "
+           "(/serve/replicas/<id>); the router treats a replica whose "
+           "heartbeat is older than 2x this as dead and routes around "
+           "it — the serving analog of the elastic dead-peer bound."),
+        _k("HVDT_SERVE_SLO_P99_MS", 0.0, float,
+           "p99 latency SLO (ms) for routing and autoscaling: the "
+           "router ejects a replica whose reported p99 breaches it, "
+           "and the autoscaler scales up while the fleet p99 sits "
+           "above it.  0 = no SLO enforcement."),
+        _k("HVDT_SERVE_REPLICAS", 1, int,
+           "Initial/target replica count for `hvdtrun serve "
+           "--replicas` (the elastic serving control plane; 1 = the "
+           "single-replica PR-2 path unless --autoscale raises it)."),
+        _k("HVDT_SERVE_MAX_REPLICAS", 4, int,
+           "Autoscaler ceiling on replica count (and the localhost "
+           "slot count of the default serve host discovery)."),
+        _k("HVDT_SERVE_AUTOSCALE", False, _parse_bool,
+           "Enable the replica autoscaler loop: scale up on queue "
+           "depth per replica / p99-over-SLO, scale down on idle "
+           "queues, within [1, HVDT_SERVE_MAX_REPLICAS]."),
+        _k("HVDT_SERVE_SCALE_COOLDOWN_S", 10.0, float,
+           "Minimum seconds between autoscaler scale events — resize "
+           "decisions must not flap faster than replicas boot/drain."),
+        _k("HVDT_SERVE_QUEUE_HI", 16.0, float,
+           "Scale-UP watermark: mean queued rows per live replica "
+           "above this adds a replica (queue depth is the leading "
+           "indicator; p99 breaches confirm it)."),
+        _k("HVDT_SERVE_QUEUE_LO", 2.0, float,
+           "Scale-DOWN watermark: mean queued rows per replica below "
+           "this (with p99 inside the SLO) drains the newest replica."),
+        _k("HVDT_SERVE_ROUTER_PORT", 0, int,
+           "Bind port for the serving router front tier (0 = "
+           "ephemeral; the router logs the bound port on start)."),
+        _k("HVDT_SERVE_EJECT_COOLDOWN_S", 3.0, float,
+           "Seconds an ejected replica (failed probe / SLO breach / "
+           "dispatch failures) sits out of routing before re-admission "
+           "— doubles per repeated ejection like the elastic host "
+           "blacklist cooldown."),
+        _k("HVDT_SERVE_HEDGE_MS", 0.0, float,
+           "Hedge-request threshold (ms): a /predict still unanswered "
+           "past it is duplicated to a second replica and the first "
+           "response wins.  0 = adaptive (hedge past ~2x the router's "
+           "observed p99, floored at 50 ms); negative = hedging off."),
         # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
         #     LibType selection env_parser.cc) ---
         _k("HVDT_CPU_OPERATIONS", "xla", str,

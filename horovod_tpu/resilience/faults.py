@@ -41,6 +41,16 @@ i.e. comma-separated ``kind@key=value:key=value`` entries.  Kinds:
   a degraded filesystem.  Under the synchronous save the step loop
   stalls for the full sleep; under ``HVDT_ASYNC_CKPT`` only the
   background writer does — the testable form of the non-blocking claim.
+* ``serve_crash`` — ``crash`` fired from the serving data path: the
+  replica's predict admission (``serve.predict`` point, ``step`` =
+  the replica's served-request count) or, via ``point=serve.dispatch``,
+  the router's dispatch loop.  ``serve_crash@step=40:rank=2`` kills
+  replica 2 at its 40th request — the mid-request death the router's
+  retry budget must absorb without a dropped request.
+* ``slow_replica`` — sleep ``secs`` in the serving path with
+  probability ``p`` (``slow_replica@p=0.1:secs=2``): a degraded
+  replica.  The router's hedging and p99-SLO ejection are the
+  production answer; this is how they are chaos-tested.
 
 Match keys: ``step`` (fires once at the first point whose step >= it —
 commits are periodic, so exact equality would silently never fire),
@@ -83,7 +93,8 @@ __all__ = ["InjectedFault", "FaultSpec", "FaultInjector", "parse_plan",
 log = get_logger(__name__)
 
 KINDS = ("crash", "hang", "exc", "corrupt_ckpt", "kv_drop",
-         "pod_crash", "pod_partition", "slow_disk")
+         "pod_crash", "pod_partition", "slow_disk",
+         "serve_crash", "slow_replica")
 
 # Default injection point per kind (spec may override with point=).
 _DEFAULT_POINT = {
@@ -95,6 +106,8 @@ _DEFAULT_POINT = {
     "pod_crash": "step",
     "pod_partition": "step",
     "slow_disk": "checkpoint.write",
+    "serve_crash": "serve.predict",
+    "slow_replica": "serve.predict",
 }
 
 
@@ -346,14 +359,15 @@ class FaultInjector:
                  rank: Optional[int], ctx: Dict[str, Any]) -> None:
         log.warning("FAULT INJECTION: %s at point=%s step=%s rank=%s",
                     spec.kind, point, step, rank)
-        if spec.kind in ("crash", "pod_crash"):
+        if spec.kind in ("crash", "pod_crash", "serve_crash"):
             # os._exit, not sys.exit: a real crash runs no finalizers, no
             # atexit checkpointing, no graceful shutdown — that is the
             # point.  pod_crash is the same hard death, pod-scoped: each
             # rank of the matched pod dies at its own injection point,
             # producing the correlated whole-slice loss.
             self._exit(spec.code)
-        elif spec.kind in ("hang", "pod_partition", "slow_disk"):
+        elif spec.kind in ("hang", "pod_partition", "slow_disk",
+                           "slow_replica"):
             # pod_partition: the matched pod's ranks block here — peers
             # outside the pod observe stalled heartbeats/collectives,
             # exactly what a network partition of the slice looks like.

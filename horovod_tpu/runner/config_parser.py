@@ -47,6 +47,12 @@ YAML shape (mirrors the reference's config sections)::
       straggler_window: 64
       trace_dir: /tmp/hvdt-trace
       flight_recorder: true
+    serve:
+      replicas: 2
+      max_replicas: 4
+      autoscale: true
+      slo_p99_ms: 250
+      heartbeat_s: 2.0
     library_options:
       cpu_operations: tcp
       tcp_port_stride: 128
@@ -257,6 +263,30 @@ KNOB_FLAGS: List[_Flag] = [
           "cross-rank desync report, on preemption, and via the "
           "exporter's /flightrecorder endpoint).", is_bool=True,
           to_env=_bool_env),
+    # --- serving control plane (serve/autoscale.py + serve/router.py;
+    #     `hvdtrun serve` reads the same HVDT_SERVE_* envs, so a YAML
+    #     serve: section configures a fleet launch end to end) ---
+    _Flag("--serve-replicas", "serve_replicas", "HVDT_SERVE_REPLICAS",
+          "serve", "replicas",
+          "Initial replica count for the elastic serving control plane "
+          "(`hvdtrun serve --replicas` reads this default).", type=int),
+    _Flag("--serve-max-replicas", "serve_max_replicas",
+          "HVDT_SERVE_MAX_REPLICAS", "serve", "max_replicas",
+          "Autoscaler replica ceiling / localhost slot count.",
+          type=int),
+    _Flag("--serve-autoscale", "serve_autoscale", "HVDT_SERVE_AUTOSCALE",
+          "serve", "autoscale",
+          "Enable the serving replica autoscaler (queue depth + "
+          "p99-vs-SLO from the KV heartbeats).", is_bool=True,
+          to_env=_bool_env),
+    _Flag("--serve-slo-p99-ms", "serve_slo_p99_ms",
+          "HVDT_SERVE_SLO_P99_MS", "serve", "slo_p99_ms",
+          "Serving p99 SLO (ms): router ejection + autoscale-up "
+          "threshold (0 = off).", type=float),
+    _Flag("--serve-heartbeat-s", "serve_heartbeat_s",
+          "HVDT_SERVE_HEARTBEAT_S", "serve", "heartbeat_s",
+          "Replica heartbeat period (s); 2x this is the router's "
+          "dead-replica bound.", type=float),
     # --- library options ---
     _Flag("--cpu-operations", "cpu_operations", "HVDT_CPU_OPERATIONS",
           "library_options", "cpu_operations",

@@ -170,6 +170,22 @@ class ElasticDriver:
         collective failure recovery in its training loop)."""
         self.registry.record_ready(rank)
 
+    def resize(self, min_np: Optional[int] = None,
+               max_np: Optional[int] = None) -> None:
+        """Scale hook: adjust the world-size bounds mid-run.  The next
+        rendezvous plans assignments against the new bounds; live
+        workers are nudged through the hosts-updated channel so one
+        lands at their next commit.  This is the driver-side seam the
+        serving autoscaler's policy layer and the online controller
+        (ROADMAP item 5) drive — resize decisions stay outside the
+        rendezvous machinery itself."""
+        with self._lock:
+            if min_np is not None:
+                self._min_np = max(1, int(min_np))
+            if max_np is not None:
+                self._max_np = max(self._min_np, int(max_np))
+        self._notify_hosts_updated()
+
     def telemetry_snapshots(self):
         """Aggregate worker telemetry snapshots from the rendezvous KV
         (workers publish /telemetry/<rank> every

@@ -363,9 +363,14 @@ def run_static(args) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if argv and argv[0] == "serve":
-        # `hvdtrun serve ...` — the serving front end (one replica per
-        # invocation; scale-out is N invocations behind a load
-        # balancer).  Flags after `serve` are the serve CLI's (see
+        # `hvdtrun serve ...` — the serving plane.  Bare: one replica,
+        # direct HTTP.  With --replicas/--autoscale: the elastic serving
+        # control plane (serve/autoscale.py) — rendezvous KV + replica
+        # fleet + SLO router, sharing the training driver's discovery/
+        # blacklist/drain machinery, e.g.
+        #   hvdtrun serve --checkpoint /ckpts --replicas 3 --autoscale \
+        #       --slo-p99-ms 250
+        # Flags after `serve` are the serve CLI's (see
         # horovod_tpu/serve/__main__.py).
         from ..serve import main as serve_main
 

@@ -14,14 +14,26 @@ from millions of users").  Layering, bottom up:
   ``CheckpointManager`` directory and hot-swaps newer steps;
 * :mod:`~horovod_tpu.serve.server`  — :class:`ModelServer`: stdlib HTTP
   front end (``/predict``, ``/healthz``, ``/metrics``) with 503
-  backpressure.
+  backpressure and SIGTERM graceful drain;
+* :mod:`~horovod_tpu.serve.replica` — :class:`ReplicaRegistrar`: KV
+  heartbeats (load + p99) that wire one replica into the elastic
+  serving control plane, plus the ``--replica-worker`` entry;
+* :mod:`~horovod_tpu.serve.router`  — :class:`Router`: the front tier —
+  discovers live replicas from the rendezvous KV, load-balances
+  ``/predict`` with retries/hedging, ejects SLO-breaching replicas;
+* :mod:`~horovod_tpu.serve.autoscale` — :class:`ServeDriver` +
+  :class:`AutoscalePolicy`: the driver-side replica autoscaler on the
+  pod-aware elastic machinery (discovery, blacklist-with-cooldown,
+  drain-then-exit-83 clean removal).
 
 Entry points: ``python -m horovod_tpu.serve`` and ``hvdtrun serve``
-(:func:`main`); in-process embedding via :class:`ModelServer` directly
+(:func:`main`; ``--replicas``/``--autoscale`` switch to the elastic
+control plane); in-process embedding via :class:`ModelServer` directly
 (the test rig and bench.py --serve do this).
 """
 
-from .batcher import BackpressureError, DynamicBatcher  # noqa: F401
+from .batcher import (BackpressureError, DispatcherDied,  # noqa: F401
+                      DynamicBatcher, RequestDeadlineExceeded)
 from .engine import InferenceEngine, parse_buckets  # noqa: F401
 from .metrics import MetricsRegistry  # noqa: F401
 from .reload import CheckpointWatcher  # noqa: F401
@@ -29,6 +41,7 @@ from .server import ModelServer  # noqa: F401
 
 __all__ = [
     "InferenceEngine", "DynamicBatcher", "BackpressureError",
+    "DispatcherDied", "RequestDeadlineExceeded",
     "CheckpointWatcher", "ModelServer", "MetricsRegistry",
     "parse_buckets", "main",
 ]

@@ -59,7 +59,63 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         "compiled buckets).")
     p.add_argument("--no-warmup", action="store_true",
                    help="Skip pre-compiling every bucket at startup.")
+    # --- elastic serving control plane (serve/autoscale.py + router) ---
+    p.add_argument("--replicas", type=int, default=None,
+                   help="Run the elastic serving control plane with this "
+                        "many replicas behind the router (default: "
+                        "HVDT_SERVE_REPLICAS; omit for the single-"
+                        "replica direct server).")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="Replica ceiling for the autoscaler / localhost "
+                        "slot count (default: HVDT_SERVE_MAX_REPLICAS).")
+    p.add_argument("--autoscale", action="store_true",
+                   help="Enable the replica autoscaler (queue depth / "
+                        "p99-vs-SLO from the KV heartbeats; implies the "
+                        "elastic control plane).")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="p99 latency SLO in ms: the router ejects "
+                        "breaching replicas, the autoscaler scales "
+                        "while the fleet breaches (default: "
+                        "HVDT_SERVE_SLO_P99_MS; 0 = off).")
+    p.add_argument("--router-port", type=int, default=None,
+                   help="Router bind port (default: "
+                        "HVDT_SERVE_ROUTER_PORT; 0 = ephemeral).")
+    p.add_argument("--host-discovery-script", default=None,
+                   help="Discovery executable printing host[:slots]"
+                        "[@pod] lines for the replica fleet (default: "
+                        "localhost with --max-replicas slots).")
+    p.add_argument("--target-file", default=None,
+                   help="Operator override: a file holding the desired "
+                        "replica count, polled by the driver (echo 3 > "
+                        "FILE resizes the fleet; remove to hand control "
+                        "back to the autoscaler).")
+    # Internal: set by the serve driver on spawned replica workers
+    # (rendezvous env contract; heartbeats, drains, exits 83).
+    p.add_argument("--replica-worker", action="store_true",
+                   help=argparse.SUPPRESS)
     return p.parse_args(argv)
+
+
+_CONTROL_FLAGS = {"--replicas": 1, "--max-replicas": 1, "--autoscale": 0,
+                  "--slo-p99-ms": 1, "--router-port": 1,
+                  "--host-discovery-script": 1, "--target-file": 1,
+                  "--replica-worker": 0}
+
+
+def strip_control_flags(argv):
+    """The serve argv minus the control-plane flags — what the driver
+    hands each spawned replica worker (which adds --replica-worker)."""
+    out, skip = [], 0
+    for tok in argv:
+        if skip:
+            skip -= 1
+            continue
+        flag = tok.split("=", 1)[0]
+        if flag in _CONTROL_FLAGS:
+            skip = _CONTROL_FLAGS[flag] if "=" not in tok else 0
+            continue
+        out.append(tok)
+    return out
 
 
 def build_server(args):
@@ -108,7 +164,20 @@ def build_server(args):
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
     args = parse_args(argv)
+    if args.replica_worker:
+        # One replica under the serving driver: heartbeat into the
+        # rendezvous KV, serve until drained, exit 83 for clean removal.
+        from .replica import run_replica
+
+        return run_replica(args)
+    if args.replicas is not None or args.autoscale:
+        # The elastic serving control plane: driver + replica fleet +
+        # router in this process group (serve/autoscale.py).
+        from .autoscale import run_serve_elastic
+
+        return run_serve_elastic(args, strip_control_flags(argv))
     server, feat_shape = build_server(args)
     # Load the newest checkpoint BEFORE binding: a replica that cannot
     # find weights should say so immediately, then (deliberately) still

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,7 +34,9 @@ import numpy as np
 
 from ..common import config
 from ..common.logging_util import get_logger
-from .batcher import BackpressureError, DynamicBatcher
+from ..resilience import faults
+from .batcher import (BackpressureError, DispatcherDied, DynamicBatcher,
+                      RequestDeadlineExceeded)
 from .engine import InferenceEngine
 from .metrics import MetricsRegistry
 from .reload import CheckpointWatcher
@@ -82,7 +85,10 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         if self.path.split("?")[0] == "/healthz":
             payload = {
-                "status": "ok",
+                # "draining" tells the router/registrar to stop sending
+                # work while in-flight batches finish; still HTTP 200 —
+                # a draining replica is healthy, just leaving.
+                "status": "draining" if srv.draining else "ok",
                 "model_version": srv.engine.params_version,
                 "checkpoint_step": (srv.watcher.current_step
                                     if srv.watcher else None),
@@ -103,6 +109,24 @@ class _Handler(BaseHTTPRequestHandler):
             return
         srv = self.server_ref
         t0 = time.perf_counter()
+        # Admission gate BEFORE any parsing work: a draining replica
+        # sheds with a retryable 503 (the router re-routes; a direct
+        # client honors Retry-After) — never a dropped connection.  The
+        # body is still consumed: leaving it unread would desync the
+        # keep-alive stream (the next "request line" would be JSON).
+        if srv.draining:
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self._reply(503, {"error": "replica draining"},
+                        extra_headers={"Retry-After": "1"})
+            self._observe("predict", t0, 503)
+            return
+        srv._inflight_enter()
+        try:
+            self._do_predict(srv, t0)
+        finally:
+            srv._inflight_exit()
+
+    def _do_predict(self, srv: "ModelServer", t0: float) -> None:
         try:
             length = int(self.headers.get("Content-Length", 0))
             doc = json.loads(self.rfile.read(length))
@@ -113,6 +137,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"bad request: {e}"})
             self._observe("predict", t0, 400)
             return
+        # Serving-plane chaos seam: serve_crash / slow_replica fire here
+        # (step = this replica's admitted-request count) so replica
+        # death and degradation are injected mid-request, exactly where
+        # production failures land.
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.fire("serve.predict", step=srv.request_seq())
         try:
             version = srv.engine.params_version
             future = srv.batcher.submit(inputs)
@@ -121,12 +152,25 @@ class _Handler(BaseHTTPRequestHandler):
                         extra_headers={"Retry-After": "1"})
             self._observe("predict", t0, 503)
             return
+        except DispatcherDied as e:
+            # Typed, retryable: this replica's dispatch plane is gone
+            # (dying or torn down) — tell the caller to go elsewhere.
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "1"})
+            self._observe("predict", t0, 503)
+            return
         try:
             outputs = future.result(timeout=srv.request_timeout_s)
-        except (concurrent.futures.TimeoutError, TimeoutError):
+        except (concurrent.futures.TimeoutError, TimeoutError,
+                RequestDeadlineExceeded):
             future.cancel()
             self._reply(504, {"error": "deadline exceeded"})
             self._observe("predict", t0, 504)
+            return
+        except DispatcherDied as e:
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "1"})
+            self._observe("predict", t0, 503)
             return
         except Exception as e:
             self._reply(500, {"error": f"inference failed: {e}"})
@@ -192,6 +236,19 @@ class ModelServer:
                 checkpoint_dir, engine, template, metrics=self.metrics)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # Graceful-drain state (resilience/preempt.py idiom: the signal
+        # handler only sets a flag; the heavy work happens in main flow).
+        self._draining = threading.Event()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._inflight_zero = threading.Condition(self._inflight_lock)
+        self._request_seq = 0
+        self._prev_handlers: dict = {}
+        self._drain_gauge = self.metrics.gauge(
+            "serve_draining", "1 while this replica drains (admission "
+            "closed, in-flight requests completing), else 0")
+        self._drain_gauge.set_function(
+            lambda: 1.0 if self.draining else 0.0)
 
     def start(self) -> int:
         """Bind + serve in a daemon thread; starts the reload watcher.
@@ -209,9 +266,82 @@ class ModelServer:
                  self.port, list(self.engine.buckets))
         return self.port
 
-    def stop(self) -> None:
-        """Graceful teardown: stop admitting, drain the batcher, stop the
-        watcher and the HTTP listener."""
+    # ---- graceful drain --------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def request_seq(self) -> int:
+        """Monotone admitted-request count — the ``step`` coordinate of
+        the serving fault plan (``serve_crash@step=N``)."""
+        with self._inflight_lock:
+            return self._request_seq
+
+    def _inflight_enter(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            self._request_seq += 1
+
+    def _inflight_exit(self) -> None:
+        with self._inflight_zero:
+            self._inflight -= 1
+            if self._inflight <= 0:
+                self._inflight_zero.notify_all()
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Flip to draining and wait for in-flight requests to finish.
+
+        From the flip on: ``/healthz`` reports ``draining``, ``/predict``
+        sheds with 503 + Retry-After, and requests already past
+        admission run to completion.  The listener socket stays OPEN the
+        whole time — a close here would RST exactly the connections the
+        drain exists to protect.  Returns True when in-flight hit zero
+        inside ``timeout``."""
+        self._draining.set()
+        deadline = time.monotonic() + timeout
+        with self._inflight_zero:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log.warning("serve drain: %d request(s) still in "
+                                "flight after %.1fs", self._inflight,
+                                timeout)
+                    return False
+                self._inflight_zero.wait(remaining)
+        return True
+
+    def install_drain_handlers(self,
+                               signals=(signal.SIGTERM, signal.SIGINT)
+                               ) -> None:
+        """SIGTERM/SIGINT → drain flag (main thread only).  The handler
+        is trivial by design; whoever owns the serving loop (the replica
+        worker, ``serve_forever``) polls :attr:`draining` and performs
+        the actual drain + exit in main flow."""
+        for sig in signals:
+            self._prev_handlers[sig] = signal.signal(
+                sig, lambda signum, frame: self._draining.set())
+
+    def uninstall_drain_handlers(self) -> None:
+        for sig, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):   # non-main thread / teardown
+                pass
+        self._prev_handlers.clear()
+
+    def stop(self, drain_timeout: float = 30.0) -> None:
+        """Graceful teardown: stop admitting (drain flag), let in-flight
+        requests complete, then stop the watcher, the HTTP listener, and
+        the batcher.  Ordering matters: the socket closes only after the
+        last in-flight response was written — zero connection resets."""
+        self._draining.set()
+        self.drain(timeout=drain_timeout)
         if self.watcher is not None:
             self.watcher.stop()
         if self._httpd is not None:
@@ -224,12 +354,19 @@ class ModelServer:
             self._thread = None
 
     def serve_forever(self) -> None:
-        """start() + block until KeyboardInterrupt (the CLI entry path)."""
+        """start() + block until KeyboardInterrupt or a drain signal
+        (the CLI entry path installs SIGTERM/SIGINT drain handlers, so a
+        preempted replica finishes its in-flight work before exiting)."""
         self.start()
         try:
-            while True:
-                time.sleep(3600)
+            self.install_drain_handlers()
+        except ValueError:      # not the main thread (embedded use)
+            pass
+        try:
+            while not self._draining.wait(1.0):
+                pass
         except KeyboardInterrupt:
             pass
         finally:
+            self.uninstall_drain_handlers()
             self.stop()

@@ -506,9 +506,11 @@ class TestModelServerEndToEnd:
         server = ModelServer(engine, port=0, max_batch_size=1,
                              max_delay_ms=1.0, max_queue_depth=2)
         release = threading.Event()
+        entered = threading.Event()
         real_infer = engine.infer
 
         def gated(x):
+            entered.set()
             release.wait(timeout=60)
             return real_infer(x)
 
@@ -524,8 +526,14 @@ class TestModelServerEndToEnd:
                                      args=(port, {"inputs": x}))
                 t.start()
                 return t
-            # One in (blocked) dispatch + two rows filling the bound.
-            for _ in range(3):
+            # One request into (blocked) dispatch...
+            pending.append(bg(np.zeros((1, SIZES[0])).tolist()))
+            # ...and only once the dispatch thread has POPPED it (the
+            # gate is entered) do the two queue-fillers go in — racing
+            # them against the pop would shed one of THEM at the bound
+            # instead of the fourth request below.
+            assert entered.wait(timeout=30)
+            for _ in range(2):
                 pending.append(bg(np.zeros((1, SIZES[0])).tolist()))
             deadline = time.time() + 30
             while server.batcher.queue_depth() < 2 \

@@ -11,8 +11,11 @@
 #       zero-sharding/reduce-scatter-wire +
 #       pod-granular-elastic/multipod-recovery +
 #       continuous-goodput/async-checkpoint/peer-restore +
-#       elastic-serving-control-plane/router/autoscaler tests on
-#       CPU) — the pre-merge gate.
+#       elastic-serving-control-plane/router/autoscaler +
+#       static-analysis/schedule-fingerprint tests on
+#       CPU) — the pre-merge gate.  The full matrix additionally
+#       emits the `analysis` service: python -m horovod_tpu.analysis
+#       --all as a hard gate over the hvdt-lint ratchet baseline.
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

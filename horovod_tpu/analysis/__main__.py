@@ -1,0 +1,294 @@
+"""``python -m horovod_tpu.analysis`` — the static-analysis CI gate.
+
+Modes (``--all`` = lint + locks + knob-table check + schedule
+self-check; the default with no flags):
+
+* ``--lint``            run the AST rule registry against the ratchet
+                        baseline (``.hvdt-lint-baseline.json``)
+* ``--locks``           lock-order graph; new cycles fail
+* ``--knob-table``      print the generated knob table
+  (``--write PATH``    write it, e.g. ``--write docs/knobs.md``;
+  ``--check``          fail on registry/docs drift)
+* ``--selfcheck``       trace the reference overlapped + hierarchical
+                        step and run every schedule verifier pass
+* ``--schedule OUT``    export the self-check step's fingerprint JSON
+                        (feed it to ``HVDT_EXPECTED_SCHEDULE``)
+* ``--update-baseline`` re-key the baseline from current findings
+                        (keeps written reasons and lock suppressions)
+* ``--dump-locks``      print the full acquisition-order edge list
+
+Exit code 0 = every requested gate clean; 1 = violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["main"]
+
+
+def _repo_root(explicit: Optional[str]) -> str:
+    if explicit:
+        return os.path.abspath(explicit)
+    # package lives at <root>/horovod_tpu/analysis/__main__.py
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _gate_lint(root: str, baseline: str, update: bool) -> int:
+    from .lint import run_lint
+
+    new, suppressed, stale = run_lint(root, baseline_path=baseline,
+                                      update_baseline=update)
+    if update:
+        print(f"hvdt-lint: baseline rewritten with "
+              f"{len(suppressed)} suppression(s) -> {baseline}")
+        return 0
+    for f in new:
+        print(f.format())
+    if stale:
+        print(f"hvdt-lint: {len(stale)} stale baseline suppression(s) "
+              f"(violation fixed — prune to ratchet down):")
+        for k in stale:
+            print(f"  {k}")
+    print(f"hvdt-lint: {len(new)} new, {len(suppressed)} baselined, "
+          f"{len(stale)} stale")
+    return 1 if new else 0
+
+
+def _gate_locks(root: str, baseline: str, dump: bool) -> int:
+    from .lint import load_baseline
+    from .locks import find_cycles, format_edge, run_locks
+
+    cycles, edges = run_locks(root, baseline=load_baseline(baseline))
+    if dump:
+        for e in edges:
+            print(format_edge(e))
+    n_total = len(find_cycles(edges))
+    for c in cycles:
+        print("lock-order cycle: " + " -> ".join(c + [c[0]]))
+    print(f"hvdt-locks: {len(edges)} acquisition edge(s), "
+          f"{n_total} cycle(s), {len(cycles)} new")
+    return 1 if cycles else 0
+
+
+def _gate_knobs(root: str, check: bool, write: Optional[str]) -> int:
+    from .lint import check_knob_docs, knob_table_markdown, write_knob_table
+
+    if write:
+        path = write if os.path.isabs(write) else os.path.join(root, write)
+        write_knob_table(path)
+        print(f"hvdt-knobs: wrote {path}")
+        return 0
+    if check:
+        problems = check_knob_docs(root)
+        for p in problems:
+            print(f"hvdt-knobs: {p}")
+        print(f"hvdt-knobs: {len(problems)} drift problem(s)")
+        return 1 if problems else 0
+    print(knob_table_markdown())
+    return 0
+
+
+def _selfcheck_step():
+    """Build the reference program pair for the schedule self-check:
+    the overlapped bucketed exchange on a two-tier (dcn, ici) mesh —
+    once plain, once under the hierarchical transport policy.  Runs on
+    however many devices exist (axis sizes degrade to 1; the jaxpr
+    still carries every collective)."""
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:                     # jax 0.4.x
+        from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    inner = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    mesh = Mesh(np.asarray(devs, dtype=object).reshape(n // inner, inner),
+                ("dcn", "ici"))
+    smap_kw = {}
+    sig = inspect.signature(shard_map).parameters
+    if "check_rep" in sig:
+        smap_kw["check_rep"] = False
+    elif "check_vma" in sig:
+        smap_kw["check_vma"] = False
+
+    rows = mesh.shape["dcn"] * mesh.shape["ici"]
+    tree = {
+        "w": jnp.zeros((rows, 96), jnp.float32),
+        "b": jnp.zeros((rows, 17), jnp.float32),
+        "i": jnp.zeros((rows, 8), jnp.int32),
+    }
+    leaves = list(tree.values())
+
+    def traced(*ls):
+        from ..common.types import ReduceOp
+        from ..ops.overlap import OverlapScheduler
+
+        out = OverlapScheduler().exchange(
+            list(ls), axis=("dcn", "ici"), op=ReduceOp.AVERAGE,
+            threshold_bytes=4096)
+        return tuple(out)
+
+    def step(*ls):
+        return shard_map(traced, mesh=mesh,
+                         in_specs=(P(("dcn", "ici")),) * len(ls),
+                         out_specs=(P(),) * len(ls), **smap_kw)(*ls)
+
+    return step, leaves, tree
+
+
+def _gate_selfcheck(export: Optional[str], root: str) -> int:
+    from . import schedule as sched
+
+    problems: List[str] = []
+    old_env = {k: os.environ.get(k)
+               for k in ("HVDT_OVERLAP", "HVDT_TRANSPORT")}
+    try:
+        os.environ["HVDT_OVERLAP"] = "on"
+        os.environ.pop("HVDT_TRANSPORT", None)
+        from ..ops import overlap as ovl
+        from ..transport import policy as tpolicy
+
+        ovl.reset()
+        tpolicy.reset()
+        step, leaves, tree = _selfcheck_step()
+
+        fp1 = sched.extract_schedule(step, *leaves, label="overlap-plain")
+        fp2 = sched.extract_schedule(step, *leaves, label="overlap-plain")
+        if fp1.digest != fp2.digest:
+            problems.append("schedule fingerprint unstable across two "
+                            "traces of the same program")
+        if not fp1.events:
+            problems.append("self-check step traced no collectives")
+        problems.extend(
+            f["message"]
+            for f in sched.verify_no_data_dependent_collectives(fp1))
+        problems.extend(
+            f["message"]
+            for f in sched.verify_bucket_plan_invariance(leaves, 4096))
+
+        # Hierarchical leg: post-pin collectives must stay psum-family.
+        os.environ["HVDT_TRANSPORT"] = \
+            "ici:ring:f32:64M,dcn:ring:f32:64M"
+        tpolicy.reset()
+        step_h, leaves_h, _ = _selfcheck_step()
+        fp_h = sched.extract_schedule(step_h, *leaves_h,
+                                      label="overlap-hier")
+        problems.extend(
+            f["message"]
+            for f in sched.verify_post_pin_psum_family(fp_h))
+        problems.extend(
+            f["message"]
+            for f in sched.verify_no_data_dependent_collectives(fp_h))
+
+        if export:
+            path = export if os.path.isabs(export) \
+                else os.path.join(root, export)
+            fp1.save(path)
+            print(f"hvdt-schedule: exported {fp1.summary()} -> {path}")
+        print(f"hvdt-schedule: {fp1.summary()}")
+        print(f"hvdt-schedule: {fp_h.summary()}")
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        from ..ops import overlap as ovl
+        from ..transport import policy as tpolicy
+
+        ovl.reset()
+        tpolicy.reset()
+    for p in problems:
+        print(f"hvdt-schedule: FAIL {p}")
+    print(f"hvdt-schedule: {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="Static distributed-correctness analysis "
+                    "(collective-schedule verifier + hvdt-lint + "
+                    "lock-order graph).")
+    p.add_argument("--all", action="store_true",
+                   help="lint + locks + knob-table drift check + "
+                        "schedule self-check (the CI gate; default "
+                        "when no mode flag is given)")
+    p.add_argument("--lint", action="store_true")
+    p.add_argument("--locks", action="store_true")
+    p.add_argument("--knob-table", action="store_true",
+                   help="print the generated knob table")
+    p.add_argument("--check", action="store_true",
+                   help="with --knob-table: fail on docs drift")
+    p.add_argument("--write", default=None, metavar="PATH",
+                   help="with --knob-table: write the generated doc")
+    p.add_argument("--selfcheck", action="store_true",
+                   help="trace the reference step and run the "
+                        "schedule verifier passes")
+    p.add_argument("--schedule", default=None, metavar="OUT.json",
+                   help="export the self-check fingerprint (implies "
+                        "--selfcheck)")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="ratchet baseline file (default: "
+                        "<root>/.hvdt-lint-baseline.json)")
+    p.add_argument("--update-baseline", action="store_true")
+    p.add_argument("--dump-locks", action="store_true")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the checkout containing "
+                        "this package)")
+    args = p.parse_args(argv)
+
+    root = _repo_root(args.root)
+    from .lint import BASELINE_NAME
+
+    baseline = args.baseline or os.path.join(root, BASELINE_NAME)
+
+    any_mode = (args.lint or args.locks or args.knob_table
+                or args.selfcheck or args.schedule or args.dump_locks)
+    if args.all or not any_mode:
+        args.lint = args.locks = args.selfcheck = True
+        args.knob_table, args.check = True, True
+
+    rc = 0
+    if args.update_baseline:
+        # Re-key lint findings; carry lock-cycle suppressions through.
+        from .lint import (default_paths, lint_paths, load_baseline,
+                           save_baseline)
+
+        old = load_baseline(baseline)
+        keep = {k: v for k, v in old.items()
+                if k.startswith("lock-cycle:")}
+        all_findings = lint_paths(default_paths(root), root=root)
+        save_baseline(baseline, all_findings, reasons=old, keep=keep)
+        print(f"hvdt-lint: baseline rewritten with "
+              f"{len(all_findings)} lint + {len(keep)} lock "
+              f"suppression(s) -> {baseline}")
+        return 0
+
+    if args.lint:
+        rc |= _gate_lint(root, baseline, update=False)
+    if args.locks or args.dump_locks:
+        rc |= _gate_locks(root, baseline, dump=args.dump_locks)
+    if args.knob_table:
+        rc |= _gate_knobs(root, check=args.check, write=args.write)
+    if args.selfcheck or args.schedule:
+        rc |= _gate_selfcheck(args.schedule, root)
+    print(f"hvdt-analysis: {'CLEAN' if rc == 0 else 'VIOLATIONS'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""Static lock-order graph over the threaded control plane.
+
+The serving router, dynamic batcher, replica autoscaler, async
+checkpoint writer, stall escalator, and the eager controller loop are
+all lock-per-object threaded code.  A deadlock between two of them
+would present exactly like a training stall — the escalator would abort
+and the flight recorder would show *nothing* divergent, because the
+hang is host-side.  This module makes the acquisition order a checked
+artifact instead of a convention:
+
+* :func:`extract_lock_graph` walks each module's AST collecting nested
+  ``with <lock>:`` acquisitions (``with a: ... with b:`` and
+  ``with a, b:`` both record the edge ``a -> b``).  A context
+  expression counts as a lock when its terminal name ends in ``lock``
+  or ``mutex`` (``self._lock``, ``kv_server.lock``, ``_cache_lock``).
+  Locks are keyed per class (``module.Class.name``) so two classes'
+  private ``_lock`` attributes stay distinct nodes.
+
+* :func:`find_cycles` runs SCC detection over the merged graph; any
+  cycle is a potential ABBA deadlock.  Cycles are reported with every
+  edge's acquisition site and gated through the same ratcheting
+  baseline as the lint rules (cycle keys are canonical rotations, so
+  unrelated edits never churn them).
+
+Static analysis cannot see acquisitions made through function calls or
+locks aliased through locals — the graph is a *lower bound*.  That is
+the useful direction for a ratchet: every edge it does see is real, so
+a new cycle is a real ordering inversion introduced by the change under
+review.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["LockEdge", "extract_lock_graph", "find_cycles",
+           "cycle_key", "run_locks", "format_edge"]
+
+_LOCK_SUFFIXES = ("lock", "mutex")
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    """Terminal dotted name when ``expr`` looks like a lock, else None.
+    ``with self._lock:`` -> 'self._lock'; ``with lock:`` -> 'lock'."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return None
+    parts.reverse()
+    leaf = parts[-1].lower().lstrip("_")
+    if any(leaf == s or leaf.endswith("_" + s) for s in _LOCK_SUFFIXES):
+        return ".".join(parts)
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class LockEdge:
+    """outer acquired, then inner, while outer still held."""
+
+    outer: str
+    inner: str
+    path: str
+    line: int
+
+
+def format_edge(e: LockEdge) -> str:
+    return f"{e.outer} -> {e.inner} ({e.path}:{e.line})"
+
+
+class _LockWalker(ast.NodeVisitor):
+    def __init__(self, relpath: str, modname: str):
+        self.relpath = relpath
+        self.modname = modname
+        self.class_stack: List[str] = []
+        self.held: List[str] = []
+        self.edges: List[LockEdge] = []
+
+    def _qualify(self, name: str) -> str:
+        scope = ".".join([self.modname] + self.class_stack) \
+            if self.class_stack else self.modname
+        return f"{scope}:{name}"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: List[str] = []
+        for item in node.items:
+            name = _lock_name(item.context_expr)
+            if name is None:
+                continue
+            q = self._qualify(name)
+            for held in self.held + acquired:
+                if held != q:
+                    self.edges.append(LockEdge(held, q, self.relpath,
+                                               node.lineno))
+            acquired.append(q)
+        self.held.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With  # type: ignore[assignment]
+
+
+def extract_lock_graph(paths: Sequence[str], root: str = ""
+                       ) -> List[LockEdge]:
+    """Every statically-visible nested lock acquisition across
+    ``paths`` (deduplicated by (outer, inner, site))."""
+    edges: List[LockEdge] = []
+    seen: Set[Tuple[str, str, str, int]] = set()
+    for p in paths:
+        try:
+            src = open(p, encoding="utf-8").read()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError):
+            continue
+        rel = os.path.relpath(p, root) if root else p
+        modname = os.path.splitext(rel.replace(os.sep, "/"))[0]
+        w = _LockWalker(rel, modname)
+        w.visit(tree)
+        for e in w.edges:
+            k = (e.outer, e.inner, e.path, e.line)
+            if k not in seen:
+                seen.add(k)
+                edges.append(e)
+    return edges
+
+
+def cycle_key(cycle: Sequence[str]) -> str:
+    """Canonical (rotation-invariant) identity of a lock cycle — the
+    baseline key that survives unrelated edits."""
+    nodes = list(cycle)
+    i = nodes.index(min(nodes))
+    rot = nodes[i:] + nodes[:i]
+    return "lock-cycle:" + "->".join(rot)
+
+
+def find_cycles(edges: Iterable[LockEdge]) -> List[List[str]]:
+    """Elementary cycles over the acquisition-order graph (DFS per SCC;
+    multi-node SCCs are reported as their shortest constituent cycle
+    per back edge).  Any cycle = two code paths that can interleave
+    into an ABBA deadlock."""
+    graph: Dict[str, Set[str]] = {}
+    for e in edges:
+        graph.setdefault(e.outer, set()).add(e.inner)
+        graph.setdefault(e.inner, set())
+
+    cycles: List[List[str]] = []
+    seen_keys: Set[str] = set()
+
+    def dfs(start: str) -> None:
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    key = cycle_key(path)
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        cycles.append(list(path))
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+
+    for n in sorted(graph):
+        dfs(n)
+    return sorted(cycles, key=cycle_key)
+
+
+def run_locks(root: str, paths: Optional[Sequence[str]] = None,
+              baseline: Optional[Dict[str, str]] = None
+              ) -> Tuple[List[List[str]], List[LockEdge]]:
+    """(new cycles not in baseline, full edge list)."""
+    from .lint import default_paths
+
+    edges = extract_lock_graph(paths or default_paths(root), root=root)
+    baseline = baseline or {}
+    new = [c for c in find_cycles(edges)
+           if cycle_key(c) not in baseline]
+    return new, edges

@@ -14,7 +14,8 @@ import dataclasses
 import os
 from typing import Any, Callable, Dict, Optional
 
-__all__ = ["Knob", "KNOBS", "get", "get_bool", "get_int", "get_float", "get_str", "registry_doc"]
+__all__ = ["Knob", "KNOBS", "CONTRACT_VARS", "get", "get_bool", "get_int",
+           "get_float", "get_str", "registry_doc"]
 
 
 def _parse_bool(v: str) -> bool:
@@ -221,6 +222,15 @@ KNOBS: Dict[str, Knob] = {
            "(telemetry.flight_recorder.get_flight_recorder() is None)."),
         _k("HVDT_FLIGHT_RECORDER_EVENTS", 256, int,
            "Ring capacity (events) of the collective flight recorder."),
+        _k("HVDT_EXPECTED_SCHEDULE", "", str,
+           "Path to a static collective-schedule fingerprint JSON "
+           "(exported by `python -m horovod_tpu.analysis --schedule "
+           "OUT.json` or analysis.schedule.ScheduleFingerprint.save). "
+           "When set, desync reports gain an `expected_schedule` "
+           "section comparing the STATIC expected issue order against "
+           "every rank's runtime-observed events and naming the first "
+           "deviation — static-expected vs observed forensics instead "
+           "of observed-vs-observed.  Empty (default) = off."),
         # --- timeline (ref: HOROVOD_TIMELINE common.h:110) ---
         _k("HVDT_TIMELINE", "", str,
            "Write per-tensor Chrome-tracing timeline JSON to this path."),
@@ -491,6 +501,33 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_MESH_AXES", "", str,
            "Comma list of axis=size pairs for the default mesh, e.g. "
            "'dp=4,tp=2'. Empty = 1-D data-parallel mesh over all devices."),
+        # --- orchestrators (horovod_tpu/orchestrate: Spark barrier
+        #     execution + estimator dataframe sharding) ---
+        _k("HVDT_SPARK_START_TIMEOUT", 600.0, float,
+           "Seconds the Spark barrier job waits for every executor "
+           "slot to check in before aborting the launch (the "
+           "--start-timeout analog for orchestrate/spark.run)."),
+        _k("HVDT_SPARK_RUN_TIMEOUT", 86400.0, float,
+           "Wall-clock bound (seconds) on one orchestrate/spark.run "
+           "barrier job; past it the job group is cancelled and the "
+           "run raises instead of holding executors forever."),
+        _k("HVDT_SPARK_COORD_TIMEOUT", 120.0, float,
+           "Seconds a Spark barrier task waits for rank 0's "
+           "coordinator address broadcast before giving up."),
+        _k("HVDT_DFSHARD_TIMEOUT", 120.0, float,
+           "Seconds the estimator's dataframe-shard fetch waits for "
+           "each worker's partition to materialize."),
+        # --- bench / example harness A/B switches (read by bench.py and
+        #     examples/, documented in docs/performance.md) ---
+        _k("HVDT_BENCH_NO_CACHE", False, _parse_bool,
+           "bench.py: bypass the persistent compilation cache for this "
+           "run — keeps an experimental config's compilations out of "
+           "the shared cache during A/B sweeps (tools/tpu_ab.py sets "
+           "it on the experiment leg)."),
+        _k("HVDT_LM_SINGLE", True, _parse_bool,
+           "examples/jax_transformer_lm.py: run the single-island step "
+           "layout (default); 0/false re-runs the per-stage island leg "
+           "as the A/B comparison documented in docs/performance.md."),
         # --- persistence safety ---
         _k("HVDT_MLPARAMS_ALLOW_PREFIXES", "horovod_tpu.", str,
            "Comma list of module prefixes orchestrate/ml_params.load() "
@@ -533,6 +570,38 @@ KNOBS: Dict[str, Knob] = {
            "quant.with_error_feedback(enabled=...)).  Starting point "
            "comes from HVDT_QUANT / HVDT_COMPRESSION."),
     ]
+}
+
+
+# Internal env-contract variables: set by the launcher / elastic driver /
+# serve control plane for their own child processes — wiring, not
+# operator-facing knobs, so they carry no Knob entry (no default, no
+# CLI flag).  Declared here so the static analyzer (horovod_tpu/analysis
+# lint rule `knob-drift`) can tell wiring from a typo'd or undeclared
+# knob; every HVDT_* read anywhere in the tree must appear either in
+# KNOBS or here.
+CONTRACT_VARS: Dict[str, str] = {
+    "HVDT_SECRET": "HMAC secret for the rendezvous KV (launcher -> "
+                   "workers; hex).",
+    "HVDT_GENERATION": "Elastic cluster generation counter (driver -> "
+                       "workers on each re-rendezvous).",
+    "HVDT_NICS": "--network-interface allowlist the launcher exports "
+                 "to workers.",
+    "HVDT_POD_INDEX": "Pod index of this host (launcher topology "
+                      "contract).",
+    "HVDT_POD_RANK": "Rank within the pod (launcher topology contract).",
+    "HVDT_NUM_PODS": "Pod count of the current mesh (elastic driver "
+                     "contract).",
+    "HVDT_EXEC_ADDR": "Executor-pool KV address (orchestrate/executor "
+                      "driver -> workers).",
+    "HVDT_EXEC_PORT": "Executor-pool KV port.",
+    "HVDT_EXEC_SECRET": "Executor-pool KV HMAC secret (hex).",
+    "HVDT_RUNFUNC_ADDR": "runner.run() function-shipping KV address.",
+    "HVDT_RUNFUNC_PORT": "runner.run() function-shipping KV port.",
+    "HVDT_RUNFUNC_SECRET": "runner.run() function-shipping KV secret "
+                           "(hex).",
+    "HVDT_SERVE_REPLICA_ID": "Replica id the serve autoscaler assigns "
+                             "to each spawned serving process.",
 }
 
 

@@ -211,7 +211,11 @@ def _attention(p, x, positions, cfg: TransformerConfig):
         fn = _flash_fn(l, dh, batch=max(1, b // dp_size),
                        heads=max(1, h // tp_size))
         spec = P(dp_axes if dp_axes else None, None, tp_ax, None)
-        o = jax.shard_map(
+        # _flash_plan only emits island plans when the public
+        # jax.shard_map exists (jax 0.4.x has neither it nor
+        # AxisType-aware abstract meshes).
+        shard_map_fn = getattr(jax, "shard_map", None)
+        o = shard_map_fn(
             fn, in_specs=(spec, spec, spec), out_specs=spec,
             axis_names=names)(q, k, v)
     else:
@@ -379,6 +383,11 @@ def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
         # dimension shardings mix manual-after-free axes — verified on
         # jax 0.9: "manual axes must come before free axes").  Fall back
         # to XLA attention; pure-auto meshes (dp/fsdp/tp) still engage.
+        return None
+    if getattr(jax, "shard_map", None) is None:
+        # Island plans need the public partial-manual shard_map API
+        # (absent on jax 0.4.x — where AxisType meshes don't exist
+        # either, so this is belt-and-braces).
         return None
     # Shard batch over dp-like axes and heads over tp, where divisible.
     dp_axes: Tuple[str, ...] = tuple(a for a in ("dp", "fsdp")
@@ -639,8 +648,12 @@ def _chunked_xent(x: jax.Array, embed: jax.Array, targets: jax.Array,
             jnp.zeros((b * t,), jnp.float32))
     # Inside a shard_map island (sp/pp) the hidden states are varying, so
     # the scan body's outputs are too — the carry init must match the
-    # body's output vma or the scan type check rejects it.
-    vma = tuple(set(jax.typeof(xf).vma) | set(jax.typeof(tgt).vma))
+    # body's output vma or the scan type check rejects it.  jax builds
+    # without vma tracking (0.4.x: no jax.typeof/lax.pcast) need no
+    # alignment — there is no vma type to mismatch.
+    typeof = getattr(jax, "typeof", None)
+    vma = (tuple(set(typeof(xf).vma) | set(typeof(tgt).vma))
+           if typeof is not None else ())
     if vma:
         init = jax.tree.map(lambda a: lax.pcast(a, vma, to="varying"), init)
     (m, s, tl), _ = lax.scan(jax.checkpoint(body), init,

@@ -360,7 +360,9 @@ def conv1x1_bn_train(x: jax.Array, w: jax.Array, gamma: jax.Array,
 
 
 def _global_m(m: int, axis: Optional[str]):
-    return m * jax.lax.axis_size(axis) if axis else m
+    from .device import _axis_size_static
+
+    return m * _axis_size_static(axis) if axis else m
 
 
 def _axis_mean(v, axis: Optional[str]):

@@ -97,7 +97,9 @@ def sparse_allreduce_jit(indices, values, axis: str = "dp",
     gi = lax.all_gather(indices, axis, tiled=True)
     gv = lax.all_gather(values, axis, tiled=True)
     if op == ReduceOp.AVERAGE:
-        gv = gv / lax.axis_size(axis)
+        from .device import _axis_size_static
+
+        gv = gv / _axis_size_static(axis)
     elif op != ReduceOp.SUM:
         raise ValueError("sparse allreduce supports SUM/AVERAGE")
     return gi, gv.astype(jnp.result_type(values))

@@ -111,7 +111,13 @@ def pvary_tree(tree, axis="dp"):
     from jax import lax
 
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
-    return jax.tree.map(lambda t: lax.pcast(t, axes, to="varying"), tree)
+    pcast = getattr(lax, "pcast", None)
+    if pcast is None:
+        # jax builds without vma tracking (0.4.x): every value is
+        # already treated as varying (ops.device.is_varying returns
+        # True conservatively), so the mark is the identity.
+        return tree
+    return jax.tree.map(lambda t: pcast(t, axes, to="varying"), tree)
 
 
 def _axis_bound(axis) -> bool:

@@ -164,7 +164,9 @@ def kv_exchange_shard_lengths(n_rows: int, timeout: Optional[float] = None,
     from ..runner.http_kv import KVClient
 
     if timeout is None:
-        timeout = float(os.environ.get("HVDT_DFSHARD_TIMEOUT", "120"))
+        from ..common import config
+
+        timeout = config.get_float("HVDT_DFSHARD_TIMEOUT")
     rank = int(os.environ["HVDT_RANK"])
     size = int(os.environ["HVDT_SIZE"])
     kv = KVClient.from_env(os.environ)

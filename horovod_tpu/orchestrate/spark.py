@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..common import config
+
 __all__ = ["run", "run_on_dataframe", "transform_dataframe"]
 
 
@@ -95,9 +97,9 @@ def run(fn: Callable, args: Tuple = (), kwargs: Optional[Dict] = None,
 
     kwargs = kwargs or {}
     if start_timeout is None:
-        start_timeout = int(os.getenv("HOROVOD_SPARK_START_TIMEOUT",
-                                      os.getenv("HVDT_SPARK_START_TIMEOUT",
-                                                "600")))
+        legacy = os.getenv("HOROVOD_SPARK_START_TIMEOUT")
+        start_timeout = int(legacy) if legacy else int(
+            config.get_float("HVDT_SPARK_START_TIMEOUT"))
 
     sc = pyspark.SparkContext._active_spark_context
     if sc is None:
@@ -157,9 +159,9 @@ def run_on_dataframe(fn: Callable, df, num_proc: Optional[int] = None,
     import pyspark
 
     if start_timeout is None:
-        start_timeout = int(os.getenv("HOROVOD_SPARK_START_TIMEOUT",
-                                      os.getenv("HVDT_SPARK_START_TIMEOUT",
-                                                "600")))
+        legacy = os.getenv("HOROVOD_SPARK_START_TIMEOUT")
+        start_timeout = int(legacy) if legacy else int(
+            config.get_float("HVDT_SPARK_START_TIMEOUT"))
     sc = pyspark.SparkContext._active_spark_context
     if sc is None:
         raise RuntimeError(
@@ -231,8 +233,8 @@ def _enter_barrier(base_env, extra_env) -> int:
                 coord = f"{host0}:{s.getsockname()[1]}"
             kv.put(key, coord.encode())
         else:
-            coord = kv.wait(key, timeout=float(
-                os.getenv("HVDT_SPARK_COORD_TIMEOUT", "120"))).decode()
+            coord = kv.wait(key, timeout=config.get_float(
+                "HVDT_SPARK_COORD_TIMEOUT")).decode()
         os.environ["HVDT_COORDINATOR_ADDR"] = coord
     # Tell the driver this rank was actually scheduled: startup is
     # bounded by start_timeout on the driver side, and a barrier stage
@@ -296,8 +298,8 @@ def _barrier_collect(sc, server, make_rdd, task, num_proc, start_timeout,
         # Phase 2 — the run itself, bounded by the (long) run timeout.
         if status is None:
             try:
-                status, payload = result_q.get(timeout=float(
-                    os.getenv("HVDT_SPARK_RUN_TIMEOUT", "86400")))
+                status, payload = result_q.get(
+                    timeout=config.get_float("HVDT_SPARK_RUN_TIMEOUT"))
             except queue.Empty:
                 sc.cancelJobGroup(job_group)
                 raise TimeoutError(

@@ -22,18 +22,22 @@ def main() -> int:
                       bytes.fromhex(os.environ["HVDT_EXEC_SECRET"]))
     rank = int(os.environ.get("HVDT_RANK", 0))
     client.put(f"/exec/ready/{rank}", b"1")
+    from ..resilience.retry import Backoff
+
     epoch = 0
     while True:
-        # Either the next call or the stop sentinel arrives for this epoch.
+        # Either the next call or the stop sentinel arrives for this
+        # epoch.  Jittered backoff (5ms -> 50ms cap) keeps dispatch
+        # latency low while idle workers decorrelate instead of
+        # hammering the KV in lockstep.
+        poll = Backoff(first=0.005, cap=0.05)
         while True:
             if client.get(f"/exec/{epoch}/stop") is not None:
                 return 0
             raw = client.get(f"/exec/{epoch}/fn")
             if raw is not None:
                 break
-            import time
-
-            time.sleep(0.02)
+            poll.sleep()
         try:
             fn, args, kwargs, has_per_rank = pickle.loads(raw)
             if has_per_rank:

@@ -453,7 +453,10 @@ def get_injector() -> Optional[FaultInjector]:
     plan = os.environ.get("HVDT_FAULT_PLAN")
     if plan != _cached_plan:
         _cached_plan = plan
-        _cached_injector = FaultInjector.from_env()
+        # Explicit None-when-unset path (zero-overhead identity
+        # contract): an empty plan never even parses.
+        _cached_injector = (FaultInjector.from_env()
+                            if plan and plan.strip() else None)
     return _cached_injector
 
 

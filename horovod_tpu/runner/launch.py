@@ -375,6 +375,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..serve import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "lint":
+        # `hvdtrun lint ...` — the static-analysis gate (collective-
+        # schedule verifier + hvdt-lint rule registry + lock-order
+        # graph; horovod_tpu/analysis).  Bare `hvdtrun lint` runs the
+        # full --all gate; flags after `lint` are the analysis CLI's
+        # (see python -m horovod_tpu.analysis --help).
+        from ..analysis import main as analysis_main
+
+        rest = argv[1:]
+        return analysis_main(rest if rest else ["--all"])
     args = parse_args(argv)
     if args.version or args.check_build:
         _print_check_build()

@@ -86,11 +86,14 @@ def _terminate_group(proc: subprocess.Popen, graceful_s: float) -> None:
         os.killpg(pgid, signal.SIGTERM)
     except ProcessLookupError:
         return
-    deadline = time.monotonic() + graceful_s
-    while time.monotonic() < deadline:
-        if proc.poll() is not None:
-            return
-        time.sleep(0.1)
+    from ..resilience.retry import Backoff
+
+    grace = Backoff(first=0.02, cap=0.25, deadline_s=graceful_s)
+    while proc.poll() is None:
+        if not grace.sleep():   # grace window exhausted -> SIGKILL
+            break
+    if proc.poll() is not None:
+        return
     try:
         os.killpg(pgid, signal.SIGKILL)
     except ProcessLookupError:

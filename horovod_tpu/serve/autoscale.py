@@ -444,12 +444,15 @@ class ServeDriver:
                 rids = list(self._live)
             for rid in rids:
                 self._drain_replica(rid)
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
+            from ..resilience.retry import Backoff
+
+            drain_wait = Backoff(first=0.05, cap=0.5, deadline_s=timeout)
+            while True:
                 with self._lock:
                     if not self._live:
                         return
-                time.sleep(0.1)
+                if not drain_wait.sleep():
+                    break
             with self._lock:
                 leftover = sorted(self._live)
             if leftover:

@@ -203,8 +203,13 @@ def run_replica(args) -> int:
     print(f"serve-replica {replica_id}: ready on {server.host}:{port}",
           flush=True)
     try:
+        from ..resilience.retry import Backoff
+
+        # Drain-wait poll: jittered 50ms -> 250ms cap keeps drain
+        # latency low while a fleet of replicas decorrelates.
+        drain_poll = Backoff(first=0.05, cap=0.25)
         while not (server.draining or registrar.drain_requested()):
-            time.sleep(0.1)
+            drain_poll.sleep()
     except KeyboardInterrupt:
         pass
     # Drain: admission 503s from here (server.draining), in-flight

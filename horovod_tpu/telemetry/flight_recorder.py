@@ -323,6 +323,67 @@ def _gather_events(kv_client, size: int, self_rank: int,
     return out
 
 
+def _load_expected_schedule() -> Optional[Dict[str, Any]]:
+    """The static schedule fingerprint named by
+    ``HVDT_EXPECTED_SCHEDULE`` (exported by ``python -m
+    horovod_tpu.analysis --schedule``), or None when unset/unreadable."""
+    path = config.get_str("HVDT_EXPECTED_SCHEDULE")
+    if not path:
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError) as e:
+        log.warning("expected schedule %s unreadable: %r", path, e)
+        return None
+
+
+def _expected_schedule_section(doc: Dict[str, Any],
+                               by_rank: Dict[int, List[Dict[str, Any]]],
+                               report: Dict[str, Any]) -> Dict[str, Any]:
+    """Static-expected vs runtime-observed: compare every rank's
+    recorded events against the exported fingerprint (cyclically — the
+    fingerprint is one step's schedule) and name the first deviation.
+    A rank whose events all match but which stopped short is reported
+    against the static entry it should have issued next."""
+    entries = doc.get("events", [])
+    sec: Dict[str, Any] = {
+        "path": config.get_str("HVDT_EXPECTED_SCHEDULE"),
+        "digest": doc.get("digest"),
+        "label": doc.get("label", ""),
+        "collectives_per_step": len(entries),
+        "first_deviation": None,
+    }
+    if not entries:
+        return sec
+    try:
+        from ..analysis.schedule import first_schedule_deviation
+    except Exception as e:       # analysis layer must never break forensics
+        log.debug("expected-schedule check unavailable: %r", e)
+        return sec
+    dev: Optional[Dict[str, Any]] = None
+    for r in sorted(by_rank):
+        d = first_schedule_deviation(by_rank[r], entries)
+        if d is not None:
+            d["rank"] = r
+            if dev is None or d["seq"] < dev["seq"]:
+                dev = d
+    if dev is None and report.get("first_divergent_seq") is not None:
+        # Every recorded event matched the static schedule — the
+        # deviation is the collective the missing rank(s) never issued.
+        seq = int(report["first_divergent_seq"])
+        dev = {
+            "seq": seq,
+            "rank": report.get("missing_ranks"),
+            "reason": "missing: rank(s) never recorded this collective "
+                      "(the static schedule expects it every step)",
+            "expected": dict(entries[(seq - 1) % len(entries)]),
+            "observed": None,
+        }
+    sec["first_deviation"] = dev
+    return sec
+
+
 def emit_desync_report(stalled: Optional[str] = None,
                        age_s: Optional[float] = None,
                        kv_client=None, size: Optional[int] = None,
@@ -334,7 +395,10 @@ def emit_desync_report(stalled: Optional[str] = None,
     Called by the resilience ``Escalator`` when its abort rung fires (the
     coordinator side of a hung negotiation) and usable on demand.  Writes
     ``desync_report_rank<N>.json`` into ``HVDT_TRACE_DIR`` (when set),
-    publishes ``/desync/report`` to the KV, and logs the headline.  Best
+    publishes ``/desync/report`` to the KV, and logs the headline.  With
+    ``HVDT_EXPECTED_SCHEDULE`` set (a fingerprint exported by the static
+    analyzer) the report gains an ``expected_schedule`` section naming
+    the first static-expected-vs-runtime-observed deviation.  Best
     effort end to end: returns None (recording nothing) when the flight
     recorder is off, and never raises."""
     fr = get_flight_recorder()
@@ -371,6 +435,10 @@ def emit_desync_report(stalled: Optional[str] = None,
             "reporting_rank": rank,
             "ts": time.time(),
         })
+        expected_doc = _load_expected_schedule()
+        if expected_doc is not None:
+            report["expected_schedule"] = _expected_schedule_section(
+                expected_doc, by_rank, report)
         d = out_dir or config.get_str("HVDT_TRACE_DIR")
         if d:
             try:
@@ -392,6 +460,11 @@ def emit_desync_report(stalled: Optional[str] = None,
             stalled, report["first_divergent_seq"],
             report["missing_ranks"], len(report["mismatches"]),
             report["per_rank_last_seq"])
+        fd = report.get("expected_schedule", {}).get("first_deviation")
+        if fd:
+            log.warning(
+                "DESYNC static-expected vs observed: seq=%s rank=%s %s",
+                fd.get("seq"), fd.get("rank"), fd.get("reason"))
         return report
     except Exception as e:   # forensics must never worsen the failure
         log.warning("desync report failed: %r", e)

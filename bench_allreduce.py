@@ -48,6 +48,13 @@ import json
 import sys
 import time
 
+# Normalized row schema (the horovod_tpu.analysis.costmodel fitter's
+# input contract): every sweep row carries `axis`, `algorithm`, `wire`,
+# `size_bytes`, `seconds`, `axis_size` next to its legacy columns, and
+# every summary carries `schema_version`.  tools/fit_costmodel.py
+# regenerates the checked-in calibration from any set of these files.
+SCHEMA_VERSION = 1
+
 
 def _fmt_bytes(n: int) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
@@ -307,7 +314,10 @@ def _run_reduce_scatter(args) -> None:
         speedup = (t["allreduce"] / t["rs_ag"]
                    if t["rs_ag"] > 0 else None)
         rows.append({
-            "bytes": size,
+            "bytes": size, "size_bytes": size,
+            "axis": "dp", "axis_size": int(n),
+            "algorithm": "rs_ag", "wire": "f32",
+            "seconds": t["rs_ag"],
             "allreduce_us": t["allreduce"] * 1e6,
             "rs_ag_us": t["rs_ag"] * 1e6,
             "rs_us": t["rs"] * 1e6,
@@ -325,6 +335,7 @@ def _run_reduce_scatter(args) -> None:
     peak = max(rows, key=lambda r: r["rs_ag_algbw_gbps"])
     summary = {
         "metric": "reduce_scatter_sweep",
+        "schema_version": SCHEMA_VERSION,
         "value": round(peak["rs_ag_speedup_vs_allreduce"], 3),
         "unit": "speedup_vs_allreduce",
         "n_devices": int(n),
@@ -389,19 +400,32 @@ def _run_hierarchical(args) -> None:
             dcn_wire = 2 * shard * _wire_item(res.slow.wire) \
                 * (n_dcn - 1) // max(1, n_dcn)
         speedup = t["flat"] / t["hier"] if t["hier"] > 0 else None
+        n = n_dcn * n_ici
+        flat_wire = 2 * count * item * (n - 1) // n
         rows.extend([
-            {"bytes": size, "axis": "ici",
+            {"bytes": size, "size_bytes": size, "axis": "ici",
+             "axis_size": int(n_ici),
              "algorithm": res.fast.algorithm, "wire": res.fast.wire,
-             "us": t["ici"] * 1e6, "bytes_on_wire": ici_wire,
+             "us": t["ici"] * 1e6, "seconds": t["ici"],
+             "bytes_on_wire": ici_wire,
              "wire_gbps": ici_wire / t["ici"] / 1e9},
-            {"bytes": size, "axis": "dcn",
+            {"bytes": size, "size_bytes": size, "axis": "dcn",
+             "axis_size": int(n_dcn),
              "algorithm": res.slow.algorithm, "wire": res.slow.wire,
-             "us": t["dcn"] * 1e6, "bytes_on_wire": dcn_wire,
+             "us": t["dcn"] * 1e6, "seconds": t["dcn"],
+             "bytes_on_wire": dcn_wire,
              "wire_gbps": dcn_wire / t["dcn"] / 1e9},
-            {"bytes": size, "axis": "ici+dcn",
-             "algorithm": "hierarchical",
+            {"bytes": size, "size_bytes": size, "axis": "ici+dcn",
+             "axis_size": int(n), "algorithm": "flat",
+             "wire": args.dtype if args.dtype != "float32" else "f32",
+             "us": t["flat"] * 1e6, "seconds": t["flat"],
+             "bytes_on_wire": flat_wire,
+             "wire_gbps": flat_wire / t["flat"] / 1e9},
+            {"bytes": size, "size_bytes": size, "axis": "ici+dcn",
+             "axis_size": int(n), "algorithm": "hierarchical",
              "wire": f"{res.fast.wire}/{res.slow.wire}",
-             "us": t["hier"] * 1e6, "flat_us": t["flat"] * 1e6,
+             "us": t["hier"] * 1e6, "seconds": t["hier"],
+             "flat_us": t["flat"] * 1e6,
              "bytes_on_wire": ici_wire + dcn_wire,
              "jit_algbw_gbps": size / t["hier"] / 1e9,
              "hierarchical_speedup_vs_flat": speedup},
@@ -412,10 +436,11 @@ def _run_hierarchical(args) -> None:
               file=sys.stderr)
         size *= 4
 
-    hier_rows = [r for r in rows if r["axis"] == "ici+dcn"]
+    hier_rows = [r for r in rows if r["algorithm"] == "hierarchical"]
     peak = max(hier_rows, key=lambda r: r["jit_algbw_gbps"])
     summary = {
         "metric": "allreduce_hierarchical_sweep",
+        "schema_version": SCHEMA_VERSION,
         "value": round(peak["hierarchical_speedup_vs_flat"], 3),
         "unit": "speedup_vs_flat",
         "n_devices": int(n_dcn * n_ici),
@@ -572,10 +597,11 @@ def main() -> None:
                           args.warmup, wire=args.wire)
         count = max(1, size // np.dtype(args.dtype).itemsize)
         on_wire = wire_payload_bytes(count, args.dtype, args.wire)
-        row = {"bytes": size, "jit_algbw_gbps": size / t_jit / 1e9,
+        row = {"bytes": size, "size_bytes": size,
+               "jit_algbw_gbps": size / t_jit / 1e9,
                "jit_busbw_gbps": size / t_jit * factor / 1e9,
-               "jit_us": t_jit * 1e6,
-               "axis": "dp", "algorithm": "flat",
+               "jit_us": t_jit * 1e6, "seconds": t_jit,
+               "axis": "dp", "axis_size": int(n), "algorithm": "flat",
                "wire": args.wire, "bytes_on_wire": on_wire,
                "wire_gbps": on_wire / t_jit / 1e9}
         if args.wire != "f32":
@@ -604,6 +630,7 @@ def main() -> None:
     peak = max(rows, key=lambda r: r["jit_busbw_gbps"])
     summary = {
         "metric": "allreduce_peak_busbw_gbps",
+        "schema_version": SCHEMA_VERSION,
         "value": round(peak["jit_busbw_gbps"], 3),
         "unit": "GB/s",
         "n_devices": n,

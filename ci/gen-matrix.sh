@@ -12,10 +12,14 @@
 #       pod-granular-elastic/multipod-recovery +
 #       continuous-goodput/async-checkpoint/peer-restore +
 #       elastic-serving-control-plane/router/autoscaler +
-#       static-analysis/schedule-fingerprint tests on
+#       static-analysis/schedule-fingerprint +
+#       static-cost-model/perf-gate tests on
 #       CPU) — the pre-merge gate.  The full matrix additionally
 #       emits the `analysis` service: python -m horovod_tpu.analysis
-#       --all as a hard gate over the hvdt-lint ratchet baseline.
+#       --all --perf as a hard gate over the hvdt-lint ratchet
+#       baseline AND the .hvdt-perf-baseline.json perf ratchet
+#       (model-predicted exposed-comm seconds / wire bytes / overlap
+#       fraction of the reference fingerprints).
 set -eu
 only=""
 if [ "${1:-}" = "--smoke" ]; then

@@ -17,6 +17,14 @@ CI gate (``python -m horovod_tpu.analysis --all`` / ``hvdtrun lint``):
   the generated knob table (``docs/knobs.md``) and its drift check.
 * :mod:`~horovod_tpu.analysis.locks` — static lock-order graph over
   the threaded control plane; new acquisition-order cycles fail CI.
+* :mod:`~horovod_tpu.analysis.costmodel` /
+  :mod:`~horovod_tpu.analysis.topology` — the analytical alpha-beta
+  topology cost model: constants fitted from measured
+  ``bench_allreduce`` rows, evaluated over schedule fingerprints for
+  declared topologies (256 chips on a 1-CPU container), ratcheted by
+  the ``--perf`` static perf-regression gate against
+  ``.hvdt-perf-baseline.json`` and consulted by autotune pre-seeding
+  (``HVDT_AUTOTUNE_MODEL_SEED``).
 """
 
 from __future__ import annotations

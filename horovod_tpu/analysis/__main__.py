@@ -38,7 +38,8 @@ def _repo_root(explicit: Optional[str]) -> str:
     return os.path.dirname(os.path.dirname(here))
 
 
-def _gate_lint(root: str, baseline: str, update: bool) -> int:
+def _gate_lint(root: str, baseline: str, update: bool,
+               fail_on_stale: bool = False) -> int:
     from .lint import run_lint
 
     new, suppressed, stale = run_lint(root, baseline_path=baseline,
@@ -49,14 +50,21 @@ def _gate_lint(root: str, baseline: str, update: bool) -> int:
         return 0
     for f in new:
         print(f.format())
+    # Lock-cycle suppressions are keyed/validated by the locks gate,
+    # not by lint findings — never count them stale here.
+    stale_hard = [k for k in stale if not k.startswith("lock-cycle:")]
     if stale:
-        print(f"hvdt-lint: {len(stale)} stale baseline suppression(s) "
-              f"(violation fixed — prune to ratchet down):")
+        verdict = ("FAIL stale-baseline" if fail_on_stale and stale_hard
+                   else "stale")
+        print(f"hvdt-lint: {verdict} — {len(stale)} baseline "
+              f"suppression(s) match no current source line "
+              f"(violation fixed or line edited; prune with "
+              f"--update-baseline):")
         for k in stale:
             print(f"  {k}")
     print(f"hvdt-lint: {len(new)} new, {len(suppressed)} baselined, "
           f"{len(stale)} stale")
-    return 1 if new else 0
+    return 1 if (new or (fail_on_stale and stale_hard)) else 0
 
 
 def _gate_locks(root: str, baseline: str, dump: bool) -> int:
@@ -93,12 +101,15 @@ def _gate_knobs(root: str, check: bool, write: Optional[str]) -> int:
     return 0
 
 
-def _selfcheck_step():
+def _selfcheck_step(zero: bool = False):
     """Build the reference program pair for the schedule self-check:
     the overlapped bucketed exchange on a two-tier (dcn, ici) mesh —
-    once plain, once under the hierarchical transport policy.  Runs on
-    however many devices exist (axis sizes degrade to 1; the jaxpr
-    still carries every collective)."""
+    once plain, once under the hierarchical transport policy; with
+    ``zero`` the program additionally routes a ZeRO reduce-scatter-wire
+    exchange over the fast tier (the composed overlapped + hierarchical
+    + ZeRO reference the perf gate prices).  Runs on however many
+    devices exist (axis sizes degrade to 1; the jaxpr still carries
+    every collective)."""
     import inspect
 
     import jax
@@ -139,12 +150,21 @@ def _selfcheck_step():
         out = OverlapScheduler().exchange(
             list(ls), axis=("dcn", "ici"), op=ReduceOp.AVERAGE,
             threshold_bytes=4096)
+        if zero:
+            from ..ops import zero as zero_mod
+
+            z = zero_mod.rs_exchange(
+                {"z": ls[0] * 2.0}, axis="ici", op=ReduceOp.AVERAGE,
+                threshold_bytes=4096)
+            return tuple(out) + (z["z"],)
         return tuple(out)
+
+    n_out = len(leaves) + (1 if zero else 0)
 
     def step(*ls):
         return shard_map(traced, mesh=mesh,
                          in_specs=(P(("dcn", "ici")),) * len(ls),
-                         out_specs=(P(),) * len(ls), **smap_kw)(*ls)
+                         out_specs=(P(),) * n_out, **smap_kw)(*ls)
 
     return step, leaves, tree
 
@@ -217,6 +237,236 @@ def _gate_selfcheck(export: Optional[str], root: str) -> int:
     return 1 if problems else 0
 
 
+PERF_BASELINE_NAME = ".hvdt-perf-baseline.json"
+
+# Ratchet tolerances: predictions are deterministic given one
+# calibration + one fingerprint, so drift means the SCHEDULE changed —
+# keep the bands tight.
+_PERF_TOLERANCES = {
+    "exposed_comm_rel": 0.10,     # predicted exposed-comm seconds
+    "wire_bytes_rel": 0.01,       # per-axis wire bytes (near-exact)
+    "overlap_fraction_abs": 0.05,  # hidden/total fraction
+}
+_REFERENCE_TOPOLOGY = {"pods": 2, "chips_per_pod": 4}   # the mesh-8 CI sim
+_SPEEDUP_REL_TOLERANCE = 0.25    # model vs measured hier speedup
+
+
+def _force_sim_devices() -> None:
+    """The perf gate prices the mesh-8 reference fingerprints: force
+    the 8-device CPU sim BEFORE the first jax backend init so the
+    committed baseline holds on any host (the conftest idiom)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _reference_fingerprints() -> list:
+    """Trace the perf gate's reference programs under pinned env: the
+    overlapped exchange plain, hierarchical, and hierarchical + ZeRO —
+    the three comm compositions the repo ships."""
+    from . import schedule as sched
+
+    old_env = {k: os.environ.get(k)
+               for k in ("HVDT_OVERLAP", "HVDT_TRANSPORT", "HVDT_ZERO")}
+    from ..ops import overlap as ovl
+    from ..transport import policy as tpolicy
+
+    out = []
+    try:
+        os.environ["HVDT_OVERLAP"] = "on"
+        os.environ.pop("HVDT_TRANSPORT", None)
+        os.environ.pop("HVDT_ZERO", None)
+        ovl.reset()
+        tpolicy.reset()
+        step, leaves, _ = _selfcheck_step()
+        out.append(sched.extract_schedule(step, *leaves,
+                                          label="overlap-plain"))
+        os.environ["HVDT_TRANSPORT"] = \
+            "ici:ring:f32:64M,dcn:ring:f32:64M"
+        tpolicy.reset()
+        step, leaves, _ = _selfcheck_step()
+        out.append(sched.extract_schedule(step, *leaves,
+                                          label="overlap-hier"))
+        step, leaves, _ = _selfcheck_step(zero=True)
+        out.append(sched.extract_schedule(step, *leaves,
+                                          label="overlap-hier-zero"))
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        ovl.reset()
+        tpolicy.reset()
+    return out
+
+
+def _perf_baseline_path(root: str, explicit: Optional[str]) -> str:
+    if explicit:
+        return explicit
+    env = os.environ.get("HVDT_PERF_BASELINE", "").strip()
+    if env:
+        return env
+    return os.path.join(root, PERF_BASELINE_NAME)
+
+
+def _gate_perf(root: str, baseline_path: str, update: bool,
+               fingerprint_paths: Optional[List[str]] = None) -> int:
+    """The static perf-regression gate: evaluate the reference
+    fingerprints (or explicitly supplied exported ones) with the fitted
+    cost model on the reference topology, validate the model against
+    its own measured calibration sweep, assert the weak-scaling curve
+    shape, and ratchet against the committed perf baseline."""
+    import json as _json
+
+    from . import costmodel as cm
+    from . import schedule as sched
+    from . import topology as tp
+
+    problems: List[str] = []
+    cal = cm.load_calibration(cm.default_calibration_path(root))
+    if cal.meta.get("degraded"):
+        problems.append(
+            f"cost-model calibration unavailable "
+            f"({cal.meta['degraded']}) — regenerate with "
+            f"tools/fit_costmodel.py")
+    model = cm.CostModel(cal)
+
+    try:
+        with open(baseline_path) as fh:
+            baseline = _json.load(fh)
+    except (OSError, ValueError):
+        baseline = None
+    topo_doc = (baseline or {}).get("topology", _REFERENCE_TOPOLOGY)
+    topo = tp.TopologySpec(pods=int(topo_doc["pods"]),
+                           chips_per_pod=int(topo_doc["chips_per_pod"]))
+
+    if fingerprint_paths:
+        fps = [sched.load_fingerprint(p) for p in fingerprint_paths]
+    else:
+        fps = _reference_fingerprints()
+    costs = {fp.label: model.evaluate(fp, topo) for fp in fps}
+    for c in costs.values():
+        print(f"hvdt-perf: {c.summary()}")
+
+    # (c) model-vs-measured validation: the fitted model must reproduce
+    # the measured hierarchical speedup its calibration sweep recorded.
+    meas = cal.meta.get("measured_hier_speedup")
+    if isinstance(meas, dict) and meas.get("value"):
+        mesh = meas.get("mesh", {}) or {}
+        vtopo = tp.TopologySpec(
+            pods=int(mesh.get("dcn", topo.pods)),
+            chips_per_pod=int(mesh.get("ici", topo.chips_per_pod)))
+        pred = model.hierarchical_speedup(
+            float(meas.get("at_bytes", 0) or 1), vtopo)
+        rel = abs(pred - float(meas["value"])) / float(meas["value"])
+        verdict = "ok" if rel <= _SPEEDUP_REL_TOLERANCE else "FAIL"
+        print(f"hvdt-perf: hier-speedup model {pred:.3f} vs measured "
+              f"{meas['value']:.3f} at {meas.get('at_bytes')}B "
+              f"({rel:.1%} off, {verdict})")
+        if rel > _SPEEDUP_REL_TOLERANCE:
+            problems.append(
+                f"model hierarchical_speedup_vs_flat_at_peak {pred:.3f} "
+                f"deviates {rel:.1%} from the measured {meas['value']} "
+                f"(tolerance {_SPEEDUP_REL_TOLERANCE:.0%}) — refit the "
+                f"calibration or fix the model")
+
+    # Weak-scaling curve: deterministic, monotone in comm fraction
+    # (the concurrency-paper shape).
+    wl = tp.REFERENCE_STEP_WORKLOAD
+    curve = model.weak_scaling_curve(wl["grad_bytes"],
+                                     wl["flops_per_step"])
+    frs = [r["comm_fraction"] for r in curve]
+    print("hvdt-perf: weak-scaling comm fraction "
+          + " ".join(f"{r['chips']}:{r['comm_fraction']:.4f}"
+                     for r in curve))
+    if any(b < a for a, b in zip(frs, frs[1:])):
+        problems.append(
+            "weak-scaling curve is not monotone in comm fraction — "
+            "the model lost the scaling shape the concurrency paper "
+            "pins")
+
+    if update:
+        doc = {
+            "version": 1,
+            "comment": ("static perf-regression baseline: model-"
+                        "predicted exposed-comm seconds, per-axis wire "
+                        "bytes and overlap fraction for the reference "
+                        "fingerprints.  `python -m horovod_tpu."
+                        "analysis --perf` fails on regressions beyond "
+                        "the tolerances; regenerate with "
+                        "--update-perf-baseline after an intentional "
+                        "schedule change."),
+            "topology": topo.to_dict(),
+            "tolerances": _PERF_TOLERANCES,
+            "entries": {
+                label: {
+                    "exposed_comm_s": c.exposed_comm_s,
+                    "total_comm_s": c.total_comm_s,
+                    "overlap_fraction": c.overlap_fraction,
+                    "wire_bytes_by_axis": dict(c.wire_bytes_by_axis),
+                    "n_collectives": len(c.events),
+                } for label, c in sorted(costs.items())},
+        }
+        with open(baseline_path, "w") as fh:
+            _json.dump(doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"hvdt-perf: baseline written -> {baseline_path}")
+        return 0
+
+    if baseline is None:
+        problems.append(
+            f"no perf baseline at {baseline_path} — run "
+            f"`python -m horovod_tpu.analysis --perf "
+            f"--update-perf-baseline`")
+    else:
+        tol = {**_PERF_TOLERANCES, **baseline.get("tolerances", {})}
+        entries = baseline.get("entries", {})
+        for label, c in sorted(costs.items()):
+            base = entries.get(label)
+            if base is None:
+                problems.append(
+                    f"{label}: no baseline entry — run "
+                    f"--update-perf-baseline to admit the new "
+                    f"reference fingerprint")
+                continue
+            b_exp = float(base.get("exposed_comm_s", 0.0))
+            if c.exposed_comm_s > b_exp * (1 + tol["exposed_comm_rel"]):
+                problems.append(
+                    f"{label}: exposed-comm regression "
+                    f"{b_exp * 1e6:.1f}us -> "
+                    f"{c.exposed_comm_s * 1e6:.1f}us "
+                    f"(> +{tol['exposed_comm_rel']:.0%})")
+            elif b_exp and c.exposed_comm_s < b_exp * (
+                    1 - tol["exposed_comm_rel"]):
+                print(f"hvdt-perf: note {label}: exposed comm improved "
+                      f"{b_exp * 1e6:.1f}us -> "
+                      f"{c.exposed_comm_s * 1e6:.1f}us — ratchet down "
+                      f"with --update-perf-baseline")
+            b_wire = base.get("wire_bytes_by_axis", {}) or {}
+            for axis in sorted(set(b_wire) | set(c.wire_bytes_by_axis)):
+                cur = int(c.wire_bytes_by_axis.get(axis, 0))
+                was = int(b_wire.get(axis, 0))
+                if cur > was * (1 + tol["wire_bytes_rel"]):
+                    problems.append(
+                        f"{label}: {axis} wire bytes regression "
+                        f"{was} -> {cur} "
+                        f"(> +{tol['wire_bytes_rel']:.0%})")
+            b_ovl = float(base.get("overlap_fraction", 0.0))
+            if c.overlap_fraction < b_ovl - tol["overlap_fraction_abs"]:
+                problems.append(
+                    f"{label}: overlap fraction dropped "
+                    f"{b_ovl:.2f} -> {c.overlap_fraction:.2f} "
+                    f"(> -{tol['overlap_fraction_abs']:.2f} abs)")
+
+    for p in problems:
+        print(f"hvdt-perf: FAIL {p}")
+    print(f"hvdt-perf: {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis",
@@ -241,6 +491,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--schedule", default=None, metavar="OUT.json",
                    help="export the self-check fingerprint (implies "
                         "--selfcheck)")
+    p.add_argument("--perf", action="store_true",
+                   help="static perf-regression gate: price the "
+                        "reference fingerprints with the fitted cost "
+                        "model and ratchet exposed-comm seconds / "
+                        "per-axis wire bytes / overlap fraction "
+                        "against the committed perf baseline")
+    p.add_argument("--update-perf-baseline", action="store_true",
+                   help="rewrite the perf baseline from the current "
+                        "model predictions (implies --perf)")
+    p.add_argument("--perf-fingerprint", action="append", default=None,
+                   metavar="FP.json",
+                   help="with --perf: evaluate these exported "
+                        "fingerprint files (matched to baseline "
+                        "entries by label) instead of tracing the "
+                        "reference programs; repeatable")
+    p.add_argument("--perf-baseline", default=None, metavar="PATH",
+                   help="perf baseline file (default: "
+                        "HVDT_PERF_BASELINE or "
+                        "<root>/.hvdt-perf-baseline.json)")
     p.add_argument("--baseline", default=None, metavar="PATH",
                    help="ratchet baseline file (default: "
                         "<root>/.hvdt-lint-baseline.json)")
@@ -256,11 +525,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     baseline = args.baseline or os.path.join(root, BASELINE_NAME)
 
+    perf_mode = (args.perf or args.update_perf_baseline
+                 or bool(args.perf_fingerprint))
     any_mode = (args.lint or args.locks or args.knob_table
-                or args.selfcheck or args.schedule or args.dump_locks)
+                or args.selfcheck or args.schedule or args.dump_locks
+                or perf_mode)
     if args.all or not any_mode:
+        args.all = True
         args.lint = args.locks = args.selfcheck = True
         args.knob_table, args.check = True, True
+    if perf_mode and not args.perf_fingerprint:
+        # Tracing the reference fingerprints needs the deterministic
+        # 8-device sim; evaluating exported files is jax-free.
+        _force_sim_devices()
 
     rc = 0
     if args.update_baseline:
@@ -279,13 +556,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.lint:
-        rc |= _gate_lint(root, baseline, update=False)
+        # --all runs the hard ratchet: stale suppressions (source line
+        # edited or violation fixed) fail until pruned.
+        rc |= _gate_lint(root, baseline, update=False,
+                         fail_on_stale=args.all)
     if args.locks or args.dump_locks:
         rc |= _gate_locks(root, baseline, dump=args.dump_locks)
     if args.knob_table:
         rc |= _gate_knobs(root, check=args.check, write=args.write)
     if args.selfcheck or args.schedule:
         rc |= _gate_selfcheck(args.schedule, root)
+    if perf_mode:
+        rc |= _gate_perf(root,
+                         _perf_baseline_path(root, args.perf_baseline),
+                         update=args.update_perf_baseline,
+                         fingerprint_paths=args.perf_fingerprint)
     print(f"hvdt-analysis: {'CLEAN' if rc == 0 else 'VIOLATIONS'}")
     return rc
 

@@ -357,6 +357,57 @@ class NondeterministicIterationRule(Rule):
 
 
 @register
+class MagicPeakFlopsRule(Rule):
+    """Hardware peak-rate literals (device FLOP/s, HBM/link byte/s —
+    anything >= 1e11) have exactly two homes: the
+    ``telemetry/step_stats.py`` device-peak table (the MFU gauge) and
+    the ``analysis/topology.py`` link-constants module (the cost
+    model).  A peak literal anywhere else is a second source of truth
+    that silently drifts when a new TPU generation lands — the fitter
+    and the MFU gauge must read the same numbers."""
+
+    name = "magic-peak-flops"
+    doc = ("no hardware peak-rate literals (the topology.py "
+           "PEAK_LITERAL window) outside telemetry/step_stats.py and "
+           "analysis/topology.py")
+
+    _ALLOWED = (os.path.join("telemetry", "step_stats.py"),
+                os.path.join("analysis", "topology.py"))
+
+    def check(self, tree, src, path, ctx):
+        if any(path.endswith(a) for a in self._ALLOWED):
+            return
+        # The classification window itself lives in the constants
+        # module this rule enforces — no literal here either.
+        from .topology import PEAK_LITERAL_CEIL, PEAK_LITERAL_FLOOR
+
+        lines = src.splitlines()
+        seen: Dict[str, int] = {}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, (int, float))
+                    and not isinstance(node.value, bool)):
+                continue
+            try:
+                v = abs(float(node.value))
+            except OverflowError:
+                v = float("inf")
+            if not (PEAK_LITERAL_FLOOR <= v <= PEAK_LITERAL_CEIL):
+                continue
+            snippet = _line_of(lines, node.lineno)
+            occ = seen.get(snippet, 0)
+            seen[snippet] = occ + 1
+            yield Finding(
+                self.name, path, node.lineno,
+                f"hardware-rate-sized literal {node.value!r}: peak "
+                f"FLOP/s / bandwidth numbers live in telemetry/"
+                f"step_stats.PEAK_BY_DEVICE_KIND or analysis/topology "
+                f"constants — import them so the MFU gauge and the "
+                f"cost model can never disagree",
+                snippet=snippet, occurrence=occ)
+
+
+@register
 class SleepPollRule(Rule):
     """A ``time.sleep`` inside a ``while`` loop is a hand-rolled poll:
     fixed-interval retries synchronize into thundering herds and have

@@ -123,6 +123,11 @@ class CollectiveEvent:
     nbytes: int                      # operand bytes
     context: Tuple[str, ...]         # enclosing control-flow primitives
     post_barrier: bool               # downstream of optimization_barrier
+    # How many optimization_barriers were issued before this collective
+    # — the overlap pipeline's bucket slot (events sharing a value were
+    # issued in the same flight window).  Metadata like nbytes: the
+    # cost model consumes it, the digest does not.
+    barriers_before: int = 0
 
     @property
     def event_op(self) -> str:
@@ -191,7 +196,10 @@ class ScheduleFingerprint:
                 axes=tuple(e.get("axes", ())), dtype=str(e.get("dtype", "")),
                 count=int(e.get("count", 0)), nbytes=int(e.get("nbytes", 0)),
                 context=tuple(e.get("context", ())),
-                post_barrier=bool(e.get("post_barrier", False)))
+                post_barrier=bool(e.get("post_barrier", False)),
+                barriers_before=int(e.get(
+                    "barriers_before",
+                    1 if e.get("post_barrier") else 0)))
             for i, e in enumerate(doc.get("events", []))]
         return cls(events, n_barriers=int(doc.get("n_barriers", 0)),
                    label=str(doc.get("label", "")))
@@ -269,7 +277,8 @@ class _Walker:
                            else ""),
                     count=count, nbytes=count * itemsize,
                     context=context,
-                    post_barrier=self.n_barriers > 0))
+                    post_barrier=self.n_barriers > 0,
+                    barriers_before=self.n_barriers))
                 continue
             for sub_name, sub in _sub_jaxprs(eqn):
                 # Transparent wrappers (pjit, closed_call, remat,

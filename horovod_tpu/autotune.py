@@ -387,19 +387,60 @@ class ParameterManager:
             log.warning("autotune log write failed: %s", e)
 
 
+def _model_seed(dim: str) -> Optional[bool]:
+    """Cost-model leg ordering (analysis/costmodel.predict_leg_order)
+    consulted only when ``HVDT_AUTOTUNE_MODEL_SEED`` is enabled AND the
+    caller found no measured seed / explicit env policy — the
+    ROADMAP-5 seam: when measurement is unavailable the tuner starts
+    from the model's ordering instead of blind.  ``None`` = knob off or
+    model unanswerable; callers keep their pre-existing default."""
+    raw = config.get_str("HVDT_AUTOTUNE_MODEL_SEED").strip()
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return None
+    try:
+        from .analysis import costmodel
+
+        path = (None if raw.lower() in ("1", "on", "true", "yes", "auto")
+                else raw)
+        cal = costmodel.load_calibration(path)
+        verdict = costmodel.predict_leg_order(cal).get(dim)
+        if verdict is not None:
+            log.info("autotune %s starting leg model-seeded: %s "
+                     "(%s)", dim, verdict, cal.describe())
+        return verdict
+    except Exception as e:     # a seed must never break training startup
+        log.warning("autotune model seed unavailable for %s: %s", dim, e)
+        return None
+
+
 def _env_quant_wire() -> bool:
     """The environment's int8-wire default (the quant dimension's
-    starting leg): HVDT_QUANT, or HVDT_COMPRESSION=int8."""
-    return (config.get_bool("HVDT_QUANT")
-            or config.get_str("HVDT_COMPRESSION").strip().lower() == "int8")
+    starting leg): HVDT_QUANT, or HVDT_COMPRESSION=int8; with neither
+    set (and no explicit non-int8 compression choice), the cost model
+    may order the leg (HVDT_AUTOTUNE_MODEL_SEED)."""
+    if (config.get_bool("HVDT_QUANT")
+            or config.get_str("HVDT_COMPRESSION").strip().lower()
+            == "int8"):
+        return True
+    if config.get_str("HVDT_COMPRESSION").strip():
+        return False           # explicit non-int8 wire choice wins
+    ms = _model_seed("quant")
+    return bool(ms) if ms is not None else False
 
 
 def _env_overlap() -> bool:
     """The environment's overlap-schedule default (the overlap
-    dimension's starting leg): HVDT_OVERLAP truthy."""
+    dimension's starting leg): HVDT_OVERLAP truthy; unset (not an
+    explicit 'off'), the cost model may order the leg
+    (HVDT_AUTOTUNE_MODEL_SEED)."""
     from .ops.overlap import enabled
 
-    return enabled()
+    if enabled():
+        return True
+    if config.get_str("HVDT_OVERLAP").strip():
+        return False           # explicit off wins over the model
+    ms = _model_seed("overlap")
+    return bool(ms) if ms is not None else False
 
 
 def _env_zero() -> bool:
@@ -443,7 +484,8 @@ def _env_transport() -> bool:
         return True
     seed = config.get_str("HVDT_AUTOTUNE_TRANSPORT_SEED").strip()
     if not seed:
-        return False
+        ms = _model_seed("transport")
+        return bool(ms) if ms is not None else False
     import json
 
     try:
@@ -453,7 +495,8 @@ def _env_transport() -> bool:
                              0.0)) > 1.0
     except (OSError, ValueError, TypeError) as e:
         log.warning("transport autotune seed %s unreadable: %s", seed, e)
-        return False
+        ms = _model_seed("transport")
+        return bool(ms) if ms is not None else False
 
 
 class BenchmarkAutotuner:

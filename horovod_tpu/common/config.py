@@ -231,6 +231,30 @@ KNOBS: Dict[str, Knob] = {
            "every rank's runtime-observed events and naming the first "
            "deviation — static-expected vs observed forensics instead "
            "of observed-vs-observed.  Empty (default) = off."),
+        _k("HVDT_COSTMODEL_CALIBRATION", "", str,
+           "Path to the analytical cost model's fitted calibration "
+           "JSON (per-(tier, algorithm, wire) alpha-beta constants, "
+           "regenerated by tools/fit_costmodel.py from bench_allreduce "
+           "--json-out rows).  Empty (default) = the checked-in "
+           ".hvdt-costmodel-calibration.json at the repo root; a "
+           "missing file degrades to the analysis/topology.py "
+           "order-of-magnitude defaults."),
+        _k("HVDT_PERF_BASELINE", "", str,
+           "Path to the static perf-regression baseline JSON the "
+           "`python -m horovod_tpu.analysis --perf` gate ratchets "
+           "against (predicted exposed-comm seconds, per-axis wire "
+           "bytes, overlap fraction for the reference fingerprints; "
+           "regenerated by --update-perf-baseline).  Empty (default) "
+           "= the checked-in .hvdt-perf-baseline.json at the repo "
+           "root."),
+        _k("HVDT_AUTOTUNE_MODEL_SEED", "", str,
+           "Let autotune consult the static cost model "
+           "(analysis/costmodel.predict_leg_order) to order its "
+           "flat-vs-hierarchical / wire-dtype / overlap starting legs "
+           "when no measured HVDT_AUTOTUNE_*_SEED sweep is available: "
+           "'1' uses the default calibration, a path names a "
+           "calibration file.  Unset (default) = off — measured seeds "
+           "and explicit env policies always win over the model."),
         # --- timeline (ref: HOROVOD_TIMELINE common.h:110) ---
         _k("HVDT_TIMELINE", "", str,
            "Write per-tensor Chrome-tracing timeline JSON to this path."),

@@ -744,6 +744,46 @@ class TestExpectedScheduleUnit:
         d = sched.first_schedule_deviation(events, entries)
         assert d and d["seq"] == 1 and "bfloat16" in d["reason"]
 
+    def test_extra_observed_collective_named(self, mesh_hier,
+                                             hier_env):
+        """The runtime issues an op the static schedule LACKS (e.g. a
+        stray debug allgather injected mid-step): every later seq
+        shifts against the expected cycle, so the deviation surfaces at
+        the extra op's slot — the satellite coverage for the
+        extra-collective path next to missing/mismatched."""
+        step, leaves = _hier_zero_step(mesh_hier)
+        fp = sched.extract_schedule(step, *leaves, label="hz")
+        entries = fp.to_dict()["events"]
+        assert len(entries) >= 3
+        clean = [{"seq": i + 1, "op": e["event_op"],
+                  "dtype": e["dtype"]}
+                 for i, e in enumerate(entries)]
+        assert sched.first_schedule_deviation(clean, entries) is None
+        # Inject an extra alltoall the static schedule never issues;
+        # everything after it shifts by one seq.
+        extra_at = 2
+        observed = clean[:extra_at - 1] + \
+            [{"seq": extra_at, "op": "alltoall", "dtype": "float32"}] + \
+            [{**e, "seq": e["seq"] + 1} for e in clean[extra_at - 1:]]
+        d = sched.first_schedule_deviation(observed, entries)
+        assert d is not None
+        assert d["seq"] == extra_at
+        assert "alltoall" in d["reason"]
+        assert d["expected"]["event_op"] == entries[extra_at - 1][
+            "event_op"]
+
+    def test_extra_trailing_collective_wraps_cycle(self, mesh8,
+                                                   tmp_path):
+        """An extra op issued AFTER the step's schedule ran out wraps
+        to the next cycle's slot — detected when its kind differs from
+        the wrapped expectation."""
+        fp, _ = _one_psum_fingerprint(mesh8, tmp_path)
+        entries = fp.to_dict()["events"]     # one allreduce per step
+        events = [{"seq": 1, "op": "allreduce", "dtype": "float32"},
+                  {"seq": 2, "op": "broadcast", "dtype": "float32"}]
+        d = sched.first_schedule_deviation(events, entries)
+        assert d and d["seq"] == 2 and "broadcast" in d["reason"]
+
     def test_desync_report_carries_expected_schedule(
             self, mesh8, tmp_path, monkeypatch):
         from horovod_tpu.telemetry import flight_recorder as frm

@@ -811,13 +811,18 @@ class TestBenchHierarchicalSweep:
             timeout=420)
         assert proc.returncode == 0, proc.stderr[-2000:]
         doc = json.loads(out.read_text())
+        assert doc["schema_version"] >= 1
         axes = {r["axis"] for r in doc["rows"]}
         assert axes == {"ici", "dcn", "ici+dcn"}
-        hier = [r for r in doc["rows"] if r["axis"] == "ici+dcn"]
-        assert hier[0]["algorithm"] == "hierarchical"
+        combined = [r for r in doc["rows"] if r["axis"] == "ici+dcn"]
+        by_alg = {r["algorithm"] for r in combined}
+        assert by_alg == {"flat", "hierarchical"}
+        hier = [r for r in combined if r["algorithm"] == "hierarchical"]
         assert hier[0]["hierarchical_speedup_vs_flat"] > 0
         assert doc["hierarchical_speedup_vs_flat_at_peak"] > 0
         assert doc["mesh"] == {"dcn": 2, "ici": 4}
         for r in doc["rows"]:
-            assert {"axis", "algorithm", "wire",
-                    "bytes_on_wire"} <= set(r)
+            # the normalized fitter schema every row carries
+            assert {"axis", "algorithm", "wire", "bytes_on_wire",
+                    "size_bytes", "seconds", "axis_size"} <= set(r)
+            assert r["seconds"] > 0 and r["axis_size"] >= 2

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Regenerate the static cost-model calibration from measured rows.
+
+Reads any set of ``bench_allreduce.py --json-out`` result files
+(normalized row schema, ``schema_version`` >= 1; legacy files are
+adapted), fits the per-(tier, algorithm, wire) alpha-beta constants via
+``horovod_tpu.analysis.costmodel.fit_from_bench``, and writes the
+calibration JSON the model loads (default
+``.hvdt-costmodel-calibration.json`` at the repo root, the
+``HVDT_COSTMODEL_CALIBRATION`` default).
+
+The checked-in calibration was fitted from the CPU-sim sweeps under
+``tools/calibration/``::
+
+    python tools/fit_costmodel.py tools/calibration/*.json
+
+Re-run on a real TPU slice to calibrate against hardware — the sweep
+commands are recorded in each row file's ``meta``/CLI echo and in
+docs/analysis.md.  No jax import; safe anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from horovod_tpu.analysis import costmodel  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fit the analysis cost-model calibration from "
+                    "bench_allreduce --json-out row files.")
+    ap.add_argument("rows", nargs="+",
+                    help="bench_allreduce.py --json-out result files")
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, costmodel.CALIBRATION_NAME),
+        help="calibration file to write (default: the checked-in "
+             "repo-root file)")
+    args = ap.parse_args(argv)
+
+    all_rows = []
+    sources = []
+    measured = None
+    for path in args.rows:
+        with open(path) as fh:
+            doc = json.load(fh)
+        rows = costmodel.normalize_rows(doc)
+        if not rows:
+            print(f"fit_costmodel: {path}: no usable rows, skipped",
+                  file=sys.stderr)
+            continue
+        # Record the measured hierarchical-vs-flat verdict (prefer the
+        # pure-f32 sweep) — the --perf gate's model-vs-measured
+        # validation target.
+        peak = (doc.get("hierarchical_speedup_vs_flat_at_peak")
+                if isinstance(doc, dict) else None)
+        if peak and (measured is None
+                     or "int8" in str(measured.get("transport", ""))):
+            measured = {
+                "value": float(peak),
+                "at_bytes": int(doc.get("at_bytes", 0) or 0),
+                "mesh": doc.get("mesh", {}),
+                "transport": doc.get("transport", ""),
+                "file": os.path.relpath(path, _REPO),
+            }
+        all_rows.extend(rows)
+        sources.append({
+            "file": os.path.relpath(path, _REPO),
+            "rows": len(rows),
+            "metric": doc.get("metric") if isinstance(doc, dict) else None,
+            "platform": (doc.get("platform")
+                         if isinstance(doc, dict) else None),
+            "n_devices": (doc.get("n_devices")
+                          if isinstance(doc, dict) else None),
+        })
+    if not all_rows:
+        print("fit_costmodel: no rows in any input file", file=sys.stderr)
+        return 1
+
+    meta = {"sources": sources}
+    if measured:
+        meta["measured_hier_speedup"] = measured
+    cal = costmodel.fit_from_bench(all_rows, meta=meta)
+    cal.save(args.out)
+    print(f"fit_costmodel: {cal.describe()}")
+    print(f"fit_costmodel: wrote {args.out} "
+          f"({len(all_rows)} rows from {len(sources)} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,6 +163,13 @@ def _parse_args(argv=None):
                     help="Seconds of sustained client fire for --serve.")
     ap.add_argument("--serve-threads", type=int, default=8,
                     help="Concurrent HTTP client threads for --serve.")
+    ap.add_argument("--report", action="store_true",
+                    help="After the run, render the post-mortem "
+                         "markdown report (analysis --report) from the "
+                         "HVDT_EVENT_LOG anomaly event log to stderr — "
+                         "the bench-side smoke of the attribution "
+                         "plane.  Rides the telemetry doc, so it never "
+                         "touches the last-good cache.")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -655,6 +662,19 @@ def _run_child(args) -> None:
         fr = _tfr.get_flight_recorder()
         if fr is not None:
             telemetry_doc["flight_recorder_events"] = len(fr.events())
+        # Predicted-vs-observed attribution (HVDT_EXPECTED_SCHEDULE):
+        # the cost model's exposed-comm prediction, the observed
+        # comm-exposed step time, the deviation ratio, and per-kind
+        # anomaly counts — inside the telemetry doc, so it stays out
+        # of the last-good headline cache with the rest of it.
+        evo = _tele.expected_vs_observed_doc()
+        if evo is not None:
+            telemetry_doc["expected_vs_observed"] = evo
+        if args.report and os.environ.get("HVDT_EVENT_LOG"):
+            from horovod_tpu.analysis.report import render_report
+
+            print(render_report(os.environ["HVDT_EVENT_LOG"]),
+                  file=sys.stderr)
     print(json.dumps({
         "metric": METRIC,
         "value": round(value, 2),
@@ -905,7 +925,8 @@ def main() -> None:
         + (["--transport", args.transport] if args.transport else []) \
         + (["--zero", args.zero] if args.zero else []) \
         + (["--remat", args.remat] if args.remat else []) \
-        + (["--ckpt-stall"] if args.ckpt_stall else [])
+        + (["--ckpt-stall"] if args.ckpt_stall else []) \
+        + (["--report"] if args.report else [])
 
     # Phase 1: accelerator attempts with backoff (tunnelled backends can be
     # transiently down; a hung init is bounded by the child timeout).

@@ -13,7 +13,8 @@
 #       continuous-goodput/async-checkpoint/peer-restore +
 #       elastic-serving-control-plane/router/autoscaler +
 #       static-analysis/schedule-fingerprint +
-#       static-cost-model/perf-gate tests on
+#       static-cost-model/perf-gate +
+#       live-attribution/time-series/anomaly-detection tests on
 #       CPU) — the pre-merge gate.  The full matrix additionally
 #       emits the `analysis` service: python -m horovod_tpu.analysis
 #       --all --perf as a hard gate over the hvdt-lint ratchet

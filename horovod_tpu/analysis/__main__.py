@@ -9,6 +9,12 @@ self-check; the default with no flags):
 * ``--knob-table``      print the generated knob table
   (``--write PATH``    write it, e.g. ``--write docs/knobs.md``;
   ``--check``          fail on registry/docs drift)
+* ``--metric-table``    print the generated metric-catalog table
+  (``--write PATH``    write it, e.g. ``--write docs/metrics.md``;
+  ``--check``          fail on catalog/docs drift)
+* ``--report PATH``     render a post-mortem markdown report from an
+                        anomaly event log (HVDT_EVENT_LOG JSONL) or an
+                        artifact directory (``--report-out`` writes it)
 * ``--selfcheck``       trace the reference overlapped + hierarchical
                         step and run every schedule verifier pass
 * ``--schedule OUT``    export the self-check step's fingerprint JSON
@@ -98,6 +104,38 @@ def _gate_knobs(root: str, check: bool, write: Optional[str]) -> int:
         print(f"hvdt-knobs: {len(problems)} drift problem(s)")
         return 1 if problems else 0
     print(knob_table_markdown())
+    return 0
+
+
+def _gate_metrics(root: str, check: bool, write: Optional[str]) -> int:
+    from .lint import (check_metric_docs, metric_table_markdown,
+                       write_metric_table)
+
+    if write:
+        path = write if os.path.isabs(write) else os.path.join(root, write)
+        write_metric_table(path)
+        print(f"hvdt-metrics: wrote {path}")
+        return 0
+    if check:
+        problems = check_metric_docs(root)
+        for p in problems:
+            print(f"hvdt-metrics: {p}")
+        print(f"hvdt-metrics: {len(problems)} drift problem(s)")
+        return 1 if problems else 0
+    print(metric_table_markdown())
+    return 0
+
+
+def _gate_report(target: str, out: Optional[str]) -> int:
+    from .report import render_report
+
+    md = render_report(target)
+    if out:
+        with open(out, "w") as fh:
+            fh.write(md)
+        print(f"hvdt-report: wrote {out}")
+    else:
+        print(md)
     return 0
 
 
@@ -474,17 +512,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "(collective-schedule verifier + hvdt-lint + "
                     "lock-order graph).")
     p.add_argument("--all", action="store_true",
-                   help="lint + locks + knob-table drift check + "
-                        "schedule self-check (the CI gate; default "
-                        "when no mode flag is given)")
+                   help="lint + locks + knob-table and metric-table "
+                        "drift checks + schedule self-check (the CI "
+                        "gate; default when no mode flag is given)")
     p.add_argument("--lint", action="store_true")
     p.add_argument("--locks", action="store_true")
     p.add_argument("--knob-table", action="store_true",
                    help="print the generated knob table")
+    p.add_argument("--metric-table", action="store_true",
+                   help="print the generated metric-catalog table "
+                        "(telemetry/metrics.py CATALOG)")
     p.add_argument("--check", action="store_true",
-                   help="with --knob-table: fail on docs drift")
+                   help="with --knob-table/--metric-table: fail on "
+                        "docs drift")
     p.add_argument("--write", default=None, metavar="PATH",
-                   help="with --knob-table: write the generated doc")
+                   help="with --knob-table/--metric-table: write the "
+                        "generated doc (give exactly one table flag)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="render a post-mortem markdown report from an "
+                        "anomaly event log (JSONL) or artifact "
+                        "directory")
+    p.add_argument("--report-out", default=None, metavar="OUT.md",
+                   help="with --report: write the markdown here "
+                        "instead of stdout")
     p.add_argument("--selfcheck", action="store_true",
                    help="trace the reference step and run the "
                         "schedule verifier passes")
@@ -525,15 +575,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     baseline = args.baseline or os.path.join(root, BASELINE_NAME)
 
+    if args.report:
+        return _gate_report(args.report, args.report_out)
+
     perf_mode = (args.perf or args.update_perf_baseline
                  or bool(args.perf_fingerprint))
     any_mode = (args.lint or args.locks or args.knob_table
-                or args.selfcheck or args.schedule or args.dump_locks
-                or perf_mode)
+                or args.metric_table or args.selfcheck or args.schedule
+                or args.dump_locks or perf_mode)
     if args.all or not any_mode:
         args.all = True
         args.lint = args.locks = args.selfcheck = True
-        args.knob_table, args.check = True, True
+        args.knob_table, args.metric_table, args.check = True, True, True
     if perf_mode and not args.perf_fingerprint:
         # Tracing the reference fingerprints needs the deterministic
         # 8-device sim; evaluating exported files is jax-free.
@@ -563,7 +616,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.locks or args.dump_locks:
         rc |= _gate_locks(root, baseline, dump=args.dump_locks)
     if args.knob_table:
-        rc |= _gate_knobs(root, check=args.check, write=args.write)
+        rc |= _gate_knobs(root, check=args.check,
+                          write=(None if args.metric_table
+                                 else args.write))
+    if args.metric_table:
+        rc |= _gate_metrics(root, check=args.check,
+                            write=(None if args.knob_table
+                                   else args.write))
     if args.selfcheck or args.schedule:
         rc |= _gate_selfcheck(args.schedule, root)
     if perf_mode:

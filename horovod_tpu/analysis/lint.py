@@ -35,7 +35,8 @@ __all__ = [
     "Finding", "Rule", "RULES", "register", "lint_source", "lint_paths",
     "default_paths", "load_baseline", "save_baseline", "apply_baseline",
     "run_lint", "knob_table_markdown", "write_knob_table",
-    "check_knob_docs", "declared_knobs",
+    "check_knob_docs", "declared_knobs", "metric_table_markdown",
+    "write_metric_table", "check_metric_docs",
 ]
 
 _KNOB_RE = re.compile(r"^HVDT_[A-Z0-9]+(?:_[A-Z0-9]+)*$")
@@ -408,6 +409,64 @@ class MagicPeakFlopsRule(Rule):
 
 
 @register
+class MetricDriftRule(Rule):
+    """Every metric the package constructs by literal name
+    (``registry.counter("hvdt_...")`` / ``Counter("hvdt_...")`` /
+    ``.gauge`` / ``.summary``) must be declared in the
+    ``telemetry/metrics.py`` CATALOG — the registry ``docs/metrics.md``
+    is generated from.  An undeclared construction is a metric that
+    never reaches the docs and silently forks the naming scheme
+    (the knob-drift contract applied to metrics)."""
+
+    name = "metric-drift"
+    doc = ("hvdt_*/serve_* metric constructions must be declared in "
+           "telemetry/metrics.py CATALOG")
+
+    _METHODS = ("counter", "gauge", "summary")
+    _CLASSES = ("Counter", "Gauge", "Summary")
+    _PREFIXES = ("hvdt_", "serve_")
+
+    def check(self, tree, src, path, ctx):
+        # The catalog module itself declares, it doesn't construct.
+        if path.endswith(os.path.join("telemetry", "metrics.py")):
+            return
+        from ..telemetry.metrics import declared_metric
+
+        lines = src.splitlines()
+        seen: Dict[str, int] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            is_metric_call = (
+                (isinstance(fn, ast.Attribute)
+                 and fn.attr in self._METHODS)
+                or (isinstance(fn, ast.Name) and fn.id in self._CLASSES))
+            if not is_metric_call:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue   # dynamic names ride catalog wildcards
+            name = arg.value
+            if not name.startswith(self._PREFIXES):
+                continue   # collections.Counter & friends
+            if declared_metric(name):
+                continue
+            snippet = _line_of(lines, node.lineno)
+            occ = seen.get(name, 0)
+            seen[name] = occ + 1
+            yield Finding(
+                self.name, path, node.lineno,
+                f"metric {name!r} is constructed but not declared in "
+                f"telemetry/metrics.py CATALOG — add a MetricSpec "
+                f"(name/kind/labels/doc) and regenerate docs/metrics.md "
+                f"(python -m horovod_tpu.analysis --metric-table "
+                f"--write docs/metrics.md)",
+                snippet=snippet, occurrence=occ)
+
+
+@register
 class SleepPollRule(Rule):
     """A ``time.sleep`` inside a ``while`` loop is a hand-rolled poll:
     fixed-interval retries synchronize into thundering herds and have
@@ -653,6 +712,73 @@ def write_knob_table(path: str) -> str:
     with open(path, "w") as fh:
         fh.write(render_knob_doc())
     return path
+
+
+_METRIC_MARK = ("<!-- generated by `python -m horovod_tpu.analysis "
+                "--metric-table --write docs/metrics.md` — do not edit "
+                "by hand -->")
+
+
+def metric_table_markdown() -> str:
+    """The metric CATALOG as markdown tables grouped by kind (the
+    docs/knobs.md pattern applied to metrics)."""
+    from ..telemetry.metrics import CATALOG
+
+    lines = ["| Metric | Type | Labels | Description |",
+             "|---|---|---|---|"]
+    for name in sorted(CATALOG):
+        s = CATALOG[name]
+        labels = ", ".join(f"`{lb}`" for lb in s.labels) or "—"
+        lines.append(f"| `{name}` | {s.kind} | {labels} | "
+                     f"{_squash(s.doc)} |")
+    return "\n".join(lines)
+
+
+def render_metric_doc() -> str:
+    return "\n".join([
+        "# Metric registry",
+        "",
+        _METRIC_MARK,
+        "",
+        "Single source of truth: the CATALOG in "
+        "`horovod_tpu/telemetry/metrics.py`.  Every "
+        "Counter/Gauge/Summary the package constructs must be declared "
+        "there — the `metric-drift` lint rule fails CI on any literal "
+        "metric name missing from the catalog, and `python -m "
+        "horovod_tpu.analysis --metric-table --check` gates drift "
+        "between the catalog and this table.  Names ending in `*` are "
+        "prefix wildcards for dynamically-formatted families.  See "
+        "docs/observability.md for semantics and scrape examples.",
+        "",
+        metric_table_markdown(),
+        "",
+    ])
+
+
+def write_metric_table(path: str) -> str:
+    with open(path, "w") as fh:
+        fh.write(render_metric_doc())
+    return path
+
+
+def check_metric_docs(root: str) -> List[str]:
+    """Freshness check: docs/metrics.md must match the generated
+    catalog table."""
+    problems: List[str] = []
+    metrics_md = os.path.join(root, "docs", "metrics.md")
+    try:
+        current = open(metrics_md).read()
+    except OSError:
+        problems.append("docs/metrics.md missing — generate it with "
+                        "`python -m horovod_tpu.analysis --metric-table "
+                        "--write docs/metrics.md`")
+        current = ""
+    if current and current.strip() != render_metric_doc().strip():
+        problems.append("docs/metrics.md is stale vs telemetry/metrics."
+                        "py CATALOG — regenerate with `python -m "
+                        "horovod_tpu.analysis --metric-table --write "
+                        "docs/metrics.md`")
+    return problems
 
 
 def check_knob_docs(root: str) -> List[str]:

@@ -1,0 +1,153 @@
+"""Post-mortem run report: anomaly event log (+ trace artifacts) →
+markdown.
+
+``python -m horovod_tpu.analysis --report <event-log|trace-dir>``
+renders the run's observability artifacts into one human-readable
+document: the run timeline reconstructed from the JSONL anomaly event
+log (``HVDT_EVENT_LOG``), a per-kind anomaly summary, and — when the
+target is a directory — an inventory of the forensics files found next
+to it (Chrome traces, desync reports, more event logs).
+
+Pure stdlib, no jax: a post-mortem must render on any laptop from a
+copied artifact directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["render_report", "collect_artifacts"]
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if not ts:
+        return "?"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(float(ts)))
+
+
+def collect_artifacts(target: str) -> Tuple[List[str], List[str]]:
+    """(event_log_paths, other_artifact_paths) under ``target``.
+
+    A file target is taken as one event log; a directory is scanned
+    for ``*.jsonl`` event logs plus the known forensics artifacts
+    (``trace_*.json``, ``trace_merged.json``, ``desync_report*.json``).
+    """
+    if os.path.isfile(target):
+        return [target], []
+    logs: List[str] = []
+    other: List[str] = []
+    try:
+        names = sorted(os.listdir(target))
+    except OSError:
+        return [], []
+    for name in names:
+        path = os.path.join(target, name)
+        if not os.path.isfile(path):
+            continue
+        if name.endswith(".jsonl"):
+            logs.append(path)
+        elif (name.startswith(("trace_", "desync_report"))
+              and name.endswith(".json")):
+            other.append(path)
+    return logs, other
+
+
+def _event_row(ev: Dict[str, Any]) -> str:
+    who = []
+    if ev.get("rank") is not None:
+        who.append(f"rank {ev['rank']}")
+    if ev.get("pod"):
+        who.append(f"pod {ev['pod']}")
+    ratio = ev.get("ratio")
+    return ("| " + " | ".join([
+        _fmt_ts(ev.get("ts")),
+        str(ev.get("step", "")),
+        str(ev.get("kind", "")),
+        str(ev.get("scope", "")),
+        ", ".join(who) or "—",
+        f"{ratio:.2f}x" if isinstance(ratio, (int, float)) else "—",
+        str(ev.get("message", "")).replace("|", "\\|"),
+    ]) + " |")
+
+
+def render_report(target: str) -> str:
+    """Markdown post-mortem for an event-log file or artifact
+    directory."""
+    from ..telemetry.anomaly import read_event_log
+
+    logs, artifacts = collect_artifacts(target)
+    events: List[Dict[str, Any]] = []
+    for path in logs:
+        events.extend(read_event_log(path))
+    events.sort(key=lambda e: (float(e.get("ts") or 0),
+                               int(e.get("step") or 0)))
+
+    lines: List[str] = [
+        "# Run post-mortem report",
+        "",
+        f"Source: `{target}`  ",
+        f"Event logs: {len(logs)} — {len(events)} event(s)",
+        "",
+    ]
+
+    if events:
+        first, last = events[0], events[-1]
+        dur = float(last.get("ts") or 0) - float(first.get("ts") or 0)
+        steps = [int(e["step"]) for e in events
+                 if e.get("step") is not None]
+        lines += [
+            "## Run timeline",
+            "",
+            f"* first event: {_fmt_ts(first.get('ts'))} "
+            f"(step {first.get('step', '?')})",
+            f"* last event:  {_fmt_ts(last.get('ts'))} "
+            f"(step {last.get('step', '?')})",
+            f"* span: {dur:.1f}s"
+            + (f", steps {min(steps)}–{max(steps)}" if steps else ""),
+            "",
+            "| time (UTC) | step | kind | scope | who | ratio |"
+            " message |",
+            "|---|---|---|---|---|---|---|",
+        ]
+        lines += [_event_row(e) for e in events]
+        lines.append("")
+
+        counts: Dict[str, List[int]] = {}
+        for e in events:
+            kind = str(e.get("kind", "?"))
+            counts.setdefault(kind, []).append(int(e.get("step") or 0))
+        lines += [
+            "## Anomaly summary",
+            "",
+            "| kind | count | first step | last step |",
+            "|---|---|---|---|",
+        ]
+        for kind in sorted(counts):
+            steps_k = counts[kind]
+            lines.append(f"| {kind} | {len(steps_k)} | {min(steps_k)} "
+                         f"| {max(steps_k)} |")
+        lines.append("")
+    else:
+        lines += ["## Run timeline", "",
+                  "No anomaly events found — either a clean run, or "
+                  "`HVDT_EVENT_LOG` was not set.", ""]
+
+    if artifacts:
+        lines += ["## Forensics artifacts", ""]
+        for path in artifacts:
+            note = ""
+            if os.path.basename(path).startswith("desync_report"):
+                try:
+                    with open(path) as fh:
+                        doc = json.load(fh)
+                    note = (f" — first divergent seq "
+                            f"{doc.get('first_divergent_seq')}, missing "
+                            f"ranks {doc.get('missing_ranks')}")
+                except (OSError, ValueError):
+                    note = " — unreadable"
+            lines.append(f"* `{os.path.basename(path)}`{note}")
+        lines.append("")
+    return "\n".join(lines)

@@ -297,6 +297,17 @@ def init(
 
         maybe_start_exporter(topology=topo)
 
+        # Predicted-vs-observed perf attribution: when an expected
+        # schedule fingerprint is configured (HVDT_EXPECTED_SCHEDULE),
+        # price it with the fitted cost model on the ambient topology
+        # and publish hvdt_expected_step_comm_seconds /
+        # hvdt_expected_wire_bytes{axis}; the StepTimer stream then
+        # keeps hvdt_perf_deviation_ratio live.  No-op when telemetry
+        # is off; never raises.
+        from ..telemetry.step_stats import maybe_publish_expected_cost
+
+        maybe_publish_expected_cost()
+
 
 def shutdown() -> None:
     """Tear down (ref: operations.cc horovod_shutdown)."""

@@ -200,6 +200,46 @@ KNOBS: Dict[str, Knob] = {
            "Seconds between worker snapshot publishes to the rendezvous "
            "KV (/telemetry/<rank>) for driver-side aggregation; only "
            "active under the elastic launcher.  0 disables publishing."),
+        # --- live perf attribution (telemetry/history.py +
+        #     telemetry/anomaly.py: per-metric time series, windowed
+        #     anomaly detectors, predicted-vs-observed pricing) ---
+        _k("HVDT_HISTORY", False, _parse_bool,
+           "Keep bounded per-metric time series (ring buffers of "
+           "(wall_ts, step, value) samples: step time, examples/s, MFU, "
+           "goodput fraction, per-axis wire bytes, perf-deviation "
+           "ratio), served as /timeseries on the per-worker exporter, "
+           "published in the KV telemetry snapshot for driver-side "
+           "step-aligned roll-ups, and fed to the windowed anomaly "
+           "detectors.  Requires HVDT_TELEMETRY.  Off (default) = zero "
+           "overhead (telemetry.history.get_history() is None)."),
+        _k("HVDT_HISTORY_WINDOW", 512, int,
+           "Max samples retained per time series (ring buffer; the "
+           "recent window is what detectors and `hvdtrun top` read, "
+           "memory stays flat)."),
+        _k("HVDT_HISTORY_SAMPLE_S", 1.0, float,
+           "Minimum seconds between time-series samples (the recording "
+           "cadence; steps arriving faster are coalesced into one "
+           "sample carrying their mean step time).  0 = sample every "
+           "observed step (tests, short runs)."),
+        _k("HVDT_EVENT_LOG", "", str,
+           "Path of the structured JSONL anomaly event log: each "
+           "detector firing (step_time_shift, goodput_drop, "
+           "mfu_regression, wire_drift, straggler_onset, "
+           "perf_deviation) appends one JSON line with kind / step / "
+           "rank / pod / value / baseline / ratio / message; the "
+           "elastic driver writes cluster-scoped events (a pod-wide "
+           "shift is ONE event) to the same format.  Empty (default) = "
+           "off (telemetry.anomaly.get_event_log() is None); "
+           "hvdt_anomaly_total{kind} counters ride the registry either "
+           "way when detectors run."),
+        _k("HVDT_PERF_DEVIATION_RATIO", 2.0, float,
+           "Fire a perf_deviation anomaly event when "
+           "hvdt_perf_deviation_ratio (observed EWMA step seconds vs "
+           "the cost-model-predicted step seconds: predicted exposed "
+           "comm + compute anchor) exceeds this factor — the runtime "
+           "mirror of the CI --perf ratchet.  Needs "
+           "HVDT_EXPECTED_SCHEDULE (or an in-process traced "
+           "fingerprint) so hvd.init() can price the schedule."),
         # --- distributed tracing + flight recorder (telemetry/trace.py,
         #     telemetry/flight_recorder.py — cross-rank forensics) ---
         _k("HVDT_TRACE_DIR", "", str,

@@ -95,6 +95,11 @@ class ElasticDriver:
         self._discovery_thread: Optional[threading.Thread] = None
         self._rendezvous_cb: Optional[Callable[[List[hosts_mod.SlotInfo],
                                                 int], None]] = None
+        # Cluster anomaly correlation (telemetry/anomaly.py): created
+        # lazily on the first discovery tick that finds HVDT_EVENT_LOG
+        # configured — cluster events (a pod-wide step-time shift is
+        # ONE event) land in the driver's JSONL event log.
+        self._cluster_anomalies = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -139,6 +144,7 @@ class ElasticDriver:
                 self._notify_hosts_updated()
             self._poll_worker_registry()
             self._check_pod_stragglers()
+            self._check_cluster_anomalies()
 
     def _poll_worker_registry(self) -> None:
         """Feed KV-reported worker states (workers put
@@ -224,6 +230,43 @@ class ElasticDriver:
         from ...telemetry.flight_recorder import collect_server_events
 
         return collect_server_events(self._kv)
+
+    def telemetry_rollup(self):
+        """Step-aligned fleet roll-up over the latest KV snapshots
+        (telemetry/aggregate.rollup): per-pod median/p99 step time,
+        cluster wire-bytes-by-axis, goodput series, worst pod.  Ranks
+        publishing the old snapshot schema (no step id / time series)
+        are skipped and counted, never failed."""
+        snaps = self.telemetry_snapshots()
+        if not snaps:
+            return {}
+        from ...telemetry import aggregate as _aggregate
+
+        return _aggregate.rollup(snaps)
+
+    def _check_cluster_anomalies(self) -> None:
+        """Run the cluster anomaly rules over the fleet snapshots each
+        discovery tick (active only when HVDT_EVENT_LOG names a driver-
+        side event log — the zero-overhead gate)."""
+        if self._kv is None:
+            return
+        try:
+            from ...telemetry import anomaly as _anomaly
+
+            if self._cluster_anomalies is None:
+                if _anomaly.get_event_log() is None:
+                    return
+                self._cluster_anomalies = _anomaly.ClusterAnomalyMonitor()
+            snaps = self.telemetry_snapshots()
+            if not snaps:
+                return
+            for ev in self._cluster_anomalies.observe(snaps):
+                print(f"elastic: anomaly {ev.get('kind')} "
+                      f"({ev.get('scope')}): {ev.get('message')}",
+                      file=sys.stderr)
+        except Exception as e:   # detection must never sink the driver
+            print(f"elastic: cluster anomaly check failed: {e}",
+                  file=sys.stderr)
 
     def _check_pod_stragglers(self) -> None:
         """The pod-granular escalation rung over the PR-5 straggler

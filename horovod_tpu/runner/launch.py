@@ -375,6 +375,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..serve import main as serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "top":
+        # `hvdtrun top ...` — live terminal view over worker
+        # /timeseries endpoints (telemetry/top.py): per-rank step-time
+        # sparklines, goodput, worst pod, last anomalies.  Flags after
+        # `top` are the top CLI's (--endpoints/--interval/--once/
+        # --event-log).
+        from ..telemetry.top import main as top_main
+
+        return top_main(argv[1:])
     if argv and argv[0] == "lint":
         # `hvdtrun lint ...` — the static-analysis gate (collective-
         # schedule verifier + hvdt-lint rule registry + lock-order

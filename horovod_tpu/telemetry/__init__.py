@@ -27,13 +27,30 @@ and ad-hoc module-level ints).  Layering, bottom up:
 * :mod:`~horovod_tpu.telemetry.flight_recorder` — always-cheap ring of
   recent collective events (seq/op/dtype/bytes/wire, in-flight vs done)
   + the cross-rank desync analyzer that names the first divergent
-  collective on stall-abort.
+  collective on stall-abort;
+* :mod:`~horovod_tpu.telemetry.history` — bounded per-metric time
+  series (``HVDT_HISTORY``), served as ``/timeseries`` and embedded in
+  the KV snapshot for step-aligned driver roll-ups;
+* :mod:`~horovod_tpu.telemetry.anomaly` — windowed detectors over the
+  series + the JSONL anomaly event log (``HVDT_EVENT_LOG``) and the
+  driver-side pod-correlated cluster rules;
+* :mod:`~horovod_tpu.telemetry.aggregate` — step-id-joined cross-rank
+  roll-ups (per-pod median/p99, cluster wire bytes, goodput series);
+* :mod:`~horovod_tpu.telemetry.top` — the ``hvdtrun top`` live
+  terminal view over ``/timeseries``.
+
+Predicted-vs-observed attribution lives in :mod:`~horovod_tpu.
+telemetry.step_stats`: ``hvd.init()`` prices the expected schedule
+fingerprint (``HVDT_EXPECTED_SCHEDULE``) with the analytical cost model
+and the StepTimer stream keeps ``hvdt_perf_deviation_ratio`` live.
 
 Knobs: ``HVDT_TELEMETRY``, ``HVDT_METRICS_PORT``,
 ``HVDT_STRAGGLER_WINDOW``, ``HVDT_STRAGGLER_THRESHOLD``,
-``HVDT_TELEMETRY_PUBLISH_S`` (common/config.py); launcher flags
-``hvdtrun --telemetry`` / ``--metrics-port``.  See docs/observability.md
-for the metric catalog and a scrape example.
+``HVDT_TELEMETRY_PUBLISH_S``, ``HVDT_HISTORY``/``HVDT_HISTORY_*``,
+``HVDT_EVENT_LOG``, ``HVDT_PERF_DEVIATION_RATIO`` (common/config.py);
+launcher flags ``hvdtrun --telemetry`` / ``--metrics-port``.  See
+docs/observability.md for semantics and docs/metrics.md for the
+generated metric catalog.
 """
 
 from .metrics import (  # noqa: F401
@@ -51,12 +68,31 @@ from .instrument import (  # noqa: F401
     wrap_step,
 )
 from .step_stats import (  # noqa: F401
+    DeviationTracker,
     GoodputLedger,
+    PerfExpectation,
     StepTimer,
     bind_resilience_gauges,
+    expected_vs_observed_doc,
+    get_deviation_tracker,
+    maybe_publish_expected_cost,
     peak_flops_for,
+    publish_expected_schedule_cost,
 )
 from .straggler import StragglerMonitor  # noqa: F401
+from .history import (  # noqa: F401
+    MetricHistory,
+    Series,
+    get_history,
+)
+from .anomaly import (  # noqa: F401
+    AnomalyMonitor,
+    ClusterAnomalyMonitor,
+    EventLog,
+    get_event_log,
+    read_event_log,
+)
+from .aggregate import rollup  # noqa: F401
 from .exporter import (  # noqa: F401
     MetricsExporter,
     bind_process_gauges,
@@ -86,6 +122,12 @@ __all__ = [
     "CollectiveRecorder", "enabled", "get_recorder", "wrap_step",
     "StepTimer", "GoodputLedger", "bind_resilience_gauges",
     "peak_flops_for", "StragglerMonitor",
+    "PerfExpectation", "DeviationTracker", "get_deviation_tracker",
+    "publish_expected_schedule_cost", "maybe_publish_expected_cost",
+    "expected_vs_observed_doc",
+    "MetricHistory", "Series", "get_history",
+    "AnomalyMonitor", "ClusterAnomalyMonitor", "EventLog",
+    "get_event_log", "read_event_log", "rollup",
     "MetricsExporter", "start_exporter", "stop_exporter", "get_exporter",
     "maybe_start_exporter", "snapshot_dict", "collect_driver_snapshots",
     "bind_process_gauges",

@@ -50,12 +50,20 @@ def snapshot_dict(registry: Optional[MetricsRegistry] = None
     workers publish to the driver and bench.py embeds in its output."""
     reg = registry if registry is not None else default_registry()
     out: Dict[str, Any] = {}
+    # Snapshot schema v2 (tolerant): wall_ts + the current step id let
+    # the driver step-align cross-rank roll-ups (telemetry/aggregate);
+    # v1 consumers ignore the extra keys, v1 producers are skipped by
+    # the aligned roll-up with a counted hvdt_snapshot_unaligned_total.
+    out["wall_ts"] = round(time.time(), 3)
     bytes_total = reg.get("hvdt_collective_bytes_total")
     if bytes_total is not None:
         out["bytes_on_wire_total"] = bytes_total.total()
     coll = reg.get("hvdt_collectives_total")
     if coll is not None:
         out["collectives_total"] = coll.total()
+    step_counter = reg.get("hvdt_steps_total")
+    if step_counter is not None:
+        out["step"] = int(step_counter.total())
     steps = reg.get("hvdt_step_time_seconds")
     if steps is not None and steps.count:
         pct = steps.percentiles()
@@ -70,11 +78,25 @@ def snapshot_dict(registry: Optional[MetricsRegistry] = None
                        ("hvdt_straggler_rank", "straggler_rank"),
                        ("hvdt_step_time_skew", "step_time_skew"),
                        ("hvdt_straggler_pod", "straggler_pod"),
-                       ("hvdt_pod_step_time_skew", "pod_step_time_skew")):
+                       ("hvdt_pod_step_time_skew", "pod_step_time_skew"),
+                       ("hvdt_perf_deviation_ratio",
+                        "perf_deviation_ratio"),
+                       ("hvdt_expected_step_comm_seconds",
+                        "expected_step_comm_seconds")):
         g = reg.get(gname)
         if g is not None:
             v = g.value()
             out[key] = round(v, 4) if v == v else None   # NaN-safe
+    anomalies = reg.get("hvdt_anomaly_total")
+    if anomalies is not None:
+        out["anomaly_total"] = anomalies.total()
+    # Time-series tail (HVDT_HISTORY): a short recent slice so the
+    # driver can join ranks on step id without scraping /timeseries.
+    from . import history as _history
+
+    hist = _history.get_history()
+    if hist is not None:
+        out["timeseries"] = hist.to_dict(max_points=64)
     # Control-plane flakiness counters (runner/http_kv.py) — surfaced so
     # ElasticDriver.telemetry_snapshots() sees KV retries/errors per
     # worker without scraping N endpoints.
@@ -153,6 +175,26 @@ class _Handler(BaseHTTPRequestHandler):
             }
             self._reply(200, json.dumps(payload).encode(),
                         "application/json")
+        elif route == "/timeseries":
+            from . import history as _history
+
+            hist = _history.get_history()
+            if hist is None:
+                self._reply(404, json.dumps({
+                    "error": "metric history disabled "
+                             "(set HVDT_HISTORY=1)"}).encode(),
+                    "application/json")
+            else:
+                doc = hist.to_dict()
+                doc["rank"] = exp.rank
+                pod = os.environ.get("HVDT_POD")
+                if pod:
+                    doc["pod"] = pod
+                steps = exp.registry.get("hvdt_steps_total")
+                doc["step"] = (int(steps.total())
+                               if steps is not None else 0)
+                self._reply(200, json.dumps(doc).encode(),
+                            "application/json")
         elif route == "/flightrecorder":
             from . import flight_recorder as _frm
 

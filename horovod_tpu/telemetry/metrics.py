@@ -20,11 +20,13 @@ the percentile math onto a query engine the test rig doesn't have.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Summary", "MetricsRegistry",
-           "default_registry", "reset_default_registry"]
+           "default_registry", "reset_default_registry",
+           "MetricSpec", "CATALOG", "declared_metric"]
 
 
 def _fmt_labels(labels: Dict[str, str]) -> str:
@@ -83,6 +85,13 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def items(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every (labels, value) series — the per-label view harnesses
+        (time-series sampling, anomaly-count roll-ups) read without
+        reparsing the rendered text."""
+        with self._lock:
+            return [(dict(k), v) for k, v in sorted(self._values.items())]
+
     def total(self) -> float:
         """Sum across every label combination — the scrape-independent
         aggregate harnesses (bench JSON, driver roll-ups) report."""
@@ -101,42 +110,69 @@ class Counter(_Metric):
 
 class Gauge(_Metric):
     """Point-in-time value; ``set_function`` makes it a live probe (queue
-    depth is read from the batcher at scrape time, not shadowed)."""
+    depth is read from the batcher at scrape time, not shadowed).
+
+    Optionally labelled: ``set(v, axis="dcn")`` keeps one value per
+    label combination (``hvdt_expected_wire_bytes{axis=...}``); without
+    labels the gauge stays the scalar it always was, and live probes
+    are scalar-only."""
 
     kind = "gauge"
 
     def __init__(self, name: str, help: str = ""):
         super().__init__(name, help)
-        self._value = 0.0
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {(): 0.0}
         self._fn = None
 
-    def set(self, value: float) -> None:
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._value = float(value)
+            self._values[key] = float(value)
 
-    def inc(self, amount: float = 1.0) -> None:
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._value += amount
+            self._values[key] = self._values.get(key, 0.0) + amount
 
-    def dec(self, amount: float = 1.0) -> None:
-        self.inc(-amount)
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
 
     def set_function(self, fn) -> None:
         with self._lock:
             self._fn = fn
 
-    def value(self) -> float:
+    def value(self, **labels: str) -> float:
+        key = tuple(sorted(labels.items()))
         with self._lock:
             fn = self._fn
-            if fn is None:
-                return self._value
+            if fn is None or key:
+                return self._values.get(key, 0.0 if not key
+                                        else float("nan"))
         try:
             return float(fn())
         except Exception:
             return float("nan")
 
+    def items(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every labelled (labels, value) series (the scalar slot is
+        omitted unless it is the only one or was explicitly set)."""
+        with self._lock:
+            labelled = [(dict(k), v) for k, v in sorted(
+                self._values.items()) if k]
+            if labelled:
+                return labelled
+            return [({}, self._values.get((), 0.0))]
+
     def render(self) -> List[str]:
-        return self._header() + [f"{self.name} {_fmt_value(self.value())}"]
+        with self._lock:
+            fn = self._fn
+            labelled = sorted((k, v) for k, v in self._values.items() if k)
+        if fn is not None or not labelled:
+            return self._header() + [
+                f"{self.name} {_fmt_value(self.value())}"]
+        return self._header() + [
+            f"{self.name}{_fmt_labels(dict(k))} {_fmt_value(v)}"
+            for k, v in labelled]
 
 
 class Summary(_Metric):
@@ -189,29 +225,43 @@ class Summary(_Metric):
                 return None
             return float(sum(self._ring) / len(self._ring))
 
-    def quantile(self, q: float) -> Optional[float]:
-        """Nearest-rank quantile over the retained window (None if no
-        observations yet)."""
+    def _sorted_window(self) -> List[float]:
+        """The ONE sort per render/percentile pass.  Every quantile
+        consumer goes through here so a 3-quantile scrape costs one
+        O(n log n), not three (regression-tested via a sort-spy
+        subclass in tests/test_attribution.py)."""
         with self._lock:
-            if not self._ring:
-                return None
-            data = sorted(self._ring)
+            return sorted(self._ring)
+
+    @staticmethod
+    def _nearest_rank(data: List[float], q: float) -> float:
         idx = min(len(data) - 1, max(0, int(q * len(data) + 0.5) - 1))
         return data[idx]
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the retained window (None if no
+        observations yet).  For several quantiles at once use
+        :meth:`percentiles`, which sorts the window once."""
+        data = self._sorted_window()
+        if not data:
+            return None
+        return self._nearest_rank(data, q)
+
     def percentiles(self) -> Dict[float, Optional[float]]:
-        return {q: self.quantile(q) for q in self.QUANTILES}
+        data = self._sorted_window()
+        if not data:
+            return {q: None for q in self.QUANTILES}
+        return {q: self._nearest_rank(data, q) for q in self.QUANTILES}
 
     def render(self) -> List[str]:
         lines = self._header()
+        data = self._sorted_window()
         with self._lock:
-            data = sorted(self._ring)
             count, total = self._count, self._sum
         for q in self.QUANTILES:
             if data:
-                idx = min(len(data) - 1, max(0, int(q * len(data) + 0.5) - 1))
                 lines.append(f'{self.name}{{quantile="{q}"}} '
-                             f"{_fmt_value(data[idx])}")
+                             f"{_fmt_value(self._nearest_rank(data, q))}")
             else:
                 lines.append(f'{self.name}{{quantile="{q}"}} NaN')
         lines.append(f"{self.name}_sum {_fmt_value(total)}")
@@ -294,3 +344,246 @@ def reset_default_registry() -> MetricsRegistry:
     with _default_lock:
         _default = MetricsRegistry()
         return _default
+
+
+# ---------------------------------------------------------------------------
+# Metric catalog — the declared universe of metric names.
+#
+# Every Counter/Gauge/Summary the package constructs must be declared
+# here (name, type, label set, one-line doc).  The `metric-drift` lint
+# rule (analysis/lint.py) fails the CI gate on any construction whose
+# literal name is missing, and `python -m horovod_tpu.analysis
+# --metric-table --write docs/metrics.md` generates the docs table from
+# this registry — the docs/knobs.md pattern applied to metrics, so the
+# catalog, the code, and the docs can never drift apart.  Names ending
+# in `*` are prefix wildcards for dynamically-formatted families
+# (hvdt_phase_<PHASE>_seconds).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One declared metric: name (or `prefix*` wildcard), kind
+    (counter|gauge|summary), label names, and a docs line."""
+
+    name: str
+    kind: str
+    labels: Tuple[str, ...]
+    doc: str
+
+
+def _m(name: str, kind: str, labels: Sequence[str], doc: str) -> MetricSpec:
+    return MetricSpec(name, kind, tuple(labels), doc)
+
+
+CATALOG: Dict[str, MetricSpec] = {
+    s.name: s
+    for s in [
+        # -- collectives (telemetry/instrument.py) --
+        _m("hvdt_collective_bytes_total", "counter",
+           ("op", "dtype", "wire", "path", "axis"),
+           "Bytes on the wire per collective (path=eager counts "
+           "executions; path=jit counts traced programs)"),
+        _m("hvdt_collectives_total", "counter",
+           ("op", "dtype", "wire", "path", "axis"),
+           "Collectives recorded, labelled op/dtype/wire/path"),
+        _m("hvdt_wire_bytes_total", "counter", ("axis", "wire"),
+           "Bytes on the wire per mesh axis — the per-tier view of "
+           "hierarchical transport policies"),
+        _m("hvdt_collective_negotiate_seconds", "summary", (),
+           "Eager-path announce -> negotiated-response latency"),
+        _m("hvdt_collective_queue_seconds", "summary", (),
+           "Eager-path enqueue -> announce latency"),
+        _m("hvdt_collective_execute_seconds", "summary", (),
+           "Eager-path response dispatch duration"),
+        _m("hvdt_fusion_fill_ratio", "summary", (),
+           "Fused-allreduce bucket occupancy: bucket bytes / "
+           "HVDT_FUSION_THRESHOLD"),
+        _m("hvdt_step_dispatch_seconds", "summary", (),
+           "donated_step call duration (async dispatch interval)"),
+        _m("hvdt_overlap_hidden_bytes_total", "counter", (),
+           "Collective bytes issued with compute still scheduled under "
+           "their flight window (ops/overlap)"),
+        _m("hvdt_overlap_bytes_total", "counter", (),
+           "Total collective bytes scheduled by the overlap scheduler"),
+        _m("hvdt_overlap_fraction", "gauge", (),
+           "Hidden / total collective bytes across overlapped exchange "
+           "schedules"),
+        _m("hvdt_phase_*", "summary", (),
+           "Timeline span durations per phase (hvdt_phase_<PHASE>_"
+           "seconds, from the timeline writer's B/E pairs)"),
+        # -- step stats / goodput (telemetry/step_stats.py) --
+        _m("hvdt_step_time_seconds", "summary", (),
+           "Host-observed training step duration"),
+        _m("hvdt_steps_total", "counter", (),
+           "Training steps observed by the StepTimer"),
+        _m("hvdt_examples_per_sec", "gauge", (),
+           "Windowed training throughput (examples/s, EWMA)"),
+        _m("hvdt_mfu", "gauge", (),
+           "Model-flops utilization (published only when caller flops "
+           "and the device peak are both known)"),
+        _m("hvdt_goodput_fraction", "gauge", (),
+           "(elapsed - lost) / elapsed since ledger start"),
+        _m("hvdt_goodput_lost_seconds_total", "counter", ("reason",),
+           "Wall-clock seconds lost to non-training work, by reason"),
+        _m("hvdt_recovery_seconds", "counter", ("phase",),
+           "Recovery-time-budget seconds by phase (checkpoint_snapshot "
+           "| checkpoint_write | rendezvous | compile | restore | "
+           "replay)"),
+        _m("hvdt_injected_faults", "gauge", (),
+           "Faults the HVDT_FAULT_PLAN injector has fired"),
+        _m("hvdt_emergency_checkpoints", "gauge", (),
+           "Preemption-guard emergency checkpoints taken"),
+        _m("hvdt_param_bytes", "gauge", (),
+           "Per-rank parameter bytes (post-sharding)"),
+        _m("hvdt_optimizer_state_bytes", "gauge", (),
+           "Per-rank optimizer-state bytes (post-sharding)"),
+        # -- perf attribution (predicted vs observed) --
+        _m("hvdt_expected_step_comm_seconds", "gauge", (),
+           "Cost-model-predicted exposed (non-overlapped) communication "
+           "seconds per step for the expected schedule fingerprint on "
+           "the ambient topology (published by hvd.init when "
+           "HVDT_EXPECTED_SCHEDULE is set)"),
+        _m("hvdt_expected_wire_bytes", "gauge", ("axis",),
+           "Cost-model-predicted wire bytes per step per transport "
+           "tier for the expected schedule fingerprint"),
+        _m("hvdt_perf_deviation_ratio", "gauge", (),
+           "Observed EWMA step seconds / predicted step seconds "
+           "(predicted exposed comm + compute anchor) — >1 means the "
+           "live run is slower than the cost model says it should be; "
+           "the perf_deviation anomaly fires past "
+           "HVDT_PERF_DEVIATION_RATIO"),
+        _m("hvdt_anomaly_total", "counter", ("kind",),
+           "Anomaly detector firings by kind (step_time_shift | "
+           "goodput_drop | mfu_regression | wire_drift | "
+           "straggler_onset | perf_deviation)"),
+        _m("hvdt_history_samples_total", "counter", (),
+           "Time-series samples recorded by the metric history "
+           "(HVDT_HISTORY)"),
+        _m("hvdt_snapshot_unaligned_total", "counter", (),
+           "Driver-side roll-ups that skipped a rank whose KV snapshot "
+           "carried no step id / time series (old snapshot schema or "
+           "history off on that worker)"),
+        # -- straggler (telemetry/straggler.py) --
+        _m("hvdt_straggler_rank", "gauge", (),
+           "Worst straggler rank over the last window (-1 = none)"),
+        _m("hvdt_step_time_skew", "gauge", (),
+           "max(rank mean step time) / median over the last window"),
+        _m("hvdt_straggler_checks_total", "counter", (),
+           "Cross-rank straggler checks performed"),
+        _m("hvdt_straggler_flags_total", "counter", ("rank", "pod"),
+           "Straggler detections by offending rank (and pod)"),
+        _m("hvdt_straggler_pod", "gauge", (),
+           "Worst straggler pod over the last window (-1 = none)"),
+        _m("hvdt_pod_step_time_skew", "gauge", (),
+           "max(pod mean step time) / cross-pod median"),
+        # -- process gauges (telemetry/exporter.py) --
+        _m("hvdt_process_rss_bytes", "gauge", (),
+           "Resident set size of this worker process"),
+        _m("hvdt_process_open_fds", "gauge", (),
+           "Open file descriptors of this worker process"),
+        _m("hvdt_hbm_bytes_in_use", "gauge", (),
+           "Live device memory in use (nan where unavailable)"),
+        _m("hvdt_hbm_peak_bytes", "gauge", (),
+           "Peak device memory in use since process start"),
+        # -- checkpointing (checkpoint.py) --
+        _m("hvdt_ckpt_snapshot_seconds", "summary", (),
+           "Commit-point device->host checkpoint snapshot duration"),
+        _m("hvdt_ckpt_write_seconds", "summary", (),
+           "Background checkpoint write+fsync duration"),
+        _m("hvdt_ckpt_snapshot_over_budget_total", "counter", (),
+           "Snapshots exceeding HVDT_CKPT_SNAPSHOT_BUDGET_S"),
+        _m("hvdt_ckpt_superseded_total", "counter", (),
+           "Queued async snapshots superseded by a newer one"),
+        _m("hvdt_ckpt_write_failures_total", "counter", (),
+           "Async checkpoint writes that failed (logged, never raised)"),
+        # -- peer snapshot tier (resilience/peer_store.py) --
+        _m("hvdt_peer_restore_total", "counter", (),
+           "Recoveries served from the peer-replicated RAM tier"),
+        _m("hvdt_peer_commit_total", "counter", (),
+           "Commit-point snapshot publications to the peer tier"),
+        _m("hvdt_peer_miss_total", "counter", (),
+           "Peer-tier restore attempts that fell back to disk"),
+        _m("hvdt_peer_replica_bytes", "gauge", (),
+           "Host-RAM bytes holding peer snapshot replicas"),
+        # -- control plane (runner/http_kv.py, optimizer.py) --
+        _m("hvdt_kv_retries_total", "counter", (),
+           "Rendezvous-KV bootstrap-wait retries"),
+        _m("hvdt_kv_errors_total", "counter", ("op",),
+           "Rendezvous-KV client op failures by op"),
+        _m("hvdt_distributed_optimizer_builds_total", "counter", (),
+           "DistributedOptimizer/GradientTransformation constructions"),
+        # -- serving router (serve/router.py) --
+        _m("hvdt_router_requests_total", "counter", (),
+           "Requests admitted by the serving router front tier"),
+        _m("hvdt_router_request_latency_ms", "summary", (),
+           "Router end-to-end /predict latency (ms)"),
+        _m("hvdt_router_upstream_latency_ms", "summary", (),
+           "Router upstream (replica) dispatch latency (ms)"),
+        _m("hvdt_router_retries_total", "counter", (),
+           "Wire-death retries dispatched to another replica"),
+        _m("hvdt_router_hedges_total", "counter", (),
+           "Hedge requests issued past the hedge threshold"),
+        _m("hvdt_router_hedge_wins_total", "counter", (),
+           "Hedge requests that answered before the primary"),
+        _m("hvdt_router_ejections_total", "counter", ("reason",),
+           "Replica ejections by reason (probe | slo | dispatch)"),
+        _m("hvdt_router_readmissions_total", "counter", (),
+           "Ejected replicas re-admitted after a fresh heartbeat"),
+        _m("hvdt_router_no_replica_total", "counter", (),
+           "Requests that found no live replica"),
+        _m("hvdt_router_inflight", "gauge", (),
+           "Requests currently in flight through the router"),
+        _m("hvdt_router_replicas_live", "gauge", (),
+           "Live replicas the router currently sees"),
+        # -- serving plane (serve/*) --
+        _m("serve_queue_depth", "gauge", (),
+           "Rows queued but not yet dispatched (live probe)"),
+        _m("serve_requests_total", "counter", (),
+           "Rows admitted to the dynamic batcher"),
+        _m("serve_rejected_total", "counter", (),
+           "Rows shed at the admission bound (HTTP 503)"),
+        _m("serve_batches_total", "counter", (),
+           "Batches dispatched by the batcher"),
+        _m("serve_deadline_expired_total", "counter", (),
+           "Requests failed by the per-request deadline watchdog"),
+        _m("serve_queue_wait_seconds", "summary", (),
+           "Row wait from admission to dispatch"),
+        _m("serve_batch_fill", "summary", (),
+           "Dispatched batch rows / max_batch_size"),
+        _m("serve_compiles_total", "counter", (),
+           "Engine jit compiles (flat in steady state)"),
+        _m("serve_engine_batches_total", "counter", (),
+           "Batches executed by the inference engine"),
+        _m("serve_pad_rows_total", "counter", (),
+           "Pad rows added to reach the shape bucket"),
+        _m("serve_http_responses_total", "counter", ("route", "status"),
+           "HTTP responses by route and status"),
+        _m("serve_request_latency_ms_*", "summary", (),
+           "End-to-end handler latency per route "
+           "(serve_request_latency_ms_<route>)"),
+        _m("serve_draining", "gauge", (),
+           "1 while the server drains (admission closed)"),
+        _m("serve_reloads_total", "counter", (),
+           "Hot weight reloads applied"),
+        _m("serve_reload_failures_total", "counter", (),
+           "Failed reload attempts (kept serving)"),
+        _m("serve_skipped_unverified_total", "counter", (),
+           "Checkpoint steps skipped by manifest verification"),
+        _m("serve_checkpoint_step", "gauge", (),
+           "Checkpoint step currently served"),
+        _m("serve_last_good_step", "gauge", (),
+           "Newest verified checkpoint step seen by the watcher"),
+    ]
+}
+
+
+def declared_metric(name: str) -> bool:
+    """Whether a metric name is declared in the CATALOG (exact match, or
+    covered by a `prefix*` wildcard family)."""
+    if name in CATALOG:
+        return True
+    for spec_name in CATALOG:
+        if spec_name.endswith("*") and name.startswith(spec_name[:-1]):
+            return True
+    return False

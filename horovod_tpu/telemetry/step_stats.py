@@ -25,6 +25,7 @@ registry as live probes, so one scrape tells the whole recovery story.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 import time
 from typing import Dict, Optional
@@ -34,7 +35,11 @@ from .metrics import Gauge, MetricsRegistry, default_registry
 __all__ = ["StepTimer", "GoodputLedger", "peak_flops_for",
            "bind_resilience_gauges", "record_memory_accounting",
            "tree_bytes", "PEAK_BY_DEVICE_KIND", "RECOVERY_PHASES",
-           "recovery_ledger", "reset_recovery_ledger"]
+           "recovery_ledger", "reset_recovery_ledger",
+           "PerfExpectation", "DeviationTracker", "get_deviation_tracker",
+           "publish_expected_schedule_cost",
+           "maybe_publish_expected_cost", "reset_expectation",
+           "expected_vs_observed_doc"]
 
 # bf16 peak FLOP/s and HBM byte/s by TPU generation (device_kind
 # substring, lowercase) — promoted from bench.py so MFU math has one
@@ -146,6 +151,18 @@ class StepTimer:
                     self.flops_per_step / (ewma * self.peak_flops))
         if self.straggler is not None:
             self.straggler.observe(s)
+        # Live perf attribution: the deviation tracker keeps
+        # hvdt_perf_deviation_ratio current against the cost-model
+        # prediction, and the history layer records the time-series
+        # sample (both are None-when-off — one module lookup each).
+        tracker = get_deviation_tracker()
+        if tracker is not None:
+            tracker.observe(s)
+        from . import history as _history
+
+        h = _history.get_history()
+        if h is not None:
+            h.observe_step(self._summary.count, s)
 
     @property
     def count(self) -> int:
@@ -440,3 +457,252 @@ def record_memory_accounting(param_bytes: Optional[float] = None,
         reg.gauge("hvdt_optimizer_state_bytes",
                   _MEMORY_GAUGE_DOCS["hvdt_optimizer_state_bytes"]).set(
                       float(optimizer_state_bytes))
+
+
+# ---------------------------------------------------------------------------
+# Predicted-vs-observed perf attribution (the runtime mirror of the CI
+# --perf ratchet): price the expected schedule fingerprint with the
+# analytical cost model at init, then track observed step time against
+# the prediction live.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PerfExpectation:
+    """The cost model's per-step prediction for this run.
+
+    ``comm_exposed_s`` is the predicted NON-overlapped communication
+    seconds (the number the CI perf baseline ratchets);
+    ``wire_bytes_by_axis`` the predicted per-tier wire bytes per step;
+    ``compute_s`` the device-peak compute seconds when the caller's
+    flops and the device generation are both known (None on CPU sims —
+    the deviation tracker then calibrates a compute anchor from the
+    first observed steps instead)."""
+
+    comm_exposed_s: float
+    wire_bytes_by_axis: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    compute_s: Optional[float] = None
+    label: str = ""
+    source: str = ""
+
+
+class DeviationTracker:
+    """Maintains ``hvdt_perf_deviation_ratio``: observed EWMA step
+    seconds over predicted step seconds.
+
+    Predicted step seconds = predicted exposed comm + a compute anchor.
+    The anchor is the expectation's device-peak compute time when
+    known; otherwise it is **calibrated** from the median of the first
+    ``calibration_steps`` observed steps minus the predicted comm (so
+    the ratio reads 1.0 at calibration and any later slowdown —
+    a straggling link, a throttled host, a policy regression — moves it
+    off 1.0 in proportion).  The ratio is NaN until calibrated."""
+
+    def __init__(self, expectation: PerfExpectation,
+                 registry: Optional[MetricsRegistry] = None,
+                 calibration_steps: int = 4, ewma_alpha: float = 0.3):
+        reg = registry if registry is not None else default_registry()
+        self.expectation = expectation
+        self.calibration_steps = max(1, int(calibration_steps))
+        self._alpha = float(ewma_alpha)
+        self._lock = threading.Lock()
+        self._warmup: list = []
+        self._anchor: Optional[float] = expectation.compute_s
+        self._ewma: Optional[float] = None
+        self._gauge = reg.gauge(
+            "hvdt_perf_deviation_ratio",
+            "Observed EWMA step seconds / predicted step seconds "
+            "(predicted exposed comm + compute anchor); the "
+            "perf_deviation anomaly fires past "
+            "HVDT_PERF_DEVIATION_RATIO")
+        self._gauge.set(float("nan"))
+
+    def observe(self, step_seconds: float) -> Optional[float]:
+        """Feed one observed step; returns the current ratio (None
+        while calibrating)."""
+        s = float(step_seconds)
+        with self._lock:
+            if self._anchor is None:
+                self._warmup.append(s)
+                if len(self._warmup) < self.calibration_steps:
+                    return None
+                ordered = sorted(self._warmup)
+                median = ordered[(len(ordered) - 1) // 2]
+                self._anchor = max(
+                    0.0, median - self.expectation.comm_exposed_s)
+            self._ewma = s if self._ewma is None else (
+                self._alpha * s + (1.0 - self._alpha) * self._ewma)
+            predicted = self._anchor + self.expectation.comm_exposed_s
+            if predicted <= 0:
+                return None
+            ratio = self._ewma / predicted
+        self._gauge.set(ratio)
+        return ratio
+
+    def ratio(self) -> Optional[float]:
+        with self._lock:
+            if self._ewma is None or self._anchor is None:
+                return None
+            predicted = self._anchor + self.expectation.comm_exposed_s
+            return self._ewma / predicted if predicted > 0 else None
+
+    def observed_comm_s(self) -> Optional[float]:
+        """Observed comm-exposed seconds: EWMA step time minus the
+        compute anchor (what the prediction says compute costs)."""
+        with self._lock:
+            if self._ewma is None or self._anchor is None:
+                return None
+            return max(0.0, self._ewma - self._anchor)
+
+
+_expect_lock = threading.Lock()
+_expectation: Optional[PerfExpectation] = None
+_deviation: Optional[DeviationTracker] = None
+
+
+def get_expectation() -> Optional[PerfExpectation]:
+    return _expectation
+
+
+def get_deviation_tracker() -> Optional[DeviationTracker]:
+    """The process-wide deviation tracker, or None when no expectation
+    was published (the zero-overhead off path is one global read)."""
+    return _deviation
+
+
+def reset_expectation() -> None:
+    """Drop the published expectation + tracker (test isolation; pairs
+    with metrics.reset_default_registry)."""
+    global _expectation, _deviation
+    with _expect_lock:
+        _expectation = None
+        _deviation = None
+
+
+def publish_expected_schedule_cost(
+        fingerprint_path: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        device_kind: Optional[str] = None,
+        flops_per_step: Optional[float] = None
+        ) -> Optional[PerfExpectation]:
+    """Price the expected schedule fingerprint with the fitted cost
+    model on the ambient topology and publish the prediction:
+
+    * ``hvdt_expected_step_comm_seconds`` — predicted exposed comm s;
+    * ``hvdt_expected_wire_bytes{axis}`` — predicted per-tier wire
+      bytes per step;
+    * arms the process-wide :class:`DeviationTracker` so the StepTimer
+      stream keeps ``hvdt_perf_deviation_ratio`` live.
+
+    The fingerprint comes from ``fingerprint_path`` or the
+    ``HVDT_EXPECTED_SCHEDULE`` knob (an in-process
+    ``ScheduleFingerprint`` instance is also accepted via
+    ``fingerprint_path``).  Returns None (and publishes nothing) when
+    no fingerprint is available.  Raises on an unreadable file — use
+    :func:`maybe_publish_expected_cost` from init paths."""
+    from ..analysis import costmodel as _cm
+    from ..analysis import schedule as _sched
+    from ..analysis.topology import TopologySpec
+    from ..common import config as _config
+
+    global _expectation, _deviation
+    fp = None
+    source = ""
+    if fingerprint_path is not None and not isinstance(
+            fingerprint_path, str):
+        fp = fingerprint_path            # an in-process fingerprint
+        source = "in-process"
+    else:
+        path = (fingerprint_path
+                or _config.get_str("HVDT_EXPECTED_SCHEDULE")).strip()
+        if not path:
+            return None
+        fp = _sched.load_fingerprint(path)
+        source = path
+    topo = TopologySpec.from_env()
+    cost = _cm.CostModel().evaluate(fp, topo)
+    compute_s = None
+    if device_kind and flops_per_step:
+        peak, _ = peak_flops_for(device_kind)
+        if peak:
+            compute_s = float(flops_per_step) / peak
+    exp = PerfExpectation(
+        comm_exposed_s=float(cost.exposed_comm_s),
+        wire_bytes_by_axis={k: int(v) for k, v in
+                            sorted(cost.wire_bytes_by_axis.items())},
+        compute_s=compute_s, label=fp.label or "step", source=source)
+    reg = registry if registry is not None else default_registry()
+    reg.gauge(
+        "hvdt_expected_step_comm_seconds",
+        "Cost-model-predicted exposed (non-overlapped) communication "
+        "seconds per step for the expected schedule fingerprint on "
+        "the ambient topology").set(exp.comm_exposed_s)
+    wire_gauge = reg.gauge(
+        "hvdt_expected_wire_bytes",
+        "Cost-model-predicted wire bytes per step per transport tier "
+        "for the expected schedule fingerprint")
+    for axis in sorted(exp.wire_bytes_by_axis):
+        wire_gauge.set(exp.wire_bytes_by_axis[axis], axis=axis)
+    with _expect_lock:
+        _expectation = exp
+        _deviation = DeviationTracker(exp, registry=reg)
+    return exp
+
+
+def maybe_publish_expected_cost(**kwargs) -> Optional[PerfExpectation]:
+    """The ``hvd.init()`` hook: publish the predicted-vs-observed feed
+    iff telemetry is on and an expected schedule is configured.  Never
+    raises — a bad fingerprint path must not sink init."""
+    from . import instrument
+    from ..common.logging_util import get_logger
+
+    if not instrument.enabled():
+        return None
+    try:
+        exp = publish_expected_schedule_cost(**kwargs)
+    except Exception as e:
+        get_logger(__name__).warning(
+            "expected-schedule pricing failed (HVDT_EXPECTED_SCHEDULE): "
+            "%s", e)
+        return None
+    if exp is not None:
+        get_logger(__name__).info(
+            "expected schedule %s priced: exposed comm %.1fus, wire %s",
+            exp.label, exp.comm_exposed_s * 1e6,
+            exp.wire_bytes_by_axis)
+    return exp
+
+
+def expected_vs_observed_doc(registry: Optional[MetricsRegistry] = None
+                             ) -> Optional[Dict[str, object]]:
+    """The compact predicted-vs-observed roll-up bench.py embeds in its
+    telemetry JSON: predicted comm seconds, observed comm-exposed
+    seconds, the deviation ratio, and per-kind anomaly counts.  None
+    when no expectation was published."""
+    exp = get_expectation()
+    if exp is None:
+        return None
+    tracker = get_deviation_tracker()
+    reg = registry if registry is not None else default_registry()
+    anomaly_counts: Dict[str, float] = {}
+    c = reg.get("hvdt_anomaly_total")
+    if c is not None:
+        for labels, v in c.items():
+            kind = labels.get("kind", "")
+            if kind:
+                anomaly_counts[kind] = anomaly_counts.get(kind, 0) + v
+    ratio = tracker.ratio() if tracker is not None else None
+    observed = tracker.observed_comm_s() if tracker is not None else None
+    return {
+        "predicted_comm_s": round(exp.comm_exposed_s, 9),
+        "predicted_wire_bytes_by_axis": dict(exp.wire_bytes_by_axis),
+        "observed_comm_s": (round(observed, 6)
+                            if observed is not None else None),
+        "deviation_ratio": (round(ratio, 4)
+                            if ratio is not None else None),
+        "anomaly_counts": {k: int(v) for k, v in
+                           sorted(anomaly_counts.items())},
+        "fingerprint": exp.label,
+        "source": exp.source,
+    }

@@ -1,0 +1,68 @@
+"""Live-attribution scenario worker for tests/test_attribution.py.
+
+Each rank runs a fixed-cadence step loop under full attribution
+telemetry (HVDT_TELEMETRY + HVDT_HISTORY + HVDT_EVENT_LOG +
+HVDT_EXPECTED_SCHEDULE): a StepTimer feeds the time-series/deviation
+stream, and after every step the rank publishes its telemetry snapshot
+(with the time-series tail) to the rendezvous KV — exactly what the
+exporter's publish loop does, just step-synchronous so the test is
+deterministic.  A ``hang@step=N:rank=R:secs=S`` fault plan wedges one
+rank inside its timed step region, which is the shape of a throttled
+host / slow link: that rank's step series level-shifts and its
+perf-deviation ratio blows past HVDT_PERF_DEVIATION_RATIO, while the
+other rank stays flat.  The test process plays the driver: it collects
+the KV snapshots, runs the ClusterAnomalyMonitor, and asserts the
+JSONL event log names the right rank/pod exactly once.
+
+(KV-heartbeat coupling, no collectives — the container's CPU jax cannot
+run multiprocess XLA; same pattern as desync_main.py.)
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from horovod_tpu.resilience import faults  # noqa: E402
+from horovod_tpu.runner.http_kv import KVClient  # noqa: E402
+from horovod_tpu.telemetry import exporter as texp  # noqa: E402
+from horovod_tpu.telemetry import history as thistory  # noqa: E402
+from horovod_tpu.telemetry import step_stats as tstats  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HVDT_RANK"])
+    steps = int(os.environ.get("ATTR_TEST_STEPS", "14"))
+    base_s = float(os.environ.get("ATTR_TEST_STEP_S", "0.05"))
+
+    kv = KVClient.from_env()
+    assert thistory.get_history() is not None, \
+        "HVDT_HISTORY must be on for this scenario"
+    exp = tstats.maybe_publish_expected_cost()
+    assert exp is not None, \
+        "HVDT_EXPECTED_SCHEDULE pricing must succeed"
+    inj = faults.get_injector()
+    timer = tstats.StepTimer(examples_per_step=1)
+
+    for step in range(1, steps + 1):
+        t0 = time.monotonic()
+        if inj is not None:
+            inj.fire("step", step=step)   # the hang sleeps HERE, timed
+        # the "work": a fixed-cadence sleep stands in for compute
+        time.sleep(base_s)
+        timer.observe(time.monotonic() - t0)
+        doc = texp.snapshot_dict()
+        kv.put(f"{texp.KV_PREFIX}{rank}", json.dumps(doc).encode())
+        kv.put(f"/hb/{rank}", str(step).encode())
+
+    tracker = tstats.get_deviation_tracker()
+    ratio = tracker.ratio() if tracker is not None else None
+    print(f"attr: rank {rank} done, deviation ratio "
+          f"{ratio if ratio is None else round(ratio, 3)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,7 +51,7 @@ def _save_last_good(line: str) -> None:
                 or d.get("fault_plan") or d.get("telemetry") \
                 or d.get("overlap") or d.get("transport") \
                 or d.get("zero_stage") or d.get("remat") \
-                or d.get("checkpoint_stall_ms"):
+                or d.get("fp8") or d.get("checkpoint_stall_ms"):
             # A/B probe variants, chaos runs, and telemetry-instrumented
             # runs are not the headline metric — caching one would
             # contaminate the outage-fallback evidence (telemetry adds
@@ -114,6 +114,11 @@ def _parse_args(argv=None):
                          "(overlap_fraction / overlap_schedule).  Kept "
                          "out of the last-good headline cache until a "
                          "real TPU run lands.")
+    ap.add_argument("--fp8", action="store_true",
+                    help="benchmark with the fp8 (e4m3) matmul gate on "
+                         "(HVDT_FP8=matmul, quant/fp8.py) and emit the "
+                         "probe/microbench evidence in the JSON — rides "
+                         "outside the last-good cache")
     ap.add_argument("--transport", default="",
                     help="A/B leg: run the train step under an "
                          "HVDT_TRANSPORT policy (horovod_tpu/transport) "
@@ -315,6 +320,13 @@ def _run_child(args) -> None:
                               str(8 * 1024 * 1024))
     if args.remat:
         os.environ.setdefault("HVDT_REMAT", args.remat)
+    if args.fp8:
+        # fp8 leg: flip the compute gate for anything matmul-shaped in
+        # the step (quant/fp8.py; the ResNet conv stack itself is
+        # unaffected — the leg's JSON carries the gate/probe state and
+        # a standalone convert-dot microbench as the evidence).
+        os.environ["HVDT_FP8"] = "matmul"
+        os.environ.setdefault("HVDT_TELEMETRY", "1")
 
     dev = jax.devices()[0]
     print(f"benchmarking on {dev.platform}:{dev.device_kind}"
@@ -700,6 +712,7 @@ def _run_child(args) -> None:
         **(_zero_doc(args, zero_tx, params, opt_state) if args.zero
            else {}),
         **({"remat": args.remat} if args.remat else {}),
+        **(_fp8_doc() if args.fp8 else {}),
         **(_ckpt_stall_doc(params) if args.ckpt_stall else {}),
         **({"fused_optimizer": True} if args.fused_optimizer else {}),
         **({"steps_per_call": args.steps_per_call}
@@ -786,6 +799,55 @@ def _transport_doc(spec: str) -> dict:
     pol = get_policy()
     doc = {"transport": spec,
            "transport_policy": pol.describe() if pol else None}
+    rec = get_recorder()
+    if rec is not None:
+        try:
+            wb = rec.registry.get("hvdt_wire_bytes_total")
+            if wb is not None:
+                doc["wire_bytes_by_axis"] = {
+                    ",".join(f"{k}={v}" for k, v in key): val
+                    for key, val in sorted(wb._values.items())}
+        except Exception:
+            pass
+    return doc
+
+
+def _fp8_doc() -> dict:
+    """The --fp8 leg's JSON fields: the gate/probe state, whether the
+    lowered HLO really carries the f8e4m3 convert-dot, and a matmul
+    microbench (fp8 vs plain bf16) — the compute-side analog of the
+    wire-byte evidence.  Also snapshots the per-axis wire-byte counters
+    when telemetry ran (fp8 legs usually ride a transport config).
+    Rides outside the last-good headline cache (see _save_last_good)."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.quant import fp8 as _f8
+    from horovod_tpu.telemetry.instrument import get_recorder
+
+    doc = {"fp8": {"mode": _f8.fp8_mode(),
+                   "available": _f8.fp8_available(),
+                   "engaged": _f8.matmul_enabled()}}
+    try:
+        k = 1024
+        x = jnp.ones((k, k), jnp.bfloat16)
+        w = jnp.ones((k, k), jnp.float32)
+        f_fp8 = jax.jit(lambda a, b: _f8.fp8_matmul(a, b))
+        f_ref = jax.jit(lambda a, b: a @ b.astype(a.dtype))
+        doc["fp8"]["hlo_has_f8"] = (
+            "f8e4m3" in f_fp8.lower(x, w).compile().as_text())
+        for f, key in ((f_fp8, "fp8_matmul_us"),
+                       (f_ref, "bf16_matmul_us")):
+            jax.block_until_ready(f(x, w))
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(10):
+                out = f(x, w)
+            jax.block_until_ready(out)
+            doc["fp8"][key] = round(
+                (time.perf_counter() - t0) / 10 * 1e6, 1)
+    except Exception as e:  # the probe must never sink the bench
+        print(f"fp8 microbench failed: {e!r}", file=sys.stderr)
     rec = get_recorder()
     if rec is not None:
         try:
@@ -925,6 +987,7 @@ def main() -> None:
         + (["--transport", args.transport] if args.transport else []) \
         + (["--zero", args.zero] if args.zero else []) \
         + (["--remat", args.remat] if args.remat else []) \
+        + (["--fp8"] if args.fp8 else []) \
         + (["--ckpt-stall"] if args.ckpt_stall else []) \
         + (["--report"] if args.report else [])
 

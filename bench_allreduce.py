@@ -19,10 +19,11 @@ Paths measured:
   (``hvd.allreduce``), measuring the full controller+data-plane
   round trip per op (the reference's per-op latency analog).
 
-``--wire {f32,bf16,fp16,int8}`` selects the wire format of the jit leg:
+``--wire {f32,bf16,fp16,int8,int4}`` selects the wire format of the jit leg:
 dtype casts around the psum for bf16/fp16 (``Compression.bf16/.fp16``),
-or the block-scaled quantized two-stage collective for int8
-(``Compression.int8`` — horovod_tpu/quant).  Non-f32 wires also time
+or the block-scaled quantized two-stage collective for int8/int4
+(``Compression.int8`` / ``.int4`` — horovod_tpu/quant; int4 packs two
+4-bit lanes per byte on the wire).  Non-f32 wires also time
 the f32 leg and report ``speedup_vs_f32``; ``--json-out FILE`` writes
 the sweep (bytes_on_wire, GB/s, speedup) as a JSON result file for the
 BENCH trajectory, like bench.py does.
@@ -86,6 +87,10 @@ def wire_payload_bytes(count: int, dtype, wire: str) -> int:
         from horovod_tpu.quant import wire_bytes
 
         return wire_bytes(count)
+    if wire == "int4":
+        from horovod_tpu.quant import wire_bytes_int4
+
+        return wire_bytes_int4(count)
     return count * jnp.dtype(dtype).itemsize
 
 
@@ -111,13 +116,13 @@ def bench_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
         # the 1/n rescale keeps values bounded AND makes each iteration
         # depend on the last (no overlap/elision).
         def one(_, acc):
-            if wire == "int8":
+            if wire in ("int8", "int4"):
                 from horovod_tpu.common.types import ReduceOp
                 from horovod_tpu.quant import quantized_allreduce_flat
 
                 red = quantized_allreduce_flat(
                     acc.reshape(-1), "dp",
-                    op=ReduceOp.AVERAGE).reshape(acc.shape)
+                    op=ReduceOp.AVERAGE, wire=wire).reshape(acc.shape)
             else:
                 w = acc.astype(cast_to) if cast_to is not None else acc
                 red = (lax.psum(w, "dp") * (1.0 / n)).astype(acc.dtype)
@@ -396,6 +401,10 @@ def _run_hierarchical(args) -> None:
             * (n_ici - 1) // n_ici
         if res.slow.wire == "int8":
             dcn_wire = int(q_wire_bytes(shard))
+        elif res.slow.wire == "int4":
+            from horovod_tpu.quant import wire_bytes_int4 as q_wire4
+
+            dcn_wire = int(q_wire4(shard))
         else:
             dcn_wire = 2 * shard * _wire_item(res.slow.wire) \
                 * (n_dcn - 1) // max(1, n_dcn)
@@ -533,9 +542,10 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--eager", action="store_true",
                     help="also measure the negotiated eager path")
-    ap.add_argument("--wire", choices=("f32", "bf16", "fp16", "int8"),
+    ap.add_argument("--wire",
+                    choices=("f32", "bf16", "fp16", "int8", "int4"),
                     default="f32",
-                    help="wire format for the jit leg (int8 = the "
+                    help="wire format for the jit leg (int8/int4 = the "
                          "block-scaled quantized collective, "
                          "horovod_tpu/quant; non-f32 also times the "
                          "f32 leg for speedup_vs_f32)")

@@ -5,7 +5,8 @@
 #
 #   ci/gen-matrix.sh --smoke   emit only the fast smoke service
 #       (compileall + optimizer-kernel + serving-subsystem +
-#       quantized-collective + resilience-chaos + telemetry +
+#       quantized-collective + sub-byte-wire/fp8-lowbit +
+#       resilience-chaos + telemetry +
 #       tracing/flight-recorder-forensics + overlap-scheduling +
 #       transport-policy/hierarchical-collective +
 #       zero-sharding/reduce-scatter-wire +

@@ -97,10 +97,13 @@ PEAK_LITERAL_FLOOR = 1e11
 PEAK_LITERAL_CEIL = 1e16
 
 # Per-logical-byte quantize/dequantize fallback for compressed wires
-# (int8 block-scaled kernels run near HBM speed; bf16/fp16 casts are
-# cheaper still).  Fitted gamma from int8-wire bench rows overrides.
+# (block-scaled int8/int4 kernels run near HBM speed — the packed int4
+# wire pays the same per-element pass plus the nibble pack/unpack;
+# bf16/fp16 casts are cheaper still).  Fitted gamma from quantized-wire
+# bench rows overrides.
 DEFAULT_QUANT_GAMMA_S_PER_BYTE: Dict[str, float] = {
     "int8": 1.0 / 400.0e9,
+    "int4": 1.0 / 400.0e9,
     "bf16": 1.0 / 800.0e9,
     "fp16": 1.0 / 800.0e9,
 }

@@ -163,7 +163,9 @@ class ParameterManager:
     LOG2_BUCKET_CANDIDATES = tuple(range(20, 29))     # 1 MiB .. 256 MiB
     OVERLAP_CANDIDATES = (1, 2, 4)
     FUSED_OPTIMIZER_CANDIDATES = (0.0, 1.0)
-    QUANT_CANDIDATES = (0.0, 1.0)
+    # 0 = f32, 1 = int8, 2 = int4 (the quant_leg encoding): one knob
+    # column, three wire legs, all state-compatible hot-swaps.
+    QUANT_CANDIDATES = (0.0, 1.0, 2.0)
     OVERLAP_SCHEDULE_CANDIDATES = (0.0, 1.0)
     TRANSPORT_CANDIDATES = (0.0, 1.0)
     ZERO_CANDIDATES = (0.0, 1.0)
@@ -197,10 +199,11 @@ class ParameterManager:
         self.tune_fused = (
             tune_fused_optimizer if tune_fused_optimizer is not None
             else config.get_bool("HVDT_AUTOTUNE_FUSED_OPTIMIZER"))
-        # Optional fourth dimension: int8-vs-f32 gradient wire
-        # (horovod_tpu/quant) — comm bytes and step time trade against
-        # quantize/dequantize compute, so the GP prices the wire jointly
-        # with the bucketing it directly interacts with.
+        # Optional fourth dimension: the quantized gradient-wire leg
+        # (horovod_tpu/quant; f32/int8/int4) — comm bytes and step time
+        # trade against quantize/dequantize compute, so the GP prices
+        # the wire jointly with the bucketing it directly interacts
+        # with.
         self.tune_quant = (tune_quant if tune_quant is not None
                            else config.get_bool("HVDT_AUTOTUNE_QUANT"))
         # Optional fifth dimension: overlap-schedule on/off
@@ -270,7 +273,7 @@ class ParameterManager:
         if self.tune_fused:
             start.append(float(config.get_bool("HVDT_FUSED_OPTIMIZER")))
         if self.tune_quant:
-            start.append(float(_env_quant_wire()))
+            start.append(_LEG_VALUES[_env_quant_leg()])
         if self.tune_overlap:
             start.append(float(_env_overlap()))
         if self.tune_transport:
@@ -303,11 +306,22 @@ class ParameterManager:
 
     @property
     def quant_wire(self) -> bool:
-        """Current int8-vs-f32 wire choice; outside the tuned dimension
-        it reports the HVDT_QUANT / HVDT_COMPRESSION env default."""
+        """Current quantized-vs-f32 wire choice (any quantized leg);
+        outside the tuned dimension it reports the HVDT_QUANT /
+        HVDT_COMPRESSION env default."""
         if self.tune_quant:
             return bool(self._current[self._quant_col] >= 0.5)
         return _env_quant_wire()
+
+    @property
+    def quant_leg(self) -> str:
+        """Current wire leg by name — "f32", "int8" or "int4" (the
+        0/1/2 knob encoding); outside the tuned dimension it reports
+        the env default leg."""
+        if self.tune_quant:
+            v = float(self._current[self._quant_col])
+            return "int4" if v >= 1.5 else ("int8" if v >= 0.5 else "f32")
+        return _env_quant_leg()
 
     @property
     def overlap_schedule(self) -> bool:
@@ -413,19 +427,32 @@ def _model_seed(dim: str) -> Optional[bool]:
         return None
 
 
-def _env_quant_wire() -> bool:
-    """The environment's int8-wire default (the quant dimension's
-    starting leg): HVDT_QUANT, or HVDT_COMPRESSION=int8; with neither
-    set (and no explicit non-int8 compression choice), the cost model
-    may order the leg (HVDT_AUTOTUNE_MODEL_SEED)."""
-    if (config.get_bool("HVDT_QUANT")
-            or config.get_str("HVDT_COMPRESSION").strip().lower()
-            == "int8"):
-        return True
-    if config.get_str("HVDT_COMPRESSION").strip():
-        return False           # explicit non-int8 wire choice wins
+# quant_leg knob encoding (one GP column spanning three legs).
+_LEG_VALUES = {"f32": 0.0, "int8": 1.0, "int4": 2.0}
+
+
+def _env_quant_leg() -> str:
+    """The environment's quantized-wire default leg (the quant
+    dimension's starting point): HVDT_QUANT → int8,
+    HVDT_COMPRESSION=int8|int4 by name; with neither set (and no
+    explicit non-quantized compression choice), the cost model may
+    order the leg (HVDT_AUTOTUNE_MODEL_SEED — a True verdict starts at
+    int8, the conservative quantized leg)."""
+    if config.get_bool("HVDT_QUANT"):
+        return "int8"
+    comp = config.get_str("HVDT_COMPRESSION").strip().lower()
+    if comp in ("int8", "int4"):
+        return comp
+    if comp:
+        return "f32"           # explicit non-quantized wire choice wins
     ms = _model_seed("quant")
-    return bool(ms) if ms is not None else False
+    return "int8" if ms else "f32"
+
+
+def _env_quant_wire() -> bool:
+    """The environment's quantized-wire default as a bool (any
+    quantized leg; the legacy ``quant=`` builder keyword)."""
+    return _env_quant_leg() != "f32"
 
 
 def _env_overlap() -> bool:
@@ -582,7 +609,7 @@ class BenchmarkAutotuner:
         state = "converged" if self.done else "tuning"
         fused = (f" fused_opt={int(self.pm.fused_optimizer)}"
                  if self.pm.tune_fused else "")
-        quant = (f" wire={'int8' if self.pm.quant_wire else 'f32'}"
+        quant = (f" wire={self.pm.quant_leg}"
                  if self.pm.tune_quant else "")
         ovl = (f" schedule={'overlap' if self.pm.overlap_schedule else 'mono'}"
                if self.pm.tune_overlap else "")
@@ -633,14 +660,17 @@ class AutotunedStep:
     GP prices the update-side kernels jointly with the comm bucketing.
     Builders without the keyword keep the old call shape.
 
-    With ``HVDT_AUTOTUNE_QUANT=1`` the space likewise gains an
-    int8-vs-f32 *wire* dimension (horovod_tpu/quant): builders accepting
-    a ``quant`` keyword are rebuilt as ``builder(threshold_bytes,
-    quant=bool)`` — hot-swappable mid-run because both wire legs keep
-    one optimizer state tree (build the chain with
-    ``quant.with_error_feedback(..., enabled=quant)`` and switch
-    ``compression=`` between ``Compression.int8`` and
-    ``Compression.none``; tests/test_quant.py pins the contract).
+    With ``HVDT_AUTOTUNE_QUANT=1`` the space likewise gains a
+    quantized-*wire* leg dimension (horovod_tpu/quant; f32/int8/int4):
+    builders accepting a ``quant`` keyword are rebuilt as
+    ``builder(threshold_bytes, quant=bool)`` (any quantized leg →
+    True); builders accepting ``quant_leg`` additionally receive the
+    leg by name (``quant_leg="f32"|"int8"|"int4"``) and can pick the
+    matching ``Compression`` + ``with_error_feedback(wire=...)``.
+    Hot-swappable mid-run because every wire leg keeps one optimizer
+    state tree — the error-feedback residual is leg-independent f32
+    (``quant.with_error_feedback(enabled=..., wire=...)``;
+    tests/test_quant.py and tests/test_lowbit.py pin the contract).
 
     With ``HVDT_AUTOTUNE_OVERLAP=1`` the space gains an
     overlap-schedule on/off dimension (ops/overlap.py): builders
@@ -695,12 +725,14 @@ class AutotunedStep:
                          for p in sig.values())
             self._accepts_fused = "fused" in sig or var_kw
             self._accepts_quant = "quant" in sig or var_kw
+            self._accepts_quant_leg = "quant_leg" in sig or var_kw
             self._accepts_overlap = "overlap" in sig or var_kw
             self._accepts_transport = "transport" in sig or var_kw
             self._accepts_zero = "zero" in sig or var_kw
         except (TypeError, ValueError):
             self._accepts_fused = False
             self._accepts_quant = False
+            self._accepts_quant_leg = False
             self._accepts_overlap = False
             self._accepts_transport = False
             self._accepts_zero = False
@@ -716,6 +748,9 @@ class AutotunedStep:
         if (self.enabled and self._accepts_quant
                 and config.get_bool("HVDT_AUTOTUNE_QUANT")):
             build_kw["quant"] = _env_quant_wire()
+        if (self.enabled and self._accepts_quant_leg
+                and config.get_bool("HVDT_AUTOTUNE_QUANT")):
+            build_kw["quant_leg"] = _env_quant_leg()
         if (self.enabled and self._accepts_overlap
                 and config.get_bool("HVDT_AUTOTUNE_OVERLAP")):
             build_kw["overlap"] = _env_overlap()
@@ -757,6 +792,8 @@ class AutotunedStep:
             kw["fused"] = pm.fused_optimizer
         if pm.tune_quant and self._accepts_quant:
             kw["quant"] = pm.quant_wire
+        if pm.tune_quant and self._accepts_quant_leg:
+            kw["quant_leg"] = pm.quant_leg
         if pm.tune_overlap and self._accepts_overlap:
             kw["overlap"] = pm.overlap_schedule
         if pm.tune_transport and self._accepts_transport:

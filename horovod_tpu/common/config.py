@@ -606,7 +606,7 @@ KNOBS: Dict[str, Knob] = {
         # --- quantized wire (horovod_tpu/quant: block-scaled int8
         #     collectives with error feedback) ---
         _k("HVDT_COMPRESSION", "", str,
-           "Gradient wire compressor by name: none|bf16|fp16|int8 "
+           "Gradient wire compressor by name: none|bf16|fp16|int8|int4 "
            "(empty = none).  Consumed by hvd.init() and by "
            "DistributedOptimizer wrappers when compression= is unset; "
            "unknown names raise with the valid list.  The launcher "
@@ -617,22 +617,33 @@ KNOBS: Dict[str, Knob] = {
            "(quant/collectives two-stage quantized allreduce).  Pair "
            "with quant.with_error_feedback for f32-parity convergence."),
         _k("HVDT_QUANT_BLOCK", 256, int,
-           "Block size (elements) for int8 wire quantization: one f32 "
-           "absmax scale per block.  256 default = 1.6% scale overhead; "
-           "must be a multiple of 128 for the Pallas lowering (other "
-           "values fall back to identical-math XLA)."),
+           "Block size (elements) for int8/int4 wire quantization: one "
+           "f32 absmax scale per block.  256 default = 1.6% scale "
+           "overhead; must be a multiple of 128 for the int8 Pallas "
+           "lowering (256 for the packed-int4 one; other values fall "
+           "back to identical-math XLA)."),
         _k("HVDT_QUANT_KERNELS", "auto", str,
            "Quantize/dequantize lowering: auto (Pallas on TPU, XLA "
            "elsewhere), on (force Pallas — interpret mode off-TPU, the "
            "kernel-equivalence test path), off (XLA everywhere).  Both "
            "lowerings share the same block math."),
         _k("HVDT_AUTOTUNE_QUANT", False, _parse_bool,
-           "Add an int8-vs-f32 wire dimension (0/1) to the autotune "
-           "search space; the step builder is rebuilt with quant=... at "
-           "each knob change (autotune.AutotunedStep), hot-swappable "
-           "because both legs keep one optimizer state tree (see "
-           "quant.with_error_feedback(enabled=...)).  Starting point "
-           "comes from HVDT_QUANT / HVDT_COMPRESSION."),
+           "Add a quantized-wire leg dimension (f32/int8/int4) to the "
+           "autotune search space; the step builder is rebuilt with "
+           "quant=.../quant_leg=... at each knob change "
+           "(autotune.AutotunedStep), hot-swappable because all legs "
+           "keep one optimizer state tree (see "
+           "quant.with_error_feedback(enabled=...), whose residual is "
+           "leg-independent f32).  Starting point comes from "
+           "HVDT_QUANT / HVDT_COMPRESSION."),
+        _k("HVDT_FP8", "off", str,
+           "fp8 (e4m3) compute path: off (default) or matmul — route "
+           "the transformer MLP/attention-projection matmuls through "
+           "quant.fp8.fp8_matmul (per-tensor delayed-max scaling, f32 "
+           "accumulation).  A capability probe falls back to the plain "
+           "matmul when the installed jax/backend lacks working fp8 "
+           "dtypes, so 'matmul' is always safe to set; unknown values "
+           "raise with the valid list."),
     ]
 }
 

@@ -3,7 +3,10 @@
 TPU-first design decisions:
 
 * **bf16 compute, f32 params/accumulation** — MXU-native (SURVEY.md §6's
-  per-chip throughput target is set by MXU utilization).
+  per-chip throughput target is set by MXU utilization).  With
+  ``HVDT_FP8=matmul`` the MLP and attention projections drop to
+  per-tensor-scaled e4m3 operands (quant/fp8.py) where the backend
+  supports the fp8 convert-dot; accumulation stays f32.
 * **RoPE** instead of learned positions — no position table to shard.
 * **Scan over layers** — one compiled block body regardless of depth
   (compile time O(1) in layers), standard XLA practice.
@@ -33,6 +36,7 @@ from jax import lax
 from ..parallel.moe import moe_dispatch_combine
 from ..parallel.pipeline import pipeline_spmd
 from ..parallel.ring_attention import ring_attention
+from ..quant import fp8 as _fp8
 
 __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
@@ -178,12 +182,23 @@ def _rope(x, positions, theta):
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
+def _proj(x, w):
+    """Dense projection ``x @ w`` in the activation dtype — rides the
+    per-tensor-scaled fp8 (e4m3) convert-dot when ``HVDT_FP8=matmul``
+    and the backend supports it (quant/fp8.py); otherwise exactly the
+    plain matmul.  The gate is resolved at trace time from env config,
+    so flipping HVDT_FP8 recompiles rather than branching in-graph."""
+    if _fp8.matmul_enabled():
+        return _fp8.fp8_matmul(x, w)
+    return x @ w.astype(x.dtype)
+
+
 def _attention(p, x, positions, cfg: TransformerConfig):
     b, l, d = x.shape
     h, hk, dh = cfg.heads, cfg.kv_heads, cfg.head_dim
-    q = (x @ p["wq"].astype(x.dtype)).reshape(b, l, h, dh)
-    k = (x @ p["wk"].astype(x.dtype)).reshape(b, l, hk, dh)
-    v = (x @ p["wv"].astype(x.dtype)).reshape(b, l, hk, dh)
+    q = _proj(x, p["wq"]).reshape(b, l, h, dh)
+    k = _proj(x, p["wk"]).reshape(b, l, hk, dh)
+    v = _proj(x, p["wv"]).reshape(b, l, hk, dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     flash_plan = None if cfg.sp > 1 else _flash_plan(b, l, h, hk, dh)
@@ -229,7 +244,7 @@ def _attention(p, x, positions, cfg: TransformerConfig):
         s = jnp.where(mask[None, None], s, -1e30)
         w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
         o = jnp.einsum("bhqk,bkhd->bqhd", w, v)
-    return o.reshape(b, l, h * dh) @ p["wo"].astype(x.dtype)
+    return _proj(o.reshape(b, l, h * dh), p["wo"])
 
 
 def _flash_enabled(seq_len: int, head_dim: int, *, batch: int = 1,
@@ -415,9 +430,9 @@ def _flash_plan(b: int, l: int, h: int, hk: int, dh: int):
 
 
 def _mlp(p, x):
-    up = x @ p["w_up"].astype(x.dtype)
-    gate = jax.nn.silu(x @ p["w_gate"].astype(x.dtype))
-    return (up * gate) @ p["w_down"].astype(x.dtype)
+    up = _proj(x, p["w_up"])
+    gate = jax.nn.silu(_proj(x, p["w_gate"]))
+    return _proj(up * gate, p["w_down"])
 
 
 def _moe_mlp(p, x, cfg: TransformerConfig):

@@ -12,12 +12,13 @@ fused collective (optimizer.py → fused_allreduce).  ``compress``/
 ``decompress`` mirror the reference's optimizer-level API for user code
 that wants explicit round-trip casts around eager ops.
 
-Beyond the reference: ``Compression.int8`` selects the block-scaled
-quantized wire (horovod_tpu/quant/ — EQuARX-style int8 payload + f32
-block scales, with the two-stage quantized collective on the jit path).
+Beyond the reference: ``Compression.int8`` / ``Compression.int4``
+select the block-scaled quantized wire (horovod_tpu/quant/ —
+EQuARX-style int8 or packed sub-byte int4 payload + f32 block scales,
+with the two-stage quantized collective on the jit path).
 Compressors are also selectable by NAME from the environment
-(``HVDT_COMPRESSION=none|bf16|fp16|int8``, or ``HVDT_QUANT=1`` as the
-int8 shorthand) via :meth:`Compression.from_env`, consumed by
+(``HVDT_COMPRESSION=none|bf16|fp16|int8|int4``, or ``HVDT_QUANT=1`` as
+the int8 shorthand) via :meth:`Compression.from_env`, consumed by
 ``hvd.init()`` and the optimizer wrappers when no explicit
 ``compression=`` is passed; the launcher forwards ``--compression``.
 """
@@ -29,7 +30,8 @@ from typing import Any, Optional, Tuple
 import numpy as np
 
 __all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
-           "BF16Compressor", "Int8Compressor", "Compression"]
+           "BF16Compressor", "Int8Compressor", "Int4Compressor",
+           "Compression"]
 
 
 class Compressor:
@@ -145,12 +147,16 @@ class Int8Compressor(Compressor):
         del ctx  # on-grid values ARE the decompressed representation
         return tensor
 
-    @staticmethod
-    def _np_quantize_dequantize(arr: np.ndarray) -> np.ndarray:
+    # Quantization grid: (divisor, clip) — int8's absmax/127 grid.
+    _GRID = (127.0, 127)
+
+    @classmethod
+    def _np_quantize_dequantize(cls, arr: np.ndarray) -> np.ndarray:
         """Numpy mirror of quant.kernels.quantize_dequantize (identical
         block math; np.rint and jnp.round are both round-half-even)."""
         from ..common import config
 
+        div, clip = cls._GRID
         block = config.get_int("HVDT_QUANT_BLOCK")
         block = block if block > 0 else 256
         shape, dtype = arr.shape, arr.dtype
@@ -159,14 +165,38 @@ class Int8Compressor(Compressor):
         if pad:
             flat = np.concatenate([flat, np.zeros(pad, np.float32)])
         x2 = flat.reshape(-1, block)
-        scale = np.max(np.abs(x2), axis=1, keepdims=True) * (1.0 / 127.0)
+        scale = np.max(np.abs(x2), axis=1, keepdims=True) * (1.0 / div)
         inv = np.where(scale > 0,
                        1.0 / np.where(scale > 0, scale, 1.0), 0.0)
-        q = np.clip(np.rint(x2 * inv), -127, 127)
+        q = np.clip(np.rint(x2 * inv), -clip, clip)
         out = (q * scale).reshape(-1)
         if pad:
             out = out[:-pad]
         return out.reshape(shape).astype(dtype)
+
+
+class Int4Compressor(Int8Compressor):
+    """Packed sub-byte int4 wire (two 4-bit lanes per byte,
+    absmax/7 block scales) — same contract as :class:`Int8Compressor`
+    with the coarser grid; pair with
+    ``quant.with_error_feedback(wire='int4')`` to carry the larger
+    rounding error forward.  jit path: ``wire_dtype`` is the
+    :data:`~..quant.collectives.INT4_WIRE` sentinel; host path snaps to
+    the int4 grid."""
+
+    wire_dtype = "int4_blockwise"   # == quant.collectives.INT4_WIRE
+    _GRID = (7.0, 7)
+
+    @classmethod
+    def compress(cls, tensor) -> Tuple[Any, Any]:
+        dtype = getattr(tensor, "dtype", None)
+        if dtype is None or np.dtype(dtype).kind != "f":
+            return tensor, None
+        if type(tensor).__module__.startswith("jax"):
+            from ..quant import kernels as _qk
+
+            return _qk.quantize_dequantize_int4(tensor), None
+        return cls._np_quantize_dequantize(np.asarray(tensor)), None
 
 
 class Compression:
@@ -176,9 +206,11 @@ class Compression:
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    int4 = Int4Compressor
 
     _BY_NAME = {"none": NoneCompressor, "fp16": FP16Compressor,
-                "bf16": BF16Compressor, "int8": Int8Compressor}
+                "bf16": BF16Compressor, "int8": Int8Compressor,
+                "int4": Int4Compressor}
 
     @classmethod
     def by_name(cls, name: str) -> type:

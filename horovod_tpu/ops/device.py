@@ -483,11 +483,12 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
 
     ``wire_dtype`` optionally casts buckets for the reduction (bf16 wire
     compression — ref: tensorflow/compression.py:141) and casts back.
-    The sentinel ``"int8_blockwise"`` (``Compression.int8.wire_dtype``,
-    == quant.collectives.INT8_WIRE) instead routes each float bucket
-    through the two-stage block-scaled quantized allreduce — real int8
-    payloads on the wire, f32 accumulation in the middle; non-float
-    buckets keep the exact path.
+    The sentinels ``"int8_blockwise"`` / ``"int4_blockwise"``
+    (``Compression.int8`` / ``.int4`` ``wire_dtype``, ==
+    quant.collectives INT8_WIRE/INT4_WIRE) instead route each float
+    bucket through the two-stage block-scaled quantized allreduce —
+    real int8 (or packed int4) payloads on the wire, f32 accumulation
+    in the middle; non-float buckets keep the exact path.
 
     Transport policies (``HVDT_TRANSPORT``, horovod_tpu/transport): when
     the active policy resolves ``axis``, float SUM/AVERAGE buckets route
@@ -510,10 +511,13 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
         # policy's exact-name / ici-class entry); an explicit caller
         # wire (Compression) keeps precedence.
         wire_dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16,
-                      "int8": "int8_blockwise"}.get(_res.fast.wire)
+                      "int8": "int8_blockwise",
+                      "int4": "int4_blockwise"}.get(_res.fast.wire)
 
-    quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
-        "int8", "int8_blockwise")
+    from ..quant.collectives import quant_wire_leg as _qleg
+
+    quant_leg = _qleg(wire_dtype)
+    quant_wire = quant_leg is not None
     if quant_wire:
         wire_dtype = None  # the quantized path owns the wire format
     hier = (_res is not None and _res.kind == "hierarchical"
@@ -589,7 +593,8 @@ def fused_allreduce(tree, axis: AxisName = "dp", op: ReduceOp = ReduceOp.AVERAGE
                 red = quantized_allreduce_flat(
                     flat, axis, op=op,
                     prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor)
+                    postscale_factor=postscale_factor,
+                    wire=quant_leg)
             else:
                 red = allreduce(flat, axis, op, prescale_factor,
                                 postscale_factor)

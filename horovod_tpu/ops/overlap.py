@@ -238,6 +238,10 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
                      leaf_finish=None):
     """Core dependency-ordered exchange over a flat leaf list.
 
+    ``quant_wire`` names the quantized leg ("int8" / "int4") or is
+    falsy for exact/cast wires (a bare ``True`` means int8, the legacy
+    bool spelling).
+
     Returns ``(cells, token)`` where ``cells[i]`` is the reduced leaf
     (or whatever ``leaf_finish(i, reduced_leaf, pin)`` returned) and
     ``token`` is the last bucket's payload token — thread it into the
@@ -261,6 +265,8 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
 
     from ..telemetry import instrument as _ti
     from ..transport import policy as _tpolicy
+
+    quant_leg = "int8" if quant_wire is True else (quant_wire or None)
 
     rec = _ti.get_recorder()
     _res = _tpolicy.resolve_axis(axis)
@@ -290,7 +296,8 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
             flat, _ = lax.optimization_barrier((flat, token))
         token = _payload_token(flat)
         nbytes = int(flat.size) * jnp.dtype(flat.dtype).itemsize
-        quant_bucket = quant_wire and float_bucket and not hier_bucket
+        quant_bucket = (quant_leg is not None and float_bucket
+                        and not hier_bucket)
         if hier_bucket:
             from ..transport import hierarchy as _th
 
@@ -300,7 +307,9 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
         elif quant_bucket:
             from ..quant import kernels as _qk
 
-            bucket_bytes.append(int(_qk.wire_bytes(
+            _wb = (_qk.wire_bytes_int4 if quant_leg == "int4"
+                   else _qk.wire_bytes)
+            bucket_bytes.append(int(_wb(
                 int(flat.size), _qk.quant_block_size())))
         else:
             bucket_bytes.append(nbytes)
@@ -322,7 +331,8 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
                 from ..quant import collectives as qc
 
                 state = qc.quantized_allreduce_start(
-                    flat, axis, op=op, prescale_factor=prescale_factor)
+                    flat, axis, op=op, prescale_factor=prescale_factor,
+                    wire=quant_leg)
                 kind = "quant"
             else:
                 state = dev.allreduce(flat, axis, op, prescale_factor,
@@ -330,9 +340,12 @@ def _exchange_leaves(leaves, axis, op, threshold_bytes, prescale_factor,
                 kind = "plain"
         issued.append((bucket, shapes, sizes, orig_dtype, kind, state, flat))
 
+    from ..quant.collectives import wire_sentinel as _sentinel
+
     _account(bucket_bytes,
              wire=("hierarchical" if hier
-                   else "int8_blockwise" if quant_wire else "exact"))
+                   else _sentinel(quant_leg) if quant_leg is not None
+                   else "exact"))
 
     cells: List[Any] = [None] * len(leaves)
     for k, (bucket, shapes, sizes, orig_dtype, kind, state, _payload) \
@@ -393,11 +406,12 @@ class OverlapScheduler:
         established block-scale/2 bound per stage."""
         from ..transport import policy as _tpolicy
 
+        from ..quant.collectives import quant_wire_leg as _qleg
+
         threshold_bytes = dev._validated_threshold(
             _tpolicy.bucket_threshold(axis, threshold_bytes))
-        quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
-            "int8", "int8_blockwise")
-        if quant_wire:
+        quant_wire = _qleg(wire_dtype)
+        if quant_wire is not None:
             wire_dtype = None  # the quantized path owns the wire format
         leaves, treedef = jax.tree.flatten(tree)
         if not leaves:
@@ -460,9 +474,11 @@ def overlap_value_and_grad(stage_fns: Sequence[Callable],
 
         threshold = dev._validated_threshold(
             _tpolicy.bucket_threshold(axis, threshold_bytes))
-        quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
-            "int8", "int8_blockwise")
-        wd = None if quant_wire else wire_dtype
+        from ..quant.collectives import quant_wire_leg as _qleg
+        from ..quant.collectives import wire_sentinel as _sentinel
+
+        quant_wire = _qleg(wire_dtype)
+        wd = wire_dtype if quant_wire is None else None
 
         # ZeRO composition (ops/zero.py): with HVDT_ZERO live, each VJP
         # segment's exchange rides the reduce-scatter wire (rs_exchange:
@@ -487,8 +503,8 @@ def overlap_value_and_grad(stage_fns: Sequence[Callable],
                         g_p, axis, op, threshold_bytes=threshold,
                         prescale_factor=prescale_factor,
                         postscale_factor=postscale_factor,
-                        wire_dtype=wd if not quant_wire
-                        else "int8_blockwise")
+                        wire_dtype=wd if quant_wire is None
+                        else _sentinel(quant_wire))
                     token = _payload_token(jnp.ravel(leaves[0]))
                     if i > 0:
                         ct, _ = lax.optimization_barrier((ct, token))
@@ -534,10 +550,11 @@ def exchange_and_update(grads, leaf_update: Callable, aux_trees=(),
     pytree matching ``grads`` — or a tuple of such pytrees when
     ``leaf_update`` returns tuples (e.g. ``(updates, new_trace)``).
     """
+    from ..quant.collectives import quant_wire_leg as _qleg
+
     threshold_bytes = dev._validated_threshold(threshold_bytes)
-    quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
-        "int8", "int8_blockwise")
-    if quant_wire:
+    quant_wire = _qleg(wire_dtype)
+    if quant_wire is not None:
         wire_dtype = None
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:

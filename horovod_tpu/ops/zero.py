@@ -370,23 +370,33 @@ def record_state_gauges(spec_bytes_per_rank: int,
 # ---------------------------------------------------------------------------
 
 
-def _int8_slow_axis(axis, wire_dtype) -> Optional[str]:
-    """The single axis whose shard exchange rides the block-scaled int8
-    wire: an explicit ``Compression.int8`` on a flat group, or the
-    transport policy's int8 slow tier on a hierarchical group."""
+def _quant_slow_axis(axis, wire_dtype):
+    """``(axis, leg)`` for the single axis whose shard exchange rides a
+    block-scaled quantized wire ("int8" / "int4"): an explicit
+    ``Compression.int8``/``.int4`` on a flat group, or the transport
+    policy's quantized slow tier on a hierarchical group; ``None``
+    otherwise."""
+    from ..quant.collectives import quant_wire_leg
+
     axes = _axes_tuple(axis)
-    quant_wire = isinstance(wire_dtype, str) and wire_dtype in (
-        "int8", "int8_blockwise")
-    if quant_wire and len(axes) == 1:
-        return axes[0]
+    leg = quant_wire_leg(wire_dtype)
+    if leg is not None and len(axes) == 1:
+        return axes[0], leg
     from ..transport import policy as _tpolicy
 
     res = _tpolicy.resolve_axis(axis)
     if (res is not None and res.kind == "hierarchical"
-            and res.slow is not None and res.slow.wire == "int8"
+            and res.slow is not None
+            and quant_wire_leg(res.slow.wire) is not None
             and len(res.slow_axes) == 1):
-        return res.slow_axes[0]
+        return res.slow_axes[0], quant_wire_leg(res.slow.wire)
     return None
+
+
+def _int8_slow_axis(axis, wire_dtype) -> Optional[str]:
+    """Back-compat shim: the axis half of :func:`_quant_slow_axis`."""
+    hit = _quant_slow_axis(axis, wire_dtype)
+    return None if hit is None else hit[0]
 
 
 def _cast_wire(axis, wire_dtype):
@@ -422,15 +432,16 @@ class _InflightShard:
 
 def _rs_start(flat, axis, wire_dtype, float_bucket) -> _InflightShard:
     dtype = flat.dtype
-    slow = _int8_slow_axis(axis, wire_dtype) if float_bucket else None
+    hit = _quant_slow_axis(axis, wire_dtype) if float_bucket else None
     cast_to = _cast_wire(axis, wire_dtype) if float_bucket else None
     x = flat
     if cast_to is not None and x.dtype != cast_to:
         x = x.astype(cast_to)
-    if slow is None:
+    if hit is None:
         return _InflightShard(shard=_reduce_scatter_flat(x, axis),
                               quant_state=None, slow_axis=None,
                               dtype=dtype)
+    slow, leg = hit
     from ..quant.collectives import quantized_reduce_scatter_start
 
     axes = _axes_tuple(axis)
@@ -438,7 +449,8 @@ def _rs_start(flat, axis, wire_dtype, float_bucket) -> _InflightShard:
     shard = x
     for a in fast_axes:
         shard = lax.psum_scatter(shard, a, tiled=True)
-    qs = quantized_reduce_scatter_start(shard.astype(jnp.float32), slow)
+    qs = quantized_reduce_scatter_start(shard.astype(jnp.float32), slow,
+                                        wire=leg)
     return _InflightShard(shard=None, quant_state=qs, slow_axis=slow,
                           dtype=dtype)
 
@@ -533,10 +545,11 @@ def _exchange_buckets(leaves, plan: _Plan, axis, op: ReduceOp,
         nbytes = int(flat.size) * jnp.dtype(flat.dtype).itemsize
         # Ring accounting: a reduce-scatter moves (n-1)/n of the payload.
         bucket_bytes.append(nbytes * (n - 1) // max(1, n))
+        from ..quant.collectives import wire_sentinel as _sentinel
+
+        _qhit = _quant_slow_axis(axis, wire_dtype) if float_bucket else None
         _record_bucket("reduce_scatter", _axis_label, flat.dtype,
-                       ("int8_blockwise"
-                        if _int8_slow_axis(axis, wire_dtype) is not None
-                        and float_bucket
+                       (_sentinel(_qhit[1]) if _qhit is not None
                         else jnp.dtype(flat.dtype).name),
                        bucket_bytes[-1], name=f"zero.b{bi}",
                        count=len(bucket))

@@ -1,11 +1,14 @@
-"""Quantized collective communication — block-scaled int8 wire format.
+"""Quantized collectives and low-precision compute — int8/int4 wire,
+fp8 matmul.
 
-The gradient wire path's third compression tier (after bf16/fp16
-casts, ops/compression.py): EQuARX-style (arxiv 2506.17615)
-block-scaled symmetric int8 with per-block f32 absmax scales, reduced
-in two quantized hops (reduce-scatter in wire format → f32
-dequant-accumulate → requantize → allgather), with optax-compatible
-error feedback so convergence matches the f32 wire.
+The gradient wire path's third and fourth compression tiers (after
+bf16/fp16 casts, ops/compression.py): EQuARX-style (arxiv 2506.17615)
+block-scaled symmetric int8 — and packed sub-byte int4 — with
+per-block f32 absmax scales, reduced in two quantized hops
+(reduce-scatter in wire format → f32 dequant-accumulate → requantize →
+allgather), with optax-compatible error feedback so convergence
+matches the f32 wire.  :mod:`.fp8` adds the compute-side leg: e4m3
+per-tensor-scaled matmuls (``HVDT_FP8=matmul``) with f32 accumulation.
 
 Layout:
 
@@ -18,10 +21,10 @@ Layout:
 * :mod:`.error_feedback` — ``with_error_feedback(tx)`` residual
   accumulator carrying quantization error into the next step.
 
-Selection: ``DistributedOptimizer(compression=hvd.Compression.int8)``,
-or env-wide via ``HVDT_COMPRESSION=int8`` / ``HVDT_QUANT=1``; the
-autotuner can A/B the wire online with ``HVDT_AUTOTUNE_QUANT=1``
-(state-compatible hot-swap legs).
+Selection: ``DistributedOptimizer(compression=hvd.Compression.int8)``
+(or ``.int4``), env-wide via ``HVDT_COMPRESSION=int8|int4`` /
+``HVDT_QUANT=1``; the autotuner can A/B the f32/int8/int4 legs online
+with ``HVDT_AUTOTUNE_QUANT=1`` (state-compatible hot-swap legs).
 """
 
 from __future__ import annotations
@@ -29,13 +32,21 @@ from __future__ import annotations
 from .kernels import (  # noqa: F401
     quant_block_size,
     quant_kernel_eligible,
+    quant_kernel_eligible_int4,
     quantize_flat,
     dequantize_flat,
     quantize_dequantize,
+    quantize_flat_int4,
+    dequantize_flat_int4,
+    quantize_dequantize_int4,
     wire_bytes,
+    wire_bytes_int4,
 )
 from .collectives import (  # noqa: F401
     INT8_WIRE,
+    INT4_WIRE,
+    quant_wire_leg,
+    wire_sentinel,
     quantized_allreduce,
     quantized_allreduce_flat,
     eager_quantized_allreduce,
@@ -47,15 +58,31 @@ from .error_feedback import (  # noqa: F401
     stack_residual,
     unstack_residual,
 )
+from .fp8 import (  # noqa: F401
+    E4M3_MAX,
+    Fp8AmaxState,
+    fp8_available,
+    fp8_matmul,
+    fp8_matmul_delayed,
+    init_amax_state,
+)
 
 __all__ = [
     "quant_block_size",
     "quant_kernel_eligible",
+    "quant_kernel_eligible_int4",
     "quantize_flat",
     "dequantize_flat",
     "quantize_dequantize",
+    "quantize_flat_int4",
+    "dequantize_flat_int4",
+    "quantize_dequantize_int4",
     "wire_bytes",
+    "wire_bytes_int4",
     "INT8_WIRE",
+    "INT4_WIRE",
+    "quant_wire_leg",
+    "wire_sentinel",
     "quantized_allreduce",
     "quantized_allreduce_flat",
     "eager_quantized_allreduce",
@@ -64,4 +91,10 @@ __all__ = [
     "tile_residual",
     "stack_residual",
     "unstack_residual",
+    "E4M3_MAX",
+    "Fp8AmaxState",
+    "fp8_available",
+    "fp8_matmul",
+    "fp8_matmul_delayed",
+    "init_amax_state",
 ]

@@ -1,4 +1,4 @@
-"""Two-stage quantized allreduce — int8 wire format end to end.
+"""Two-stage quantized allreduce — int8 or packed-int4 wire end to end.
 
 The EQuARX schedule (arxiv 2506.17615), expressed with XLA named-axis
 collectives so GSPMD/Mosaic can overlap it like any other program:
@@ -49,11 +49,46 @@ __all__ = ["quantized_allreduce_flat", "quantized_allreduce",
            "quantized_allreduce_start", "quantized_allreduce_finish",
            "quantized_reduce_scatter_start",
            "quantized_reduce_scatter_finish",
-           "InflightQuantized", "eager_quantized_allreduce", "INT8_WIRE"]
+           "InflightQuantized", "eager_quantized_allreduce",
+           "INT8_WIRE", "INT4_WIRE", "quant_wire_leg", "wire_sentinel"]
 
-# Sentinel a Compressor exposes as ``wire_dtype`` to select this path in
-# fused_allreduce (a string on purpose: never mistakable for a dtype).
+# Sentinels a Compressor exposes as ``wire_dtype`` to select this path in
+# fused_allreduce (strings on purpose: never mistakable for a dtype).
 INT8_WIRE = "int8_blockwise"
+INT4_WIRE = "int4_blockwise"
+
+# Every quantized-wire spelling a wire_dtype slot may carry, mapped to
+# the quantized leg it selects.  The ONE place consumers (overlap, zero,
+# device, hierarchy) classify a wire_dtype as quantized — adding a leg
+# here adds it everywhere.
+_WIRE_LEGS = {"int8": "int8", INT8_WIRE: "int8",
+              "int4": "int4", INT4_WIRE: "int4"}
+
+
+def quant_wire_leg(wire_dtype) -> Optional[str]:
+    """``"int8"`` / ``"int4"`` when ``wire_dtype`` names a quantized
+    wire (policy name or blockwise sentinel), else ``None``."""
+    if not isinstance(wire_dtype, str):
+        return None
+    return _WIRE_LEGS.get(wire_dtype)
+
+
+def wire_sentinel(wire: str) -> str:
+    """The telemetry/compressor sentinel for a quantized leg name."""
+    return INT4_WIRE if wire == "int4" else INT8_WIRE
+
+
+def _leg_wire_bytes(wire: str, size: int, block: int) -> int:
+    return (qk.wire_bytes_int4(size, block) if wire == "int4"
+            else qk.wire_bytes(size, block))
+
+
+def _check_wire(wire: str) -> str:
+    if wire not in ("int8", "int4"):
+        raise ValueError(
+            f"quantized allreduce wire must be 'int8' or 'int4', "
+            f"got {wire!r}")
+    return wire
 
 
 def _single_axis(axis) -> str:
@@ -96,21 +131,29 @@ class InflightQuantized:
     total: int
     size: int
     dtype: Any
+    # Which quantized leg the payload rides: "int8" (1 B/elem) or
+    # "int4" (packed two lanes per byte; q_recv holds shard/2 bytes).
+    wire: str = "int8"
 
 
 def quantized_allreduce_start(flat, axis="dp",
                               op: ReduceOp = ReduceOp.AVERAGE,
                               block_size: Optional[int] = None,
-                              prescale_factor: float = 1.0
+                              prescale_factor: float = 1.0,
+                              wire: str = "int8"
                               ) -> InflightQuantized:
     """Stages 1-2 of the quantized allreduce: quantize locally and issue
     the wire-format reduce-scatter (the bandwidth-heavy ``all_to_all``
-    hop).  Returns an :class:`InflightQuantized` handle for
+    hop).  ``wire`` selects the int8 or packed-int4 payload; both legs
+    trace the same schedule shape, so autotune flips between them (and
+    f32) without recompiling structure.  Returns an
+    :class:`InflightQuantized` handle for
     :func:`quantized_allreduce_finish`; ``finish(start(x))`` is the
     exact program :func:`quantized_allreduce_flat` traces."""
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             f"quantized allreduce supports SUM/AVERAGE, got {op}")
+    wire = _check_wire(wire)
     ax = _single_axis(axis)
     block = block_size or qk.quant_block_size()
     n = _axis_size_static(ax)
@@ -118,22 +161,24 @@ def quantized_allreduce_start(flat, axis="dp",
     size = flat.shape[0]
 
     # Telemetry (trace time, path=jit — the compiled program executes the
-    # wire hops): record the int8 wire-format payload this bucket's
-    # program moves per hop (qk.wire_bytes = 1 B/elem + f32 block scales).
+    # wire hops): record the wire-format payload this bucket's program
+    # moves per hop (1 B/elem int8 or 0.5 B/elem int4, + f32 block
+    # scales).
     from ..telemetry import instrument as _ti
     from ..telemetry import flight_recorder as _frm
 
+    sentinel = wire_sentinel(wire)
+    payload = _leg_wire_bytes(wire, size, block)
     _rec = _ti.get_recorder()
     if _rec is not None:
         _rec.record_collective("allreduce", jnp.dtype(dtype).name,
-                               INT8_WIRE, qk.wire_bytes(size, block),
-                               path="jit", axis=ax)
+                               sentinel, payload, path="jit", axis=ax)
     _flight = _frm.get_flight_recorder()
     if _flight is not None:
         _flight.record(op="allreduce", name="quantized.flat",
                        dtype=jnp.dtype(dtype).name, shape=(int(size),),
-                       nbytes=int(qk.wire_bytes(size, block)),
-                       wire=INT8_WIRE, path="jit", axis=ax)
+                       nbytes=int(payload),
+                       wire=sentinel, path="jit", axis=ax)
 
     x = flat.astype(jnp.float32)
     if prescale_factor != 1.0:
@@ -144,9 +189,15 @@ def quantized_allreduce_start(flat, axis="dp",
     if total != size:
         x = jnp.concatenate([x, jnp.zeros((total - size,), jnp.float32)])
 
-    # Stage 1-2: quantize locally, reduce-scatter the wire format.
-    q, scales = qk.quantize_flat(x, block)
-    q_rows = q.reshape(n, shard)
+    # Stage 1-2: quantize locally, reduce-scatter the wire format.  The
+    # int4 payload rows are shard/2 packed bytes (block is even by
+    # _check_wire + kernels' block % 2 check, so shard is too).
+    if wire == "int4":
+        q, scales = qk.quantize_flat_int4(x, block)
+        q_rows = q.reshape(n, shard // 2)
+    else:
+        q, scales = qk.quantize_flat(x, block)
+        q_rows = q.reshape(n, shard)
     s_rows = scales.reshape(n, shard // block)
     q_recv = lax.all_to_all(q_rows, ax, split_axis=0, concat_axis=0,
                             tiled=True)
@@ -154,7 +205,26 @@ def quantized_allreduce_start(flat, axis="dp",
                             tiled=True)
     return InflightQuantized(q_recv=q_recv, s_recv=s_recv, axis=ax, op=op,
                              block=block, n=n, shard=shard, total=total,
-                             size=size, dtype=dtype)
+                             size=size, dtype=dtype, wire=wire)
+
+
+def _dequant_accumulate(inflight: InflightQuantized):
+    """Stage 3, shared by finish and reduce-scatter finish: dequantize
+    the n received wire shards and accumulate in f32 (never in wire
+    precision — that would overflow and compound rounding)."""
+    block, n, shard = inflight.block, inflight.n, inflight.shard
+    q_recv, s_recv = inflight.q_recv, inflight.s_recv
+    if inflight.wire == "int4":
+        deq = qk.dequantize_flat_int4(q_recv.reshape(-1),
+                                      s_recv.reshape(-1), block)
+        acc = jnp.sum(deq.reshape(n, shard), axis=0)
+    else:
+        contrib = (q_recv.reshape(n, shard // block, block)
+                   .astype(jnp.float32) * s_recv[:, :, None])
+        acc = jnp.sum(contrib, axis=0).reshape(-1)
+    if inflight.op == ReduceOp.AVERAGE:
+        acc = acc * (1.0 / n)
+    return acc
 
 
 def quantized_allreduce_finish(inflight: InflightQuantized,
@@ -162,18 +232,13 @@ def quantized_allreduce_finish(inflight: InflightQuantized,
     """Stages 3-5 of the quantized allreduce: dequantize-accumulate this
     rank's shard, requantize, reassemble in wire format, final
     dequantize.  Inverse bookend of :func:`quantized_allreduce_start`."""
-    ax, op = inflight.axis, inflight.op
-    block, n = inflight.block, inflight.n
+    ax = inflight.axis
+    block = inflight.block
     shard, total, size = inflight.shard, inflight.total, inflight.size
     dtype = inflight.dtype
-    q_recv, s_recv = inflight.q_recv, inflight.s_recv
 
     # Stage 3: dequantize-accumulate this rank's shard in f32.
-    contrib = (q_recv.reshape(n, shard // block, block).astype(jnp.float32)
-               * s_recv[:, :, None])
-    acc = jnp.sum(contrib, axis=0).reshape(-1)
-    if op == ReduceOp.AVERAGE:
-        acc = acc * (1.0 / n)
+    acc = _dequant_accumulate(inflight)
 
     # Stage 4-5: requantize, reassemble in wire format, final dequantize.
     # Reassembly is zero-embed + psum rather than all_gather: the
@@ -181,20 +246,31 @@ def quantized_allreduce_finish(inflight: InflightQuantized,
     # consumer of an allreduce expects (P() out_specs, optax.MultiSteps
     # cond-type stability — see device.invariant_allgather_shards for
     # the idiom), and the embedded regions are disjoint so the int8 sum
-    # cannot overflow.  Costs 2(n-1)/n int8 bytes on this hop vs the
-    # allgather's (n-1)/n — total wire still ~2.7x under the f32 ring.
-    q_out, s_out = qk.quantize_flat(acc, block)
+    # cannot overflow.  Costs 2(n-1)/n wire bytes on this hop vs the
+    # allgather's (n-1)/n — total wire still well under the f32 ring.
     idx = lax.axis_index(ax)
-    q_full = lax.psum(
-        lax.dynamic_update_slice_in_dim(
-            jnp.zeros((total,), jnp.int8), q_out, idx * shard, axis=0),
-        ax)
+    if inflight.wire == "int4":
+        q_out, s_out = qk.quantize_flat_int4(acc, block)
+        q_full = lax.psum(
+            lax.dynamic_update_slice_in_dim(
+                jnp.zeros((total // 2,), jnp.int8), q_out,
+                idx * (shard // 2), axis=0),
+            ax)
+    else:
+        q_out, s_out = qk.quantize_flat(acc, block)
+        q_full = lax.psum(
+            lax.dynamic_update_slice_in_dim(
+                jnp.zeros((total,), jnp.int8), q_out, idx * shard, axis=0),
+            ax)
     s_full = lax.psum(
         lax.dynamic_update_slice_in_dim(
             jnp.zeros((total // block,), jnp.float32), s_out,
             idx * (shard // block), axis=0),
         ax)
-    out = qk.dequantize_flat(q_full, s_full, block)
+    if inflight.wire == "int4":
+        out = qk.dequantize_flat_int4(q_full, s_full, block)
+    else:
+        out = qk.dequantize_flat(q_full, s_full, block)
     if postscale_factor != 1.0:
         out = out * postscale_factor
     if total != size:
@@ -205,16 +281,17 @@ def quantized_allreduce_finish(inflight: InflightQuantized,
 def quantized_reduce_scatter_start(flat, axis="dp",
                                    op: ReduceOp = ReduceOp.SUM,
                                    block_size: Optional[int] = None,
-                                   prescale_factor: float = 1.0
+                                   prescale_factor: float = 1.0,
+                                   wire: str = "int8"
                                    ) -> InflightQuantized:
-    """The int8-wire **reduce-scatter** half of the two-stage collective
-    — stage 1-2 only (quantize + wire-format all_to_all).  Identical to
-    :func:`quantized_allreduce_start`; named separately because the
-    ZeRO exchange (ops/zero.py) consumes the *shard*, never the
-    reassembled vector: the established quant seam splits exactly at the
-    reduce-scatter / dequant-accumulate boundary."""
+    """The quantized-wire **reduce-scatter** half of the two-stage
+    collective — stage 1-2 only (quantize + wire-format all_to_all).
+    Identical to :func:`quantized_allreduce_start`; named separately
+    because the ZeRO exchange (ops/zero.py) consumes the *shard*, never
+    the reassembled vector: the established quant seam splits exactly at
+    the reduce-scatter / dequant-accumulate boundary."""
     return quantized_allreduce_start(flat, axis, op, block_size,
-                                     prescale_factor)
+                                     prescale_factor, wire=wire)
 
 
 def quantized_reduce_scatter_finish(inflight: InflightQuantized):
@@ -224,27 +301,21 @@ def quantized_reduce_scatter_finish(inflight: InflightQuantized):
     shard carries only stage-1 quantization error (each rank's block
     scale / 2); the ZeRO update consumes it directly and allgathers
     exact parameter deltas instead of a requantized gradient."""
-    block, n = inflight.block, inflight.n
-    shard = inflight.shard
-    q_recv, s_recv = inflight.q_recv, inflight.s_recv
-    contrib = (q_recv.reshape(n, shard // block, block).astype(jnp.float32)
-               * s_recv[:, :, None])
-    acc = jnp.sum(contrib, axis=0).reshape(-1)
-    if inflight.op == ReduceOp.AVERAGE:
-        acc = acc * (1.0 / n)
-    return acc
+    return _dequant_accumulate(inflight)
 
 
 def quantized_allreduce_flat(flat, axis="dp",
                              op: ReduceOp = ReduceOp.AVERAGE,
                              block_size: Optional[int] = None,
                              prescale_factor: float = 1.0,
-                             postscale_factor: float = 1.0):
-    """Allreduce one flat float vector over ``axis`` with the int8 wire
-    (the bucket-level primitive ``fused_allreduce`` routes to).  Valid
-    inside shard_map where ``axis`` is bound; SUM/AVERAGE only (MIN/MAX
-    etc. have no meaningful block-rescaled accumulation).  Returns the
-    reduced vector in the input dtype, replicated across ``axis``.
+                             postscale_factor: float = 1.0,
+                             wire: str = "int8"):
+    """Allreduce one flat float vector over ``axis`` with the quantized
+    wire (the bucket-level primitive ``fused_allreduce`` routes to).
+    Valid inside shard_map where ``axis`` is bound; SUM/AVERAGE only
+    (MIN/MAX etc. have no meaningful block-rescaled accumulation).
+    Returns the reduced vector in the input dtype, replicated across
+    ``axis``.
 
     Composition of :func:`quantized_allreduce_start` (quantize + wire
     reduce-scatter) and :func:`quantized_allreduce_finish`
@@ -253,14 +324,15 @@ def quantized_allreduce_flat(flat, axis="dp",
     wire phase; calling this traces the identical monolithic program."""
     return quantized_allreduce_finish(
         quantized_allreduce_start(flat, axis, op, block_size,
-                                  prescale_factor),
+                                  prescale_factor, wire=wire),
         postscale_factor)
 
 
 def quantized_allreduce(tree, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
                         block_size: Optional[int] = None,
                         prescale_factor: float = 1.0,
-                        postscale_factor: float = 1.0):
+                        postscale_factor: float = 1.0,
+                        wire: str = "int8"):
     """Pytree convenience wrapper: every float leaf rides
     :func:`quantized_allreduce_flat` (flattened per leaf — for the
     bucketed hot path use ``ops.device.fused_allreduce`` with
@@ -273,7 +345,7 @@ def quantized_allreduce(tree, axis="dp", op: ReduceOp = ReduceOp.AVERAGE,
             flat = jnp.ravel(leaf)
             red = quantized_allreduce_flat(
                 flat, axis, op, block_size, prescale_factor,
-                postscale_factor)
+                postscale_factor, wire=wire)
             return red.reshape(leaf.shape)
         return dev.allreduce(leaf, axis, op, prescale_factor,
                              postscale_factor)

@@ -76,7 +76,7 @@ def stack_residual(state: ErrorFeedbackState) -> ErrorFeedbackState:
 
 
 def with_error_feedback(inner, block_size: Optional[int] = None,
-                        enabled: bool = True):
+                        enabled: bool = True, wire: str = "int8"):
     """Wrap an optax ``GradientTransformation`` (typically the whole
     ``DistributedOptimizer(..., compression=Compression.int8)`` chain)
     with a quantization-error residual accumulator::
@@ -93,8 +93,19 @@ def with_error_feedback(inner, block_size: Optional[int] = None,
       enabled: with False, gradients pass through untouched and the
         residual stays zero — same state STRUCTURE, exact math; the
         f32-wire leg of a quant A/B.
+      wire: which quantization grid ``sent`` rides — ``"int8"`` or
+        ``"int4"``.  The residual tree is plain f32 ``zeros_like``
+        leaves on EVERY leg, so int8↔int4↔f32 hot-swaps carry the
+        accumulated error across without restructuring state.
     """
     import optax
+
+    if wire not in ("int8", "int4"):
+        raise ValueError(
+            f"with_error_feedback wire must be 'int8' or 'int4', "
+            f"got {wire!r}")
+    qdq = (qk.quantize_dequantize_int4 if wire == "int4"
+           else qk.quantize_dequantize)
 
     def init_fn(params):
         residual = jax.tree.map(
@@ -109,7 +120,7 @@ def with_error_feedback(inner, block_size: Optional[int] = None,
         e = jax.tree.map(compensated, updates, state.residual)
         if enabled:
             sent = jax.tree.map(
-                lambda t: qk.quantize_dequantize(t, block_size), e)
+                lambda t: qdq(t, block_size), e)
             residual = jax.tree.map(jnp.subtract, e, sent)
         else:
             sent = e

@@ -1,0 +1,176 @@
+"""Per-tensor-scaled fp8 (e4m3) matmul — the low-precision COMPUTE leg.
+
+Where the int8/int4 wire (quant/kernels, quant/collectives) shrinks
+communication, this module shrinks the matmul itself: weights and
+activations are scaled into ``float8_e4m3fn`` per tensor and the MXU/
+dot runs on the 8-bit operands with f32 accumulation
+(``preferred_element_type``), the pattern XLA fuses into a native fp8
+convert-dot on hardware with fp8 support.
+
+Scaling is symmetric per-tensor ``amax / E4M3_MAX``: e4m3 has no inf
+and a max finite value of 448, so anything scaled into [-448, 448]
+survives the cast.  Two ways to supply ``amax``:
+
+* **current-max** (default): ``stop_gradient(max|x|)`` of this very
+  operand — one extra reduction per matmul, always correct.
+* **delayed-max** (:class:`Fp8AmaxState`, :func:`fp8_matmul_delayed`):
+  the rolling max of the last N steps' amaxes, the Transformer-Engine
+  recipe — the scale is known BEFORE the operand is produced, so the
+  cast fuses with the producer.  Out-of-history spikes clip for one
+  step; the history catches up the next.
+
+Gate: ``HVDT_FP8=off|matmul`` (:func:`matmul_enabled`), consumed by the
+transformer's MLP and attention projections.  Capability is probed at
+first use (:func:`fp8_available`): the dtype must exist AND a tiny
+jitted fp8 ``dot_general`` must actually execute on the default
+backend.  Probe failure ⇒ :func:`fp8_matmul` IS ``x @ w`` — the gate is
+a provable no-op (identity-tested) on builds without fp8, e.g. older
+jax or backends that reject f8 convert-dots.  The container's jax
+0.4.37 CPU build passes the probe, so tests exercise the real
+convert-dot lowering (``f8e4m3`` in the HLO) everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..common import config
+
+__all__ = [
+    "E4M3_MAX",
+    "fp8_available",
+    "fp8_mode",
+    "matmul_enabled",
+    "fp8_matmul",
+    "Fp8AmaxState",
+    "init_amax_state",
+    "fp8_matmul_delayed",
+]
+
+# Max finite |value| of float8_e4m3fn (no inf encoding; 0x7E = 448).
+E4M3_MAX = 448.0
+
+_FP8_MODES = ("off", "matmul")
+
+_probe_result: Optional[bool] = None
+
+
+def _fp8_dtype():
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_available() -> bool:
+    """True when ``float8_e4m3fn`` exists and an fp8 ``dot_general``
+    actually executes on the default backend (probed once per process:
+    dtype presence alone doesn't guarantee the backend accepts f8
+    convert-dots)."""
+    global _probe_result
+    if _probe_result is None:
+        dt = _fp8_dtype()
+        if dt is None:
+            _probe_result = False
+        else:
+            try:
+                a = jnp.ones((8, 8), dt)
+                f = jax.jit(lambda x, y: jax.lax.dot_general(
+                    x, y, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+                jax.block_until_ready(f(a, a))
+                _probe_result = True
+            except Exception:
+                _probe_result = False
+    return _probe_result
+
+
+def fp8_mode() -> str:
+    """The validated ``HVDT_FP8`` value."""
+    mode = (config.get_str("HVDT_FP8") or "off").lower()
+    if mode not in _FP8_MODES:
+        raise ValueError(
+            f"unknown HVDT_FP8 mode {mode!r}; valid: "
+            f"{', '.join(_FP8_MODES)}")
+    return mode
+
+
+def matmul_enabled() -> bool:
+    """True when matmuls should ride the fp8 path: ``HVDT_FP8=matmul``
+    AND the capability probe passes."""
+    return fp8_mode() == "matmul" and fp8_available()
+
+
+def _scale_for(amax):
+    """Per-tensor scale mapping ``[-amax, amax]`` onto the e4m3 range;
+    all-zero tensors get scale 1 (q = 0 exactly, no 0/0)."""
+    amax = jnp.maximum(amax.astype(jnp.float32), 0.0)
+    return jnp.where(amax > 0, amax * (1.0 / E4M3_MAX), 1.0)
+
+
+def _cast_e4m3(x, scale):
+    # Clip before the convert: values past ±448 would otherwise land on
+    # e4m3 NaN (no inf encoding).
+    dt = _fp8_dtype()
+    y = jnp.clip(x.astype(jnp.float32) / scale, -E4M3_MAX, E4M3_MAX)
+    return y.astype(dt)
+
+
+def fp8_matmul(x, w, amax_x=None, amax_w=None):
+    """``x @ w`` with both operands per-tensor-scaled into e4m3 and f32
+    accumulation; result in ``x``'s dtype.  ``x`` is ``[..., k]``, ``w``
+    is ``[k, n]`` (the transformer projection shape).
+
+    ``amax_x`` / ``amax_w`` override the current-max statistics (the
+    delayed-scaling hook); by default each operand's own
+    ``stop_gradient(max|·|)`` is used.  When fp8 is unavailable this IS
+    the plain matmul — same dtype, same math."""
+    if not fp8_available():
+        return x @ w.astype(x.dtype)
+    if amax_x is None:
+        amax_x = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    if amax_w is None:
+        amax_w = jax.lax.stop_gradient(jnp.max(jnp.abs(w)))
+    sx = _scale_for(jnp.asarray(amax_x))
+    sw = _scale_for(jnp.asarray(amax_w))
+    qx = _cast_e4m3(x, sx)
+    qw = _cast_e4m3(w, sw)
+    nd = qx.ndim
+    out = jax.lax.dot_general(
+        qx, qw, (((nd - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return (out * (sx * sw)).astype(x.dtype)
+
+
+class Fp8AmaxState(NamedTuple):
+    """Delayed-max scaling state for ONE matmul site: rolling amax
+    history per operand (f32 ``[history]``, newest last)."""
+    x: Any
+    w: Any
+
+
+def init_amax_state(history: int = 16) -> Fp8AmaxState:
+    """Fresh all-zero history (zero amax ⇒ scale 1 on step 0; real
+    statistics take over as the history fills)."""
+    return Fp8AmaxState(x=jnp.zeros((history,), jnp.float32),
+                        w=jnp.zeros((history,), jnp.float32))
+
+
+def fp8_matmul_delayed(x, w, state: Fp8AmaxState
+                       ) -> Tuple[jax.Array, Fp8AmaxState]:
+    """``x @ w`` scaled by the HISTORY's max (Transformer-Engine delayed
+    scaling) and the rolled-forward state carrying this step's observed
+    amaxes.  Functional: thread the state like any optimizer state."""
+    if not fp8_available():
+        return x @ w.astype(x.dtype), state
+    ax = jax.lax.stop_gradient(jnp.max(jnp.abs(x)).astype(jnp.float32))
+    aw = jax.lax.stop_gradient(jnp.max(jnp.abs(w)).astype(jnp.float32))
+    # Scale from history ∪ current: never a stale zero on the first
+    # step, never more than one step behind after that.
+    out = fp8_matmul(x, w,
+                     amax_x=jnp.maximum(jnp.max(state.x), ax),
+                     amax_w=jnp.maximum(jnp.max(state.w), aw))
+    new = Fp8AmaxState(
+        x=jnp.concatenate([state.x[1:], ax[None]]),
+        w=jnp.concatenate([state.w[1:], aw[None]]))
+    return out, new

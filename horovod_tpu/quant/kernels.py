@@ -1,13 +1,22 @@
-"""Block-scaled symmetric int8 quantize/dequantize kernels.
+"""Block-scaled symmetric int8/int4 quantize/dequantize kernels.
 
 The wire-format primitives of the quantized-collective subsystem
 (EQuARX, arxiv 2506.17615: block-scaled quantization inside the
 allreduce roughly halves wire bytes vs bf16 at negligible quality
-loss).  Format: a flat float vector is cut into fixed-size blocks
-(``HVDT_QUANT_BLOCK`` elements); each block carries one f32 scale
-``absmax / 127`` and its elements as symmetric int8
-``round(x / scale)`` clipped to [-127, 127].  Wire bytes per element:
-1 + 4/block (vs 4 for f32) — ~3.9x smaller at the default block 256.
+loss, and a 4-bit grid roughly halves that again when error feedback
+absorbs the coarser rounding).  int8 format: a flat float vector is
+cut into fixed-size blocks (``HVDT_QUANT_BLOCK`` elements); each block
+carries one f32 scale ``absmax / 127`` and its elements as symmetric
+int8 ``round(x / scale)`` clipped to [-127, 127].  Wire bytes per
+element: 1 + 4/block (vs 4 for f32) — ~3.9x smaller at the default
+block 256.  int4 format: same block grid, scale ``absmax / 7``,
+elements clipped to [-7, 7] and packed two lanes per int8 byte —
+0.5 + 4/block B/elem, ~0.51x of the int8 wire at block 256.
+
+int4 packing is half-split, not adjacent-pair: byte ``j`` of a block
+carries element ``j`` in its low nibble and element ``j + block/2`` in
+its high nibble, so pack/unpack are contiguous half-block slices plus
+lane-local shifts — Mosaic-friendly (no strided sublane gathers).
 
 Two lowerings with identical math (the optim_kernels pattern):
 
@@ -48,10 +57,15 @@ from ..ops.pallas_kernels import _use_interpret, _vma_kw
 __all__ = [
     "quant_block_size",
     "quant_kernel_eligible",
+    "quant_kernel_eligible_int4",
     "quantize_flat",
     "dequantize_flat",
     "quantize_dequantize",
+    "quantize_flat_int4",
+    "dequantize_flat_int4",
+    "quantize_dequantize_int4",
     "wire_bytes",
+    "wire_bytes_int4",
 ]
 
 _LANES = 128
@@ -247,3 +261,180 @@ def wire_bytes(size: int, block_size: Optional[int] = None) -> int:
     block = block_size or quant_block_size()
     nblocks = -(-size // block)
     return nblocks * block + nblocks * 4
+
+
+# ---- int4 wire -----------------------------------------------------------
+
+
+def quant_kernel_eligible_int4(size: int, block: int) -> bool:
+    """int4 Pallas eligibility: the int8 conditions plus a lane-aligned
+    *packed* half-block (``block % 256 == 0``) so the [rows, block/2]
+    int8 payload keeps a legal tile.  The default block 256 qualifies;
+    smaller blocks take the identical-math XLA fallback."""
+    return (quant_kernel_eligible(size, block)
+            and (block // 2) % _LANES == 0)
+
+
+def _scale_and_q4(x2):
+    """Per-block-row scale + unpacked 4-bit codes (int32 lanes, one
+    element per lane — packing is a separate step so both lowerings
+    share this text)."""
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = absmax * (1.0 / 7.0)
+    inv = jnp.where(scale > 0, 1.0 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.clip(jnp.round(x2 * inv), -7.0, 7.0).astype(jnp.int32)
+    return scale, q
+
+
+def _pack4(q):
+    """[..., block] int32 4-bit codes -> [..., block/2] int8 bytes:
+    element j in the low nibble, element j + block/2 in the high one
+    (half-split layout; see module docstring).  Two's-complement
+    masking keeps negative codes exact: (-7 & 0xF) = 9."""
+    half = q.shape[-1] // 2
+    lo = q[..., :half] & 0xF
+    hi = q[..., half:] & 0xF
+    v = lo | (hi << 4)
+    return jnp.where(v >= 128, v - 256, v).astype(jnp.int8)
+
+
+def _unpack4(p):
+    """Inverse of :func:`_pack4`; returns [..., block] int32 codes in
+    [-7, 7] (well, [-8, 7] for arbitrary bytes)."""
+    b = p.astype(jnp.int32)
+    b = jnp.where(b < 0, b + 256, b)
+    lo = b & 0xF
+    hi = (b >> 4) & 0xF
+    sext = lambda x: jnp.where(x >= 8, x - 16, x)  # noqa: E731
+    return jnp.concatenate([sext(lo), sext(hi)], axis=-1)
+
+
+def _quantize4_xla(x2):
+    scale, q = _scale_and_q4(x2)
+    return _pack4(q), scale[:, 0]
+
+
+def _dequantize4_xla(p2, scales):
+    return _unpack4(p2).astype(jnp.float32) * scales[:, None]
+
+
+def _quant4_kernel(x_ref, p_ref, s_ref):
+    x = x_ref[...].astype(jnp.float32)
+    scale, q = _scale_and_q4(x)
+    p_ref[...] = _pack4(q)
+    s_ref[...] = jnp.broadcast_to(scale, s_ref.shape)
+
+
+def _dequant4_kernel(p_ref, s_ref, o_ref):
+    o_ref[...] = _unpack4(p_ref[...]).astype(jnp.float32) * s_ref[..., :1]
+
+
+def _quantize4_pallas(x2):
+    import jax.experimental.pallas as pl
+
+    nblocks, block = x2.shape
+    br = _block_rows(nblocks)
+    kw = _vma_kw(x2)
+    spec = pl.BlockSpec((br, block), lambda i: (i, 0))
+    pspec = pl.BlockSpec((br, block // 2), lambda i: (i, 0))
+    sspec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    p, s = pl.pallas_call(
+        _quant4_kernel,
+        grid=(nblocks // br,),
+        in_specs=[spec],
+        out_specs=[pspec, sspec],
+        out_shape=(jax.ShapeDtypeStruct((nblocks, block // 2), jnp.int8,
+                                        **kw),
+                   jax.ShapeDtypeStruct((nblocks, _LANES), jnp.float32,
+                                        **kw)),
+        interpret=_use_interpret(),
+    )(x2)
+    return p, s[:, 0]
+
+
+def _dequantize4_pallas(p2, scales):
+    import jax.experimental.pallas as pl
+
+    nblocks, half = p2.shape
+    br = _block_rows(nblocks)
+    s2 = jnp.broadcast_to(scales[:, None], (nblocks, _LANES))
+    kw = _vma_kw(p2, scales)
+    pspec = pl.BlockSpec((br, half), lambda i: (i, 0))
+    spec = pl.BlockSpec((br, 2 * half), lambda i: (i, 0))
+    sspec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    return pl.pallas_call(
+        _dequant4_kernel,
+        grid=(nblocks // br,),
+        in_specs=[pspec, sspec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((nblocks, 2 * half), jnp.float32,
+                                       **kw),
+        interpret=_use_interpret(),
+    )(p2, s2)
+
+
+def quantize_flat_int4(flat, block_size: Optional[int] = None,
+                       use_kernels: Optional[bool] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """int4 sibling of :func:`quantize_flat`.  Returns ``(packed,
+    scales)``: int8 ``[size // 2]`` (two 4-bit lanes per byte,
+    half-split layout) and f32 ``[size // block]``."""
+    block = block_size or quant_block_size()
+    if flat.ndim != 1:
+        raise ValueError(f"quantize_flat_int4 takes a 1-D vector, got "
+                         f"shape {flat.shape}")
+    if block % 2:
+        raise ValueError(f"int4 wire needs an even block size, got {block}")
+    if flat.size % block:
+        raise ValueError(
+            f"size {flat.size} is not a whole number of {block}-element "
+            "blocks — pad first (quantize_dequantize_int4 does)")
+    x2 = flat.astype(jnp.float32).reshape(-1, block)
+    if use_kernels is None:
+        use_kernels = _kernels_on()
+    if use_kernels and quant_kernel_eligible_int4(flat.size, block):
+        p2, scales = _quantize4_pallas(x2)
+    else:
+        p2, scales = _quantize4_xla(x2)
+    return p2.reshape(-1), scales
+
+
+def dequantize_flat_int4(packed, scales, block_size: Optional[int] = None,
+                         use_kernels: Optional[bool] = None) -> jax.Array:
+    """Inverse of :func:`quantize_flat_int4`; ``packed`` holds
+    ``size // 2`` bytes, returns f32 ``[size]``."""
+    block = block_size or quant_block_size()
+    p2 = packed.reshape(-1, block // 2)
+    if use_kernels is None:
+        use_kernels = _kernels_on()
+    if use_kernels and quant_kernel_eligible_int4(2 * packed.size, block):
+        out = _dequantize4_pallas(p2, scales)
+    else:
+        out = _dequantize4_xla(p2, scales)
+    return out.reshape(-1)
+
+
+def quantize_dequantize_int4(x, block_size: Optional[int] = None,
+                             use_kernels: Optional[bool] = None):
+    """int4 sibling of :func:`quantize_dequantize`: the value the 4-bit
+    wire would carry, in the input shape/dtype — what error feedback
+    subtracts on the int4 leg."""
+    block = block_size or quant_block_size()
+    shape, dtype = x.shape, x.dtype
+    flat = jnp.ravel(x).astype(jnp.float32)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    p, scales = quantize_flat_int4(flat, block, use_kernels)
+    out = dequantize_flat_int4(p, scales, block, use_kernels)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape).astype(dtype)
+
+
+def wire_bytes_int4(size: int, block_size: Optional[int] = None) -> int:
+    """int4 wire accounting: 0.5 B/elem payload + one f32 scale per
+    (padded) block — ~0.51x of :func:`wire_bytes` at block 256."""
+    block = block_size or quant_block_size()
+    nblocks = -(-size // block)
+    return nblocks * (block // 2) + nblocks * 4

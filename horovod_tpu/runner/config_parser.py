@@ -314,8 +314,9 @@ KNOB_FLAGS: List[_Flag] = [
           "compression)."),
     _Flag("--compression", "compression", "HVDT_COMPRESSION",
           "params", "compression",
-          "Gradient wire compressor by name: none|bf16|fp16|int8 "
-          "(int8 = block-scaled quantized collectives, horovod_tpu/"
+          "Gradient wire compressor by name: none|bf16|fp16|int8|int4 "
+          "(int8/int4 = block-scaled quantized collectives, int4 packed "
+          "two lanes per byte, horovod_tpu/"
           "quant).  Workers resolve it in hvd.init()/"
           "DistributedOptimizer; unknown names fail init with the "
           "valid list."),

@@ -127,10 +127,12 @@ def hierarchical_allreduce_start(flat, res: ResolvedTransport,
     if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
         raise ValueError(
             f"hierarchical allreduce supports SUM/AVERAGE, got {op}")
-    if res.fast.wire == "int8":
+    from ..quant.collectives import quant_wire_leg
+
+    if quant_wire_leg(res.fast.wire) is not None:
         raise ValueError(
-            "int8 rides the slow (dcn) axis; the fast-axis "
-            "reduce-scatter leg has no int8 wire format")
+            f"{res.fast.wire} rides the slow (dcn) axis; the fast-axis "
+            "reduce-scatter leg has no quantized wire format")
 
     dtype = flat.dtype
     size = int(flat.shape[0])
@@ -177,14 +179,16 @@ def hierarchical_allreduce_start(flat, res: ResolvedTransport,
         res=res, op=op, n_total=n_total, size=size, pad=pad, dtype=dtype,
         gathered=gathered, slow_done=not res.slow_axes, shard=shard)
 
-    if res.slow_axes and res.slow.wire == "int8":
+    if res.slow_axes and quant_wire_leg(res.slow.wire) is not None:
         # The bandwidth-heavy slow wire hop (the all_to_all carrying
-        # int8 payloads) is issued at start so the overlap scheduler
-        # can hide it; the dequant-accumulate half rides finish.
+        # int8/int4 payloads) is issued at start so the overlap
+        # scheduler can hide it; the dequant-accumulate half rides
+        # finish.
         from ..quant.collectives import quantized_allreduce_start
 
         inflight.quant_state = quantized_allreduce_start(
-            shard, res.slow_axes[0], op=ReduceOp.SUM)
+            shard, res.slow_axes[0], op=ReduceOp.SUM,
+            wire=quant_wire_leg(res.slow.wire))
         inflight.shard = None
         inflight.slow_done = True   # finish side: quant finish only
     return inflight
@@ -318,6 +322,10 @@ def wire_bytes_estimate(res: ResolvedTransport, count: int,
             from ..quant import kernels as qk
 
             total += int(qk.wire_bytes(shard, qk.quant_block_size()))
+        elif res.slow.wire == "int4":
+            from ..quant import kernels as qk
+
+            total += int(qk.wire_bytes_int4(shard, qk.quant_block_size()))
         else:
             slow_item = {"bf16": 2, "fp16": 2}.get(res.slow.wire, itemsize)
             total += 2 * _ring_bytes(shard, slow_item, slow_n)

@@ -8,12 +8,14 @@
     axis           = "ici" | "dcn"            (transport class)
                    | dp|pp|fsdp|ep|sp|tp      (exact mesh-axis name)
     algorithm      = "ring" | "tree" | "2d_ring"
-    wire           = "f32" | "bf16" | "fp16" | "int8"
+    wire           = "f32" | "bf16" | "fp16" | "int8" | "int4"
     threshold      = digits [K|M|G]           (fusion bucket bytes)
 
 e.g. ``ici:ring:f32:64M,dcn:tree:int8:8M`` — big buckets ride the
 bandwidth-optimal reduce-scatter/allgather split on ICI at f32 while the
-cross-pod shard exchange goes latency-optimal tree at ~1 B/element.
+cross-pod shard exchange goes latency-optimal tree at ~1 B/element
+(``int4``: the packed sub-byte wire, ~0.5 B/element — same dcn-only
+placement rule as int8).
 ``auto`` derives the sane default from the mesh topology convention
 (parallel/mesh.py: innermost axis = ICI, outer = DCN): ICI rings at f32
 with the global fusion threshold, DCN trees at f32 with 8 MiB buckets.
@@ -54,10 +56,12 @@ log = get_logger(__name__)
 __all__ = ["AxisPolicy", "ResolvedTransport", "TransportPolicy",
            "parse_transport", "get_policy", "resolve_axis",
            "bucket_threshold", "enabled", "reset", "validate_env",
-           "ALGORITHMS", "WIRES", "VALID_AXES"]
+           "ALGORITHMS", "WIRES", "QUANT_WIRES", "VALID_AXES"]
 
 ALGORITHMS: Tuple[str, ...] = ("ring", "tree", "2d_ring")
-WIRES: Tuple[str, ...] = ("f32", "bf16", "fp16", "int8")
+WIRES: Tuple[str, ...] = ("f32", "bf16", "fp16", "int8", "int4")
+# Block-scaled quantized wires: slow-axis (dcn) only, single slow axis.
+QUANT_WIRES: Tuple[str, ...] = ("int8", "int4")
 VALID_AXES: Tuple[str, ...] = _mesh.TRANSPORT_CLASSES + _mesh.CANONICAL_AXES
 
 _AUTO_DCN_THRESHOLD = 8 * 1024 * 1024
@@ -136,11 +140,13 @@ def parse_transport(spec: str) -> Dict[str, AxisPolicy]:
             raise ValueError(
                 f"unknown HVDT_TRANSPORT wire {wire!r} for axis {axis!r}; "
                 f"valid: {', '.join(WIRES)}")
-        if axis == _mesh.TRANSPORT_ICI and wire == "int8":
+        if axis == _mesh.TRANSPORT_ICI and wire in QUANT_WIRES:
             raise ValueError(
-                "HVDT_TRANSPORT: int8 rides the slow (dcn) axis — the "
-                "fast-axis reduce-scatter leg has no int8 wire format; "
-                "put int8 on dcn (e.g. dcn:tree:int8:8M)")
+                f"HVDT_TRANSPORT: {wire} rides the slow (dcn) axis — "
+                f"the fast-axis reduce-scatter leg has no quantized "
+                f"wire format; put {wire} on dcn (e.g. "
+                f"dcn:tree:{wire}:8M).  Valid wires: {', '.join(WIRES)} "
+                f"(quantized: {', '.join(QUANT_WIRES)}, dcn-only)")
         if axis in entries:
             raise ValueError(
                 f"duplicate HVDT_TRANSPORT axis {axis!r}")
@@ -211,11 +217,11 @@ class TransportPolicy:
             slow_axes, fast_axes = _mesh.split_transport_axes(axes, width)
             slow = self._lookup(slow_axes[0], _mesh.TRANSPORT_DCN) \
                 or AxisPolicy("tree")
-            if slow.wire == "int8" and len(slow_axes) != 1:
+            if slow.wire in QUANT_WIRES and len(slow_axes) != 1:
                 raise ValueError(
-                    f"int8 slow-axis wire needs exactly one slow axis, "
-                    f"got {slow_axes} (quantized allreduce reduces over "
-                    f"ONE mesh axis)")
+                    f"{slow.wire} slow-axis wire needs exactly one slow "
+                    f"axis, got {slow_axes} (quantized allreduce reduces "
+                    f"over ONE mesh axis)")
             threshold = (fast.threshold_bytes
                          if fast.threshold_bytes is not None
                          else slow.threshold_bytes)

@@ -479,8 +479,9 @@ class TestDeviation:
         ratio = reg.gauge("hvdt_perf_deviation_ratio").value()
         assert ratio == pytest.approx(1.0, abs=0.05)
         doc = tstats.expected_vs_observed_doc()
+        # the doc rounds to 9 decimals — allow the half-quantum
         assert doc["predicted_comm_s"] == pytest.approx(
-            exp.comm_exposed_s)
+            exp.comm_exposed_s, abs=5e-10)
         assert doc["deviation_ratio"] == pytest.approx(ratio, abs=1e-3)
         assert doc["fingerprint"] == "overlap-hier"
 

@@ -168,6 +168,17 @@ def _parse_args(argv=None):
                     help="Seconds of sustained client fire for --serve.")
     ap.add_argument("--serve-threads", type=int, default=8,
                     help="Concurrent HTTP client threads for --serve.")
+    ap.add_argument("--serve-llm", action="store_true",
+                    help="LLM decode engine comparison on the CPU sim: "
+                         "the same mixed-prefill-length greedy-decode "
+                         "workload through the static shape-bucket "
+                         "engine (full re-forward per token) and the "
+                         "continuous paged-KV engine; emits tokens/s "
+                         "for both and the speedup multiple.")
+    ap.add_argument("--serve-llm-requests", type=int, default=12,
+                    help="Concurrent sequences for --serve-llm.")
+    ap.add_argument("--serve-llm-new-tokens", type=int, default=16,
+                    help="Tokens generated per sequence for --serve-llm.")
     ap.add_argument("--report", action="store_true",
                     help="After the run, render the post-mortem "
                          "markdown report (analysis --report) from the "
@@ -262,6 +273,85 @@ def _run_serve_child(args) -> None:
         "duration_s": round(dt, 2),
         "buckets": list(buckets),
         "steady_state_compiles": engine.compile_count() - warm_compiles,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+    }))
+
+
+def _run_serve_llm_child(args) -> None:
+    """LLM engine comparison (child process): static bucket engine vs
+    continuous paged-KV engine on the SAME greedy-decode workload —
+    mixed prompt lengths, one token per step.  The static path pays what
+    it actually pays in production (a full padded forward per emitted
+    token); the continuous path runs the paged decode step.  Prints one
+    JSON line with tokens/s for both and the multiple."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models.transformer import (TransformerConfig,
+                                                transformer_apply,
+                                                transformer_init)
+    from horovod_tpu.serve import InferenceEngine
+    from horovod_tpu.serve.llm import ContinuousLLMEngine
+
+    dev = jax.devices()[0]
+    print(f"serve-llm bench on {dev.platform}:{dev.device_kind}",
+          file=sys.stderr)
+    seq_len = 128
+    cfg = TransformerConfig(vocab=512, layers=2, d_model=128, heads=4,
+                            kv_heads=4, d_ff=256, max_seq=seq_len,
+                            dtype=jnp.float32)
+    params = transformer_init(jax.random.PRNGKey(0), cfg)
+    n_req = int(args.serve_llm_requests)
+    max_new = int(args.serve_llm_new_tokens)
+    rng = np.random.default_rng(0)
+    prompts = [[int(t) for t in
+                rng.integers(1, cfg.vocab, size=int(rng.integers(4, 48)))]
+               for _ in range(n_req)]
+    total_tokens = n_req * max_new
+
+    # -- static baseline: greedy decode through the bucket engine -------
+    apply_fn = lambda p, x: transformer_apply(p, x, cfg)   # noqa: E731
+    static = InferenceEngine(apply_fn, params, buckets=(n_req,))
+    static.warmup((seq_len,), dtype=np.int32)
+    seqs = [list(p) for p in prompts]
+    t0 = time.perf_counter()
+    for _ in range(max_new):
+        x = np.zeros((n_req, seq_len), np.int32)
+        for i, s in enumerate(seqs):
+            x[i, :len(s)] = s[-seq_len:]
+        y = static.infer(x)
+        for i, s in enumerate(seqs):
+            s.append(int(np.argmax(y[i, len(s) - 1])))
+    static_dt = time.perf_counter() - t0
+    static_tps = total_tokens / static_dt
+
+    # -- continuous engine ----------------------------------------------
+    eng = ContinuousLLMEngine(params, cfg, auto_start=False)
+    eng.warmup()
+    warm_compiles = eng.compile_count()
+    futs = [eng.submit(p, max_new_tokens=max_new,
+                       tenant=("batch" if i % 3 == 0 else "interactive"))
+            for i, p in enumerate(prompts)]
+    t0 = time.perf_counter()
+    while not all(f.done() for f in futs):
+        eng.step()
+    cont_dt = time.perf_counter() - t0
+    for f in futs:
+        f.result(timeout=1)
+    cont_tps = total_tokens / cont_dt
+    eng.alloc.check()
+    print(json.dumps({
+        "metric": "serve_llm_speedup",
+        "value": round(cont_tps / static_tps, 3),
+        "unit": "x",
+        "static_tokens_per_sec": round(static_tps, 2),
+        "continuous_tokens_per_sec": round(cont_tps, 2),
+        "requests": n_req,
+        "new_tokens_per_request": max_new,
+        "steady_state_compiles": eng.compile_count() - warm_compiles,
+        "preemptions": eng.sched.preemptions,
         "platform": dev.platform,
         "device_kind": dev.device_kind,
     }))
@@ -950,10 +1040,33 @@ def _spawn(child_args, timeout_s, cpu_only=False):
 def main() -> None:
     args = _parse_args()
     if args._child:
-        if args.serve:
+        if args.serve_llm:
+            _run_serve_llm_child(args)
+        elif args.serve:
             _run_serve_child(args)
         else:
             _run_child(args)
+        return
+
+    if args.serve_llm:
+        # LLM engine comparison: one accelerator attempt, then a
+        # scrubbed CPU fallback (the CPU sim IS the reference workload).
+        llm_args = ["--serve-llm",
+                    "--serve-llm-requests", str(args.serve_llm_requests),
+                    "--serve-llm-new-tokens",
+                    str(args.serve_llm_new_tokens)]
+        timeout = int(os.environ.get("HVDT_BENCH_SERVE_TIMEOUT", "300"))
+        ok, line, note = _spawn(llm_args, timeout)
+        if not ok or not line:
+            print(f"serve-llm bench attempt failed: {note}",
+                  file=sys.stderr)
+            ok, line, note = _spawn(llm_args, timeout, cpu_only=True)
+        if ok and line:
+            print(line)
+        else:
+            print(json.dumps({"metric": "serve_llm_speedup",
+                              "value": 0.0, "unit": "x",
+                              "error": note}))
         return
 
     if args.serve:

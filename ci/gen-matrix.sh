@@ -15,7 +15,9 @@
 #       elastic-serving-control-plane/router/autoscaler +
 #       static-analysis/schedule-fingerprint +
 #       static-cost-model/perf-gate +
-#       live-attribution/time-series/anomaly-detection tests on
+#       live-attribution/time-series/anomaly-detection +
+#       continuous-batching-llm-serve (paged KV / scheduler /
+#       prefix-sharing / ring-prefill) tests on
 #       CPU) — the pre-merge gate.  The full matrix additionally
 #       emits the `analysis` service: python -m horovod_tpu.analysis
 #       --all --perf as a hard gate over the hvdt-lint ratchet

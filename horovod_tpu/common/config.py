@@ -529,6 +529,60 @@ KNOBS: Dict[str, Knob] = {
            "past it is duplicated to a second replica and the first "
            "response wins.  0 = adaptive (hedge past ~2x the router's "
            "observed p99, floored at 50 ms); negative = hedging off."),
+        # --- continuous-batching LLM decode engine (serve/llm: paged KV
+        #     cache, per-iteration scheduler, jitted decode loop) ---
+        _k("HVDT_SERVE_ENGINE", "static", str,
+           "Serving engine: 'static' (the shape-bucket InferenceEngine) "
+           "or 'continuous' (the serve/llm continuous-batching decode "
+           "engine with a paged KV cache; --model transformer only).  "
+           "The router and autoscaler are engine-agnostic."),
+        _k("HVDT_KV_BLOCK_SIZE", 16, int,
+           "Tokens per paged-KV-cache block.  Smaller blocks waste less "
+           "tail capacity per sequence but grow the block tables; the "
+           "decode step's gather shape is [slots, blocks_per_seq * "
+           "block_size], so block_size * HVDT_KV_SEQ_BLOCKS bounds "
+           "context length."),
+        _k("HVDT_KV_BLOCKS", 128, int,
+           "Total paged-KV-cache block budget per engine (physical "
+           "block 0 is the write sink for inactive decode slots and is "
+           "never allocated).  The scheduler admits/evicts against this "
+           "budget; HBM cost is 2 * layers * blocks * block_size * "
+           "kv_heads * head_dim * dtype bytes."),
+        _k("HVDT_KV_SEQ_BLOCKS", 8, int,
+           "Block-table length per sequence (max context = this * "
+           "HVDT_KV_BLOCK_SIZE tokens).  Fixed so the decode step's "
+           "gather never changes shape — the zero-recompile contract."),
+        _k("HVDT_SERVE_DECODE_SLOTS", 8, int,
+           "Decode-slot count of the continuous engine: sequences "
+           "decoded per iteration.  Fixed shape — admission/eviction "
+           "swaps sequences in and out of slots without recompiling."),
+        _k("HVDT_SERVE_PREFILL_CHUNK", 64, int,
+           "Prefill chunk length (tokens) of the continuous engine.  "
+           "Long prompts stream through in chunks of this size, one "
+           "chunk per iteration, so a long prefill never stalls decode "
+           "for more than one chunk's worth of compute (decode-p99 "
+           "disaggregation)."),
+        _k("HVDT_SERVE_MAX_NEW_TOKENS", 32, int,
+           "Default generation budget per request for the continuous "
+           "engine (a request's max_new_tokens field overrides, capped "
+           "by the context bound)."),
+        _k("HVDT_SERVE_INT8", False, _parse_bool,
+           "Serve transformer weights block-scaled int8 (quant/kernels "
+           "quantize_flat) in the continuous engine: eligible matmul "
+           "weights are stored int8+scales in HBM and dequantized "
+           "inside the jitted step — ~4x weight-HBM density per "
+           "replica, unchanged request API."),
+        _k("HVDT_SERVE_BATCH_QUOTA", 0.5, float,
+           "Ceiling fraction of decode slots the 'batch' tenant class "
+           "may hold.  The live quota adapts below this off the "
+           "interactive-tenant queue-wait time series (telemetry/"
+           "history.Series): sustained interactive waiting shrinks the "
+           "batch share, an idle interactive queue restores it."),
+        _k("HVDT_SERVE_RING_PREFILL", 0, int,
+           "Sequence-parallel degree for long-context prefill in the "
+           "continuous engine: prompts spanning at least half the "
+           "context ride a shard_map ring_attention island over this "
+           "many devices (0/1 = chunked single-device prefill only)."),
         # --- host data plane (ref: HOROVOD_CPU_OPERATIONS common.h:127-128,
         #     LibType selection env_parser.cc) ---
         _k("HVDT_CPU_OPERATIONS", "xla", str,

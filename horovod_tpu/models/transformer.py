@@ -42,6 +42,8 @@ __all__ = [
     "TransformerConfig", "transformer_init", "transformer_apply",
     "transformer_loss", "transformer_logical_axes",
     "transformer_flops_per_token", "remat_from_env", "checkpoint_policy",
+    "transformer_decode_paged", "transformer_prefill_paged",
+    "transformer_prefill_collect",
 ]
 
 
@@ -193,14 +195,25 @@ def _proj(x, w):
     return x @ w.astype(x.dtype)
 
 
-def _attention(p, x, positions, cfg: TransformerConfig):
-    b, l, d = x.shape
+def _qkv(p, x, positions, cfg: TransformerConfig):
+    """Rotated q/k/v projections — the one place the projection + RoPE
+    recipe lives, shared by training attention (:func:`_attention`) and
+    the serving paged-KV prefill/decode paths, so the cache can never
+    hold keys rotated differently from the ones training computed."""
+    b, l, _ = x.shape
     h, hk, dh = cfg.heads, cfg.kv_heads, cfg.head_dim
     q = _proj(x, p["wq"]).reshape(b, l, h, dh)
     k = _proj(x, p["wk"]).reshape(b, l, hk, dh)
     v = _proj(x, p["wv"]).reshape(b, l, hk, dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _attention(p, x, positions, cfg: TransformerConfig):
+    b, l, d = x.shape
+    h, hk, dh = cfg.heads, cfg.kv_heads, cfg.head_dim
+    q, k, v = _qkv(p, x, positions, cfg)
     flash_plan = None if cfg.sp > 1 else _flash_plan(b, l, h, hk, dh)
     if cfg.sp > 1:
         # Manual island: the sequence dim is the local sp shard here (the
@@ -697,6 +710,193 @@ def transformer_loss(params: Dict, tokens: jax.Array,
     logp = jax.nn.log_softmax(logits, -1)
     ll = jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
     return -ll.mean()
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV serving paths (serve/llm continuous-batching engine).
+#
+# The cache layout is ``[layers, num_blocks, block_size, kv_heads,
+# head_dim]`` — fixed-size physical blocks indexed per sequence through a
+# block table (``serve/llm/kv_cache.py`` owns allocation; this module
+# owns the math).  All three entry points have FIXED shapes in every
+# argument, so admission/eviction of sequences between iterations can
+# never change a jitted program: that is the zero-steady-state-recompile
+# contract the static bucket engine pioneered, carried into decode.
+#
+# Physical block 0 is the write SINK: inactive decode slots and padded
+# prefill positions scatter their k/v there, where no block table ever
+# points (the allocator never hands block 0 out), so masked lanes stay
+# harmless without a single dynamic shape.
+# ---------------------------------------------------------------------------
+
+
+def _masked_softmax_attn(q, keys, vals, mask):
+    """Attention with an explicit mask and a clamped denominator.
+
+    q: [B, Lq, H, D]; keys/vals: [B, T, Hkv, D]; mask: [B, Lq, T] bool.
+    Fully-masked rows (inactive decode slots, padded prefill lanes)
+    return exactly 0 instead of NaN — ``jax.nn.softmax`` over an
+    all-masked row is 0/0, and one NaN hidden row would poison every
+    *valid* row at the next layer through its scattered k/v."""
+    h, hkv = q.shape[2], keys.shape[2]
+    if h != hkv:
+        keys = jnp.repeat(keys, h // hkv, axis=2)
+        vals = jnp.repeat(vals, h // hkv, axis=2)
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, keys,
+                   preferred_element_type=jnp.float32) * scale
+    m = mask[:, None]                                   # [B, 1, Lq, T]
+    s = jnp.where(m, s, -1e30)
+    smax = jax.lax.stop_gradient(s.max(axis=-1, keepdims=True))
+    p = jnp.where(m, jnp.exp(s - smax), 0.0)
+    denom = jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-9)
+    w = (p / denom).astype(vals.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, vals)
+
+
+def transformer_decode_paged(params, tokens, block_tables, seq_lens,
+                             kc, vc, cfg: TransformerConfig,
+                             block_size: int):
+    """One continuous-batching decode iteration over the paged cache.
+
+    tokens [S] int32 (each slot's current last token), block_tables
+    [S, maxb] int32 physical block ids, seq_lens [S] int32 (tokens in
+    the sequence INCLUDING the one decoded now; 0 = inactive slot),
+    kc/vc [L, num_blocks, block_size, kv_heads, head_dim].
+
+    Per layer: scatter this token's k/v at position ``seq_len - 1``
+    (inactive slots scatter into sink block 0), gather the whole block
+    table, attend over key positions ``< seq_len``.  Returns
+    ``(next_tokens [S] int32, kc, vc)`` — greedy argmax stays in-graph
+    so the host transfer per iteration is S ints, not S×vocab logits.
+    """
+    s_slots = tokens.shape[0]
+    maxb = block_tables.shape[1]
+    active = seq_lens > 0
+    pos = jnp.maximum(seq_lens - 1, 0)                         # [S]
+    x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]  # [S,1,d]
+    slot_idx = jnp.arange(s_slots)
+    key_pos = jnp.arange(maxb * block_size)
+    attn_mask = key_pos[None, :] < seq_lens[:, None]           # [S, T]
+
+    def body(h_carry, layer):
+        p, kc_l, vc_l = layer
+        hx = _rmsnorm(h_carry, p["ln1"])
+        q, k, v = _qkv(p, hx, pos[:, None], cfg)
+        blk = jnp.where(active,
+                        block_tables[slot_idx, pos // block_size], 0)
+        off = pos % block_size
+        kc_l = kc_l.at[blk, off].set(k[:, 0].astype(kc_l.dtype))
+        vc_l = vc_l.at[blk, off].set(v[:, 0].astype(vc_l.dtype))
+        keys = kc_l[block_tables].reshape(
+            s_slots, maxb * block_size, *kc_l.shape[2:])
+        vals = vc_l[block_tables].reshape(
+            s_slots, maxb * block_size, *vc_l.shape[2:])
+        o = _masked_softmax_attn(q, keys.astype(cfg.dtype),
+                                 vals.astype(cfg.dtype),
+                                 attn_mask[:, None, :])
+        h_carry = h_carry + _proj(
+            o.reshape(s_slots, 1, -1), p["wo"])
+        h_carry = h_carry + _mlp(p, _rmsnorm(h_carry, p["ln2"]))
+        return h_carry, (kc_l, vc_l)
+
+    x, (kc, vc) = lax.scan(body, x, (params["block"], kc, vc))
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x[:, 0] @ params["embed"].astype(x.dtype).T
+              ).astype(jnp.float32)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return nxt, kc, vc
+
+
+def transformer_prefill_paged(params, tokens, ctx_start, n_valid,
+                              block_table, kc, vc,
+                              cfg: TransformerConfig, block_size: int):
+    """One prefill CHUNK of one sequence into the paged cache.
+
+    tokens [C] int32 (zero-padded past ``n_valid``), ctx_start scalar
+    int32 (global position of tokens[0]), n_valid scalar int32,
+    block_table [maxb] int32.  Scatters the chunk's k/v at its global
+    positions (padded lanes go to sink block 0), then attends each chunk
+    query over the WHOLE table — chunk i sees chunks 0..i-1 from the
+    cache plus its own just-scattered keys, which is what lets a long
+    prompt stream through in fixed-shape chunks without ever stalling
+    decode for more than one chunk.  Returns ``(kc, vc)``; the last
+    prompt token is deliberately NOT prefilled — it enters through the
+    decode step, which produces the first generated token.
+    """
+    c = tokens.shape[0]
+    maxb = block_table.shape[0]
+    pos = ctx_start + jnp.arange(c)                            # [C]
+    valid = jnp.arange(c) < n_valid
+    x = params["embed"].astype(cfg.dtype)[tokens][None]        # [1,C,d]
+    key_pos = jnp.arange(maxb * block_size)
+    # Causal by global position, bounded by what exists after this
+    # chunk scatters; padded queries are fully masked.
+    attn_mask = ((key_pos[None, :] <= pos[:, None])
+                 & (key_pos[None, :] < ctx_start + n_valid)
+                 & valid[:, None])[None]                       # [1,C,T]
+
+    def body(h_carry, layer):
+        p, kc_l, vc_l = layer
+        hx = _rmsnorm(h_carry, p["ln1"])
+        q, k, v = _qkv(p, hx, pos[None], cfg)
+        blk = jnp.where(valid, block_table[pos // block_size], 0)
+        off = pos % block_size
+        kc_l = kc_l.at[blk, off].set(k[0].astype(kc_l.dtype))
+        vc_l = vc_l.at[blk, off].set(v[0].astype(vc_l.dtype))
+        keys = kc_l[block_table].reshape(
+            1, maxb * block_size, *kc_l.shape[2:])
+        vals = vc_l[block_table].reshape(
+            1, maxb * block_size, *vc_l.shape[2:])
+        o = _masked_softmax_attn(q, keys.astype(cfg.dtype),
+                                 vals.astype(cfg.dtype), attn_mask)
+        h_carry = h_carry + _proj(o.reshape(1, c, -1), p["wo"])
+        h_carry = h_carry + _mlp(p, _rmsnorm(h_carry, p["ln2"]))
+        return h_carry, (kc_l, vc_l)
+
+    _, (kc, vc) = lax.scan(body, x, (params["block"], kc, vc))
+    return kc, vc
+
+
+def transformer_prefill_collect(params, tokens, cfg: TransformerConfig):
+    """Whole-prompt prefill that RETURNS every layer's rotated k/v.
+
+    The long-context prefill path: called inside a ``shard_map`` over
+    the ``sp`` axis when ``cfg.sp > 1``, so attention runs as the exact
+    :func:`~horovod_tpu.parallel.ring_attention.ring_attention` ring
+    while each shard emits its local k/v slab; the caller's out_specs
+    reassemble ``[L, B, S, kv_heads, head_dim]`` slabs that the serving
+    engine scatters into the paged cache in one shot.  tokens
+    [B, S_local] int32.  Returns ``(k_all, v_all)``.
+    """
+    b, l = tokens.shape
+    if cfg.sp > 1:
+        offset = lax.axis_index("sp") * l
+    else:
+        offset = 0
+    positions = offset + jnp.broadcast_to(jnp.arange(l), (b, l))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if cfg.sp > 1:
+        # Ring transfers make activations varying on sp; the scan carry
+        # must be type-stable under vma (transformer_hidden idiom).
+        from ..parallel.sharding import pcast_to_union
+
+        x = pcast_to_union(x, extra=("sp",))
+
+    def body(h_carry, p):
+        hx = _rmsnorm(h_carry, p["ln1"])
+        q, k, v = _qkv(p, hx, positions, cfg)
+        if cfg.sp > 1:
+            o = ring_attention(q, k, v, axis="sp", causal=True)
+        else:
+            mask = jnp.tril(jnp.ones((l, l), bool))[None]
+            o = _masked_softmax_attn(q, k, v, mask)
+        h_carry = h_carry + _proj(o.reshape(b, l, -1), p["wo"])
+        h_carry = h_carry + _mlp(p, _rmsnorm(h_carry, p["ln2"]))
+        return h_carry, (k, v)
+
+    _, (k_all, v_all) = lax.scan(body, x, params["block"])
+    return k_all, v_all
 
 
 def transformer_flops_per_token(cfg: TransformerConfig) -> float:

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.device import _axis_size_static
+
 __all__ = ["ring_attention"]
 
 _NEG_INF = -1e30

@@ -370,8 +370,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # blacklist/drain machinery, e.g.
         #   hvdtrun serve --checkpoint /ckpts --replicas 3 --autoscale \
         #       --slo-p99-ms 250
-        # Flags after `serve` are the serve CLI's (see
-        # horovod_tpu/serve/__main__.py).
+        # `--engine continuous` (or HVDT_SERVE_ENGINE=continuous) swaps
+        # each replica's static bucket engine for the paged-KV
+        # continuous-batching LLM decode engine (serve/llm) — the fleet
+        # flags compose unchanged.  Flags after `serve` are the serve
+        # CLI's (see horovod_tpu/serve/__main__.py).
         from ..serve import main as serve_main
 
         return serve_main(argv[1:])

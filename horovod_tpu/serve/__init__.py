@@ -10,6 +10,10 @@ from millions of users").  Layering, bottom up:
   weight swap, optional mesh sharding;
 * :mod:`~horovod_tpu.serve.batcher` — :class:`DynamicBatcher`: bounded
   admission queue + linger-based micro-batching ahead of the engine;
+* :mod:`~horovod_tpu.serve.llm`     — :class:`ContinuousLLMEngine`:
+  continuous-batching LLM decode (paged KV cache, per-iteration
+  scheduler, interactive/batch tenant quotas), selected with
+  ``HVDT_SERVE_ENGINE=continuous``;
 * :mod:`~horovod_tpu.serve.reload`  — :class:`CheckpointWatcher`: polls a
   ``CheckpointManager`` directory and hot-swaps newer steps;
 * :mod:`~horovod_tpu.serve.server`  — :class:`ModelServer`: stdlib HTTP
@@ -43,8 +47,18 @@ __all__ = [
     "InferenceEngine", "DynamicBatcher", "BackpressureError",
     "DispatcherDied", "RequestDeadlineExceeded",
     "CheckpointWatcher", "ModelServer", "MetricsRegistry",
-    "parse_buckets", "main",
+    "parse_buckets", "ContinuousLLMEngine", "main",
 ]
+
+
+def __getattr__(name):
+    # Lazy: serve.llm pulls in jax at engine-build time; the fleet layer
+    # (router/autoscale) must stay importable without touching it.
+    if name == "ContinuousLLMEngine":
+        from .llm import ContinuousLLMEngine
+
+        return ContinuousLLMEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def main(argv=None) -> int:

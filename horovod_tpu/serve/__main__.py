@@ -48,6 +48,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--buckets", default=None,
                    help="Comma batch-size bucket ladder (default: "
                         "HVDT_SERVE_BUCKETS).")
+    p.add_argument("--engine", choices=("static", "continuous"),
+                   default=None,
+                   help="Inference engine: 'static' shape buckets or the "
+                        "'continuous' paged-KV LLM decode engine "
+                        "(transformer only; default: HVDT_SERVE_ENGINE).")
     p.add_argument("--max-batch-size", type=int, default=None)
     p.add_argument("--max-delay-ms", type=float, default=None)
     p.add_argument("--max-queue-depth", type=int, default=None)
@@ -124,9 +129,18 @@ def build_server(args):
     import jax
     import numpy as np
 
+    from ..common import config
     from .engine import InferenceEngine, parse_buckets
     from .server import ModelServer
 
+    engine_kind = (args.engine if getattr(args, "engine", None)
+                   else config.get_str("HVDT_SERVE_ENGINE"))
+    if engine_kind not in ("static", "continuous"):
+        raise ValueError(f"HVDT_SERVE_ENGINE={engine_kind!r}: expected "
+                         "'static' or 'continuous'")
+    if engine_kind == "continuous" and args.model != "transformer":
+        raise ValueError("--engine continuous requires --model "
+                         "transformer (paged KV decode is an LLM path)")
     buckets = parse_buckets(args.buckets)
     if args.model == "mlp":
         from ..models.mlp import mlp_apply, mlp_init
@@ -149,8 +163,14 @@ def build_server(args):
         feat_shape = (args.seq,)
         input_dtype = np.int32
 
-    engine = InferenceEngine(apply_fn, template, buckets=buckets,
-                             compile_cache=args.compilation_cache_dir)
+    if engine_kind == "continuous":
+        from .llm import ContinuousLLMEngine
+
+        engine = ContinuousLLMEngine(
+            template, cfg, compile_cache=args.compilation_cache_dir)
+    else:
+        engine = InferenceEngine(apply_fn, template, buckets=buckets,
+                                 compile_cache=args.compilation_cache_dir)
     server = ModelServer(
         engine, host=args.host, port=args.port,
         checkpoint_dir=args.checkpoint, template=template,

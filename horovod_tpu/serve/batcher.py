@@ -267,6 +267,21 @@ class DynamicBatcher:
         now = time.perf_counter()
         for r in batch:
             self._wait.observe(now - r.enqueued_at)
+        # Deadline re-check at dispatch time: _gather expires requests
+        # when it pops them, but a request can outlive its deadline
+        # BETWEEN gather and here (a slow linger window, a long compile
+        # on the previous group) — running it anyway would burn a batch
+        # slot on an answer nobody is waiting for.
+        live: List[_Request] = []
+        for r in batch:
+            if r.expired(now):
+                self._expired.inc()
+                r.fail(RequestDeadlineExceeded(
+                    f"request expired after gather, before dispatch "
+                    f"(waited {now - r.enqueued_at:.3f}s)"))
+            else:
+                live.append(r)
+        batch = live
         # Group by feature signature: only same-shaped rows concatenate.
         groups: "collections.OrderedDict[Any, List[_Request]]" = \
             collections.OrderedDict()

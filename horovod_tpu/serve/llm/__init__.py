@@ -1,0 +1,26 @@
+"""Continuous-batching LLM serving (paged KV cache + per-iteration
+scheduling).
+
+Selected per replica with ``HVDT_SERVE_ENGINE=continuous`` (the default
+``static`` keeps the shape-bucket :mod:`~horovod_tpu.serve.engine`); the
+fleet layer — router, autoscaler, drain, reload — is engine-agnostic.
+
+* :mod:`.kv_cache` — paged block allocator: free list, per-sequence
+  block tables, refcounted copy-on-write prefix sharing, exact
+  accounting.
+* :mod:`.scheduler` — per-iteration admission/eviction under the block
+  budget; prefill/decode disaggregation; interactive-vs-batch tenant
+  quotas adapted off the telemetry time-series plane.
+* :mod:`.engine` — the fixed-shape jitted programs (paged decode,
+  chunked prefill, CoW copies, optional ring-attention long-context
+  prefill) and the worker loop that runs the iterations.
+"""
+
+from .engine import ContinuousLLMEngine
+from .kv_cache import SINK_BLOCK, PagedKVAllocator, make_kv_cache
+from .scheduler import IterationPlan, IterationScheduler, Sequence
+
+__all__ = [
+    "ContinuousLLMEngine", "PagedKVAllocator", "SINK_BLOCK",
+    "make_kv_cache", "IterationScheduler", "IterationPlan", "Sequence",
+]

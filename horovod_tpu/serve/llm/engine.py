@@ -1,0 +1,642 @@
+"""Continuous-batching LLM engine: jitted paged decode + chunked prefill.
+
+The device half of the subsystem.  Exactly four jitted programs exist,
+every one with FIXED shapes in every argument, so admission, eviction,
+fork, and completion of sequences can never change what XLA runs — the
+static bucket engine's zero-steady-state-recompile contract
+(``serve_compiles_total`` flat after warmup), carried into decode:
+
+* **decode** — one token for every active slot ``[S]`` over block-table
+  gathers (``models.transformer.transformer_decode_paged``); greedy
+  argmax in-graph so the per-iteration host transfer is S ints.
+* **prefill** — one ``HVDT_SERVE_PREFILL_CHUNK``-token chunk of ONE
+  sequence into its blocks; long prompts stream through across
+  iterations while decode keeps running (the disaggregation that holds
+  interactive p99).
+* **copy** — a fixed-length list of block copies (CoW resolutions),
+  padded with harmless ``(0, 0)`` sink self-copies.
+* **ring prefill** (optional, ``HVDT_SERVE_RING_PREFILL > 1``) — a
+  whole-prompt pass under ``shard_map`` over an ``sp`` mesh axis so
+  attention runs as ``parallel/ring_attention.py``'s exact ring; the
+  collected per-layer k/v slabs scatter into the paged cache in one
+  shot.  Long-context prompts prefill in one iteration at ring-attention
+  memory cost instead of ``O(chunks)`` iterations.
+
+Weights serve optionally as int8 (``HVDT_SERVE_INT8``): eligible leaves
+are block-scale quantized once per swap via ``quant/kernels.py`` and
+dequantized INSIDE the jitted programs, so replica HBM holds 1-byte
+weights (plus scales) — the replica-density play — while matmuls run in
+the model dtype.
+
+Threading: submitters enqueue under the engine lock and a worker thread
+runs scheduler iterations; everything device-facing happens on the
+worker (or whoever calls :meth:`step` in tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence as Seq, Tuple
+
+import numpy as np
+
+from ...common import config
+from ...common.logging_util import get_logger
+from ...models.transformer import (TransformerConfig,
+                                   transformer_decode_paged,
+                                   transformer_prefill_collect,
+                                   transformer_prefill_paged)
+from ..batcher import BackpressureError, RequestDeadlineExceeded
+from ..metrics import MetricsRegistry
+from .kv_cache import SINK_BLOCK, PagedKVAllocator, make_kv_cache
+from .scheduler import TENANTS, IterationScheduler, Sequence
+
+__all__ = ["ContinuousLLMEngine"]
+
+log = get_logger(__name__)
+
+
+class ContinuousLLMEngine:
+    """Continuous-batching engine for ``models/transformer.py`` weights.
+
+    Mirrors the static :class:`~horovod_tpu.serve.engine.InferenceEngine`
+    surface that ``server.py``/``replica.py``/healthz rely on
+    (``swap_params``, ``params_version``, ``warmup``, ``compile_count``,
+    ``metrics``, ``buckets``) so the fleet layer — router, autoscaler,
+    drain — works unchanged; requests enter through :meth:`submit`
+    (token ids in, generated token ids out) instead of the batcher.
+    """
+
+    is_continuous = True
+
+    def __init__(self, params: Any, cfg: TransformerConfig, *,
+                 metrics: Optional[MetricsRegistry] = None,
+                 decode_slots: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 seq_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 batch_quota: Optional[float] = None,
+                 int8: Optional[bool] = None,
+                 ring_prefill: Optional[int] = None,
+                 max_queue: int = 256,
+                 auto_start: bool = True,
+                 compile_cache: Optional[str] = None):
+        from ...step_pipeline import enable_compilation_cache
+
+        enable_compilation_cache(compile_cache)
+        # The serving config is single-sequence-parallel and remat-free;
+        # the ring degree applies only inside the ring-prefill program.
+        self._cfg = dataclasses.replace(cfg, sp=1, pp=1, remat=False)
+        self.block_size = int(block_size if block_size is not None
+                              else config.get_int("HVDT_KV_BLOCK_SIZE"))
+        self.num_blocks = int(num_blocks if num_blocks is not None
+                              else config.get_int("HVDT_KV_BLOCKS"))
+        self.seq_blocks = int(seq_blocks if seq_blocks is not None
+                              else config.get_int("HVDT_KV_SEQ_BLOCKS"))
+        self.decode_slots = int(
+            decode_slots if decode_slots is not None
+            else config.get_int("HVDT_SERVE_DECODE_SLOTS"))
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else config.get_int("HVDT_SERVE_PREFILL_CHUNK"))
+        self.default_max_new = config.get_int("HVDT_SERVE_MAX_NEW_TOKENS")
+        self._int8 = bool(int8 if int8 is not None
+                          else config.get_bool("HVDT_SERVE_INT8"))
+        self._ring = int(ring_prefill if ring_prefill is not None
+                         else config.get_int("HVDT_SERVE_RING_PREFILL"))
+        self.max_queue = int(max_queue)
+        self.max_context = self.seq_blocks * self.block_size
+
+        self.alloc = PagedKVAllocator(self.num_blocks, self.block_size)
+        self.sched = IterationScheduler(
+            self.alloc, decode_slots=self.decode_slots,
+            prefill_chunk=self.prefill_chunk, seq_blocks=self.seq_blocks,
+            batch_quota=batch_quota)
+        self._kc, self._vc = make_kv_cache(self._cfg, self.num_blocks,
+                                           self.block_size)
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._build_metrics()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._stopping = False
+        self._worker: Optional[threading.Thread] = None
+        self._auto_start = bool(auto_start)
+        self._version = 0
+        self._seen_sigs: Dict[str, set] = {}
+        self._tps_ema = 0.0
+        self._treedef = None
+        self._plan: Tuple = ()
+        self._packed: List[Any] = []
+        self._set_params(params)
+        self._build_jits()
+        self._ring_built = False
+
+    # -- metrics -----------------------------------------------------------
+
+    def _build_metrics(self) -> None:
+        m = self.metrics
+        self._compiles = m.counter(
+            "serve_compiles_total",
+            "XLA compilations triggered by inference (flat after warmup "
+            "means the shape buckets are doing their job)")
+        self._requests = m.counter(
+            "serve_requests_total", "Requests accepted by the server")
+        self._expired = m.counter(
+            "serve_deadline_expired_total",
+            "Requests failed with RequestDeadlineExceeded before "
+            "dispatch")
+        self._iterations = m.counter(
+            "hvdt_engine_iterations_total",
+            "Continuous-batching scheduler iterations executed")
+        self._decode_tokens = m.counter(
+            "hvdt_engine_decode_tokens_total",
+            "Tokens emitted by the paged decode step")
+        self._prefill_tokens = m.counter(
+            "hvdt_engine_prefill_tokens_total",
+            "Prompt tokens written into the paged KV cache")
+        self._preempt_total = m.counter(
+            "hvdt_engine_preemptions_total",
+            "Sequences evicted (blocks reclaimed; recompute on return)")
+        self._prefix_hits = m.counter(
+            "hvdt_engine_prefix_hits_total",
+            "Admissions served by forking a live prompt's block table "
+            "(copy-on-write prefix sharing; prefill skipped)")
+        self._admissions = m.counter(
+            "hvdt_engine_admissions_total",
+            "Sequences admitted to the block budget, by tenant")
+        self._tps = m.gauge(
+            "hvdt_engine_tokens_per_sec",
+            "Decode throughput (EMA over iterations)")
+        self._g_blocks_total = m.gauge(
+            "hvdt_engine_kv_blocks_total",
+            "Allocatable KV blocks (sink excluded)")
+        self._g_blocks_total.set(float(self.alloc.capacity))
+        g_used = m.gauge("hvdt_engine_kv_blocks_in_use",
+                         "KV blocks held by live block tables (live probe)")
+        g_used.set_function(lambda: self.alloc.used_blocks)
+        g_live = m.gauge("hvdt_engine_active_seqs",
+                         "Admitted (prefilling or decoding) sequences "
+                         "(live probe)")
+        g_live.set_function(lambda: len(self.sched.admitted))
+        self._g_quota = m.gauge(
+            "hvdt_engine_batch_quota_slots",
+            "Decode slots the batch tenant may hold (adaptive)")
+        self._g_queue = m.gauge(
+            "hvdt_engine_queue_depth",
+            "Waiting (not yet admitted) sequences, by tenant")
+        # The autoscaler's leading load signal; the batcher registers
+        # this on the static path — here waiting sequences are the queue.
+        g_depth = m.gauge(
+            "serve_queue_depth",
+            "Requests admitted and not yet dispatched")
+        g_depth.set_function(lambda: self.sched.queue_depth())
+        self._s_decode = m.summary(
+            "hvdt_engine_decode_step_seconds",
+            "Wall time of one paged decode iteration")
+        self._s_prefill = m.summary(
+            "hvdt_engine_prefill_chunk_seconds",
+            "Wall time of one prefill chunk (or ring prefill shot)")
+        self._s_wait = {
+            t: m.summary(f"hvdt_engine_wait_ms_{t}",
+                         f"Submit-to-first-token latency, {t} tenant (ms)")
+            for t in TENANTS}
+
+    # -- params / int8 packing ---------------------------------------------
+
+    def _set_params(self, params: Any) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree.flatten(params)
+        plan: List[Optional[Tuple]] = []
+        packed: List[Any] = []
+        if self._int8:
+            from ...quant.kernels import quant_block_size, quantize_flat
+
+            qb = quant_block_size()
+            self._qblock = qb
+            for leaf in leaves:
+                arr = jnp.asarray(leaf)
+                if (jnp.issubdtype(arr.dtype, jnp.floating)
+                        and arr.size >= qb and arr.size % qb == 0):
+                    q, s = quantize_flat(
+                        jnp.ravel(arr).astype(jnp.float32), qb)
+                    plan.append((arr.shape, arr.dtype))
+                    packed.append((q, s))
+                else:
+                    plan.append(None)
+                    packed.append(arr)
+        else:
+            self._qblock = 0
+            for leaf in leaves:
+                plan.append(None)
+                packed.append(jnp.asarray(leaf))
+        self._treedef = treedef
+        self._plan = tuple(plan)
+        self._packed = packed
+
+    def _materialize(self, packed):
+        """Rebuild the param pytree inside a traced program (dequantizing
+        int8 leaves in-graph — HBM holds bytes, matmuls see floats)."""
+        import jax
+
+        from ...quant.kernels import dequantize_flat
+
+        leaves = []
+        for spec, item in zip(self._plan, packed):
+            if spec is None:
+                leaves.append(item)
+            else:
+                shape, dt = spec
+                q, s = item
+                leaves.append(dequantize_flat(q, s, self._qblock)
+                              .reshape(shape).astype(dt))
+        return jax.tree.unflatten(self._treedef, leaves)
+
+    def swap_params(self, params: Any) -> int:
+        """Hot weight swap (reload watcher contract): repack (and
+        requantize) under the lock; in-flight iterations finish on the
+        reference they captured.  Same shapes ⇒ zero recompiles."""
+        with self._lock:
+            self._set_params(params)
+            self._version += 1
+            return self._version
+
+    @property
+    def params_version(self) -> int:
+        return self._version
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        """Shape-bucket ladder analogue: one fixed decode batch."""
+        return (self.decode_slots,)
+
+    # -- jitted programs ---------------------------------------------------
+
+    def _counted(self, name: str, jfn):
+        """Count compiles by argument signature — same contract as the
+        bucket engine's ``serve_compiles_total``: a new (shape, dtype)
+        set means XLA compiled, anything else must hit cache."""
+        import jax
+
+        seen = self._seen_sigs.setdefault(name, set())
+
+        def call(*args):
+            sig = tuple(
+                (tuple(getattr(l, "shape", ())),
+                 str(getattr(l, "dtype", type(l).__name__)))
+                for l in jax.tree.leaves(args))
+            if sig not in seen:
+                seen.add(sig)
+                self._compiles.inc()
+                log.info("serve/llm: compiling %s", name)
+            return jfn(*args)
+
+        return call
+
+    def _build_jits(self) -> None:
+        import jax
+
+        cfg, bs = self._cfg, self.block_size
+
+        def decode(packed, tokens, tables, lens, kc, vc):
+            p = self._materialize(packed)
+            return transformer_decode_paged(p, tokens, tables, lens,
+                                            kc, vc, cfg, bs)
+
+        def prefill(packed, tokens, start, n_valid, table, kc, vc):
+            p = self._materialize(packed)
+            return transformer_prefill_paged(p, tokens, start, n_valid,
+                                             table, kc, vc, cfg, bs)
+
+        def copy_blocks(kc, vc, src, dst):
+            return (kc.at[:, dst].set(kc[:, src]),
+                    vc.at[:, dst].set(vc[:, src]))
+
+        self._jits = {
+            "decode": jax.jit(decode, donate_argnums=(4, 5)),
+            "prefill": jax.jit(prefill, donate_argnums=(5, 6)),
+            "copy": jax.jit(copy_blocks, donate_argnums=(0, 1)),
+        }
+        self._decode_fn = self._counted("decode", self._jits["decode"])
+        self._prefill_fn = self._counted("prefill", self._jits["prefill"])
+        self._copy_fn = self._counted("copy", self._jits["copy"])
+
+    # -- ring (long-context) prefill ---------------------------------------
+
+    def ring_enabled(self) -> bool:
+        import jax
+
+        return (self._ring > 1
+                and len(jax.devices()) >= self._ring
+                and self.max_context % self._ring == 0)
+
+    def _build_ring(self) -> None:
+        if self._ring_built:
+            return
+        import jax
+
+        try:
+            from jax import shard_map
+        except ImportError:      # pragma: no cover - old jax
+            from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        sp = self._ring
+        mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+        rcfg = dataclasses.replace(self._cfg, sp=sp)
+
+        def collect(packed, tokens):
+            p = self._materialize(packed)
+            return transformer_prefill_collect(p, tokens, rcfg)
+
+        def run(packed, tokens):
+            return shard_map(
+                collect, mesh=mesh,
+                in_specs=(P(), P(None, "sp")),
+                out_specs=(P(None, None, "sp"),
+                           P(None, None, "sp")))(packed, tokens)
+
+        def scatter(k_all, v_all, blk, off, kc, vc):
+            kc = kc.at[:, blk, off].set(k_all[:, 0].astype(kc.dtype))
+            vc = vc.at[:, blk, off].set(v_all[:, 0].astype(vc.dtype))
+            return kc, vc
+
+        self._jits["ring_prefill"] = jax.jit(run)
+        self._jits["ring_scatter"] = jax.jit(scatter,
+                                             donate_argnums=(4, 5))
+        self._ring_fn = self._counted("ring_prefill",
+                                      self._jits["ring_prefill"])
+        self._ring_scatter = self._counted("ring_scatter",
+                                           self._jits["ring_scatter"])
+        self._ring_built = True
+
+    def _ring_eligible(self, seq: Sequence, start: int) -> bool:
+        """Whole-prompt ring prefill: only from position 0 and only for
+        prompts long enough that one-chunk-per-iteration streaming would
+        take many iterations (>= half the context bound)."""
+        return (self.ring_enabled() and start == 0
+                and len(seq.tokens) - 1 >= self.max_context // 2)
+
+    def _run_ring_prefill(self, seq: Sequence) -> None:
+        self._build_ring()
+        n = len(seq.tokens) - 1           # last token enters via decode
+        s_pad = self.max_context
+        toks = np.zeros((1, s_pad), np.int32)
+        toks[0, :n] = seq.tokens[:n]
+        p = np.arange(s_pad)
+        table = np.full(self.seq_blocks, SINK_BLOCK, np.int32)
+        table[:len(seq.table)] = seq.table
+        blk = np.where(p < n, table[p // self.block_size],
+                       SINK_BLOCK).astype(np.int32)
+        off = (p % self.block_size).astype(np.int32)
+        k_all, v_all = self._ring_fn(self._packed, toks)
+        self._kc, self._vc = self._ring_scatter(
+            k_all, v_all, blk, off, self._kc, self._vc)
+        seq.prefilled = n
+        self._prefill_tokens.inc(n)
+
+    # -- request surface ---------------------------------------------------
+
+    def submit(self, tokens: Seq[int], *,
+               max_new_tokens: Optional[int] = None,
+               tenant: str = "interactive",
+               deadline_s: Optional[float] = None) -> "Future":
+        """Enqueue one sequence; the Future resolves to the generated
+        token ids.  Raises :class:`BackpressureError` when the waiting
+        queue is at bound (callers see 503, same as the batcher path)."""
+        fut: Future = Future()
+        seq = Sequence(list(tokens),
+                       tenant=tenant,
+                       max_new=(max_new_tokens if max_new_tokens
+                                else self.default_max_new),
+                       future=fut, deadline_s=deadline_s)
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("engine is stopping")
+            if self.sched.queue_depth() >= self.max_queue:
+                raise BackpressureError(
+                    f"waiting queue at bound ({self.max_queue})")
+            self.sched.add(seq)       # validates context bound
+            self._requests.inc()
+            self._cv.notify_all()
+        if self._auto_start:
+            self._ensure_worker()
+        return fut
+
+    def generate(self, prompts: Seq[Seq[int]], *,
+                 timeout: float = 120.0, **kw) -> List[List[int]]:
+        """Synchronous convenience: submit all, wait for all."""
+        futs = [self.submit(p, **kw) for p in prompts]
+        return [f.result(timeout=timeout) for f in futs]
+
+    # -- the iteration -----------------------------------------------------
+
+    def _fail(self, seq: Sequence, exc: Exception) -> None:
+        if seq.future is not None and not seq.future.done():
+            seq.future.set_exception(exc)
+
+    def _finish(self, seq: Sequence) -> None:
+        out = list(seq.generated)
+        self.sched.release(seq)
+        if seq.future is not None and not seq.future.done():
+            seq.future.set_result(out)
+
+    def step(self) -> int:
+        """One scheduler iteration + its device work.  Returns tokens
+        decoded (0 means the engine is idle).  Thread-safe; the worker
+        loop calls this, tests may call it directly."""
+        import jax
+
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> int:
+        import jax
+
+        t_start = time.perf_counter()
+        pre_preempt = self.sched.preemptions
+        pre_prefix = self.sched.prefix_hits
+        pre_admit = dict(self.sched.admissions)
+        plan = self.sched.plan(t_start)
+        self._iterations.inc()
+        self._preempt_total.inc(self.sched.preemptions - pre_preempt)
+        self._prefix_hits.inc(self.sched.prefix_hits - pre_prefix)
+        for t in TENANTS:
+            d = self.sched.admissions[t] - pre_admit[t]
+            if d:
+                self._admissions.inc(d, tenant=t)
+            self._g_queue.set(float(len(self.sched.waiting[t])), tenant=t)
+        self._g_quota.set(float(self.sched.batch_quota_slots()))
+        for seq in plan.expired:
+            self._expired.inc()
+            self._fail(seq, RequestDeadlineExceeded(
+                f"deadline exceeded before admission "
+                f"(waited {time.perf_counter() - seq.t_submit:.3f}s)"))
+
+        if plan.copies:
+            src = np.zeros(self.decode_slots, np.int32)
+            dst = np.zeros(self.decode_slots, np.int32)
+            for i, (s, d) in enumerate(plan.copies[:self.decode_slots]):
+                src[i], dst[i] = s, d
+            self._kc, self._vc = self._copy_fn(self._kc, self._vc,
+                                               src, dst)
+
+        if plan.prefill is not None:
+            seq, start, n = plan.prefill
+            t0 = time.perf_counter()
+            if self._ring_eligible(seq, start):
+                self._run_ring_prefill(seq)
+            else:
+                toks = np.zeros(self.prefill_chunk, np.int32)
+                toks[:n] = seq.tokens[start:start + n]
+                table = np.full(self.seq_blocks, SINK_BLOCK, np.int32)
+                table[:len(seq.table)] = seq.table
+                self._kc, self._vc = self._prefill_fn(
+                    self._packed, toks, np.int32(start), np.int32(n),
+                    table, self._kc, self._vc)
+                seq.prefilled += n
+                self._prefill_tokens.inc(n)
+            self._s_prefill.observe(time.perf_counter() - t0)
+
+        n_decoded = 0
+        if plan.decode:
+            tokens = np.zeros(self.decode_slots, np.int32)
+            tables = np.full((self.decode_slots, self.seq_blocks),
+                             SINK_BLOCK, np.int32)
+            lens = np.zeros(self.decode_slots, np.int32)
+            for slot, seq in plan.decode:
+                tokens[slot] = seq.tokens[-1]
+                lens[slot] = len(seq.tokens)
+                tables[slot, :len(seq.table)] = seq.table
+            t0 = time.perf_counter()
+            nxt, self._kc, self._vc = self._decode_fn(
+                self._packed, tokens, tables, lens, self._kc, self._vc)
+            nxt = np.asarray(jax.device_get(nxt))
+            dt = time.perf_counter() - t0
+            self._s_decode.observe(dt)
+            now = time.perf_counter()
+            for slot, seq in plan.decode:
+                seq.tokens.append(int(nxt[slot]))
+                # The decode kernel wrote k/v at the position of the token
+                # we just consumed, so the cache now covers everything up
+                # to (but not including) the freshly appended token.
+                seq.prefilled = len(seq.tokens) - 1
+                if seq.t_first_token is None:
+                    seq.t_first_token = now
+                    self._s_wait[seq.tenant].observe(
+                        (now - seq.t_submit) * 1000.0)
+                if seq.finished():
+                    self._finish(seq)
+            n_decoded = len(plan.decode)
+            self._decode_tokens.inc(n_decoded)
+            if dt > 0:
+                inst = n_decoded / dt
+                self._tps_ema = (0.8 * self._tps_ema + 0.2 * inst
+                                 if self._tps_ema else inst)
+                self._tps.set(self._tps_ema)
+        return n_decoded
+
+    # -- worker loop -------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            if self._stopping:
+                return
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="llm-engine", daemon=True)
+            self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopping and not self.sched.has_work():
+                    self._cv.wait(timeout=0.1)
+                if self._stopping:
+                    return
+            try:
+                did = self.step()
+            except Exception as e:           # pragma: no cover - safety
+                log.exception("llm engine iteration failed: %s", e)
+                self._abort_all(e)
+                return
+            if not did:
+                # Work exists but none ran (e.g. waiting sequences the
+                # budget cannot admit yet) — park on the condition so a
+                # submit/release wakes us instead of spinning.
+                with self._cv:
+                    self._cv.wait(timeout=0.001)
+
+    def _abort_all(self, exc: Exception) -> None:
+        with self._lock:
+            seqs = list(self.sched.admitted)
+            for q in self.sched.waiting.values():
+                seqs.extend(q)
+                q.clear()
+            for seq in seqs:
+                if seq in self.sched.admitted:
+                    self.sched.release(seq)
+                self._fail(seq, exc)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Drain-free shutdown: fail whatever is still queued/running."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=timeout)
+        self._abort_all(RuntimeError("engine stopped"))
+
+    close = stop
+
+    # -- warmup / introspection --------------------------------------------
+
+    def warmup(self, feat_shape: Optional[Tuple[int, ...]] = None,
+               dtype=None) -> None:
+        """Pre-compile every fixed-shape program with inert inputs (all
+        slots inactive, zero-valid prefill, sink self-copies) so the
+        first real request never pays a compile.  ``feat_shape``/
+        ``dtype`` are accepted for bucket-engine signature compatibility
+        and ignored — this engine has exactly one shape per program."""
+        import jax
+
+        with self._lock:
+            tokens = np.zeros(self.decode_slots, np.int32)
+            tables = np.full((self.decode_slots, self.seq_blocks),
+                             SINK_BLOCK, np.int32)
+            lens = np.zeros(self.decode_slots, np.int32)
+            nxt, self._kc, self._vc = self._decode_fn(
+                self._packed, tokens, tables, lens, self._kc, self._vc)
+            jax.block_until_ready(nxt)
+            ctoks = np.zeros(self.prefill_chunk, np.int32)
+            ctable = np.full(self.seq_blocks, SINK_BLOCK, np.int32)
+            self._kc, self._vc = self._prefill_fn(
+                self._packed, ctoks, np.int32(0), np.int32(0), ctable,
+                self._kc, self._vc)
+            src = np.zeros(self.decode_slots, np.int32)
+            self._kc, self._vc = self._copy_fn(self._kc, self._vc,
+                                               src, src)
+            if self.ring_enabled():
+                self._build_ring()
+                rtoks = np.zeros((1, self.max_context), np.int32)
+                k_all, v_all = self._ring_fn(self._packed, rtoks)
+                p = np.arange(self.max_context)
+                blk = np.full(self.max_context, SINK_BLOCK, np.int32)
+                off = (p % self.block_size).astype(np.int32)
+                self._kc, self._vc = self._ring_scatter(
+                    k_all, v_all, blk, off, self._kc, self._vc)
+            jax.block_until_ready(self._kc)
+
+    def compile_count(self) -> int:
+        return int(self._compiles.value())
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return self.sched.queue_depth()

@@ -1,0 +1,420 @@
+"""Per-iteration scheduler: admit/evict sequences between decode steps.
+
+Continuous batching inverts the static batcher's unit of work: instead
+of gathering REQUESTS into one fixed batch that runs to completion, the
+engine runs ITERATIONS — one fixed-shape decode step over whatever
+sequences currently hold decode slots — and this scheduler decides,
+between iterations, which sequences hold slots, which prefill, and which
+get evicted when the block budget runs dry.  Decisions are pure host
+bookkeeping against the :class:`~.kv_cache.PagedKVAllocator`; the device
+program never changes shape.
+
+Policy, in priority order:
+
+* **Prefill/decode disaggregation** — at most ONE prefill chunk
+  (``HVDT_SERVE_PREFILL_CHUNK`` tokens) runs per iteration, and decode
+  runs EVERY iteration.  A 10k-token prompt streams through in chunks
+  while in-flight decodes keep emitting a token per iteration — decode
+  p99 is bounded by one chunk's compute, not one prompt's.
+* **Tenant classes** — ``interactive`` outranks ``batch`` at every
+  decision point (admission order, prefill order, slot assignment,
+  eviction victims).  Batch holds at most ``quota`` decode slots; the
+  quota adapts off a :class:`~horovod_tpu.telemetry.history.Series` of
+  interactive queue wait (the PR-15 time-series plane): sustained
+  interactive waiting halves the batch share down to an
+  anti-starvation floor of one slot, an idle interactive queue restores
+  it toward ``HVDT_SERVE_BATCH_QUOTA`` — and with no interactive demand
+  at all, batch is work-conserving over every slot.
+* **Eviction = recompute** — a preempted sequence releases its blocks
+  and re-enters the FRONT of its tenant queue with everything generated
+  so far as its new prompt; re-admission re-prefills (chunked) and
+  continues.  Newest batch sequences are preempted first, newest
+  interactive only when no batch victim remains.
+* **Prefix sharing** — an admitted prompt identical to a live
+  sequence's prompt forks that sequence's block table (refcounts, no
+  copy) and skips prefill entirely; the first divergent write resolves
+  through the allocator's copy-on-write.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ...common import config
+from ...telemetry.history import Series
+from .kv_cache import PagedKVAllocator
+
+__all__ = ["Sequence", "IterationPlan", "IterationScheduler", "TENANTS"]
+
+TENANTS = ("interactive", "batch")
+
+_uid = itertools.count()
+
+
+class Sequence:
+    """One request's lifetime through the engine.
+
+    ``tokens`` is the full token list so far (prompt then generated);
+    ``n_prompt`` marks the boundary.  ``prefilled`` counts positions
+    whose k/v sit in the cache — decode is legal once ``prefilled ==
+    len(tokens) - 1`` (the LAST token enters through the decode step,
+    which scatters its k/v and emits the first new token in one pass).
+    Preemption resets ``prefilled`` to 0 and keeps ``tokens``: the
+    recompute path re-prefills prompt+generated as one longer prompt.
+    """
+
+    __slots__ = ("uid", "tokens", "n_prompt", "tenant", "max_new",
+                 "table", "prefilled", "slot", "future", "t_submit",
+                 "deadline", "preemptions", "prefix_shared",
+                 "t_first_token", "admit_order")
+
+    def __init__(self, tokens: List[int], *, tenant: str = "interactive",
+                 max_new: int = 16, future=None,
+                 deadline_s: Optional[float] = None):
+        if tenant not in TENANTS:
+            raise ValueError(f"unknown tenant {tenant!r}; "
+                             f"valid: {TENANTS}")
+        if not tokens:
+            raise ValueError("empty prompt")
+        self.uid = next(_uid)
+        self.tokens: List[int] = [int(t) for t in tokens]
+        self.n_prompt = len(self.tokens)
+        self.tenant = tenant
+        self.max_new = int(max_new)
+        self.table: List[int] = []
+        self.prefilled = 0
+        self.slot: Optional[int] = None
+        self.future = future
+        self.t_submit = time.perf_counter()
+        self.deadline = (self.t_submit + deadline_s
+                         if deadline_s and deadline_s > 0 else None)
+        self.preemptions = 0
+        self.prefix_shared = False
+        self.t_first_token: Optional[float] = None
+        self.admit_order = -1
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.tokens) - self.n_prompt
+
+    @property
+    def generated(self) -> List[int]:
+        return self.tokens[self.n_prompt:]
+
+    @property
+    def decode_ready(self) -> bool:
+        return self.prefilled >= len(self.tokens) - 1
+
+    def finished(self) -> bool:
+        return self.n_generated >= self.max_new
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+@dataclasses.dataclass
+class IterationPlan:
+    """What the engine executes this iteration (device work only; all
+    bookkeeping already committed to the allocator)."""
+
+    copies: List[Tuple[int, int]]                    # CoW block copies
+    prefill: Optional[Tuple[Sequence, int, int]]     # (seq, start, n)
+    decode: List[Tuple[int, Sequence]]               # (slot, seq)
+    expired: List[Sequence]                          # deadline failures
+
+
+class IterationScheduler:
+    """Owns the waiting queues, the decode slots, and the block budget.
+
+    Single-threaded by contract (the engine's worker loop); ``add`` is
+    the one entry point the engine may call under its own lock from
+    submitter threads.
+    """
+
+    def __init__(self, allocator: PagedKVAllocator, *,
+                 decode_slots: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 seq_blocks: Optional[int] = None,
+                 batch_quota: Optional[float] = None,
+                 wait_hi_ms: float = 25.0,
+                 history_window: int = 256):
+        self.alloc = allocator
+        self.decode_slots = int(
+            decode_slots if decode_slots is not None
+            else config.get_int("HVDT_SERVE_DECODE_SLOTS"))
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else config.get_int("HVDT_SERVE_PREFILL_CHUNK"))
+        self.seq_blocks = int(
+            seq_blocks if seq_blocks is not None
+            else config.get_int("HVDT_KV_SEQ_BLOCKS"))
+        self.quota_ceiling = float(
+            batch_quota if batch_quota is not None
+            else config.get_float("HVDT_SERVE_BATCH_QUOTA"))
+        self.quota_ceiling = min(1.0, max(0.0, self.quota_ceiling))
+        self.wait_hi_ms = float(wait_hi_ms)
+        self.max_context = self.seq_blocks * self.alloc.block_size
+
+        self.waiting: Dict[str, Deque[Sequence]] = {
+            t: collections.deque() for t in TENANTS}
+        self.slots: List[Optional[Sequence]] = [None] * self.decode_slots
+        self.admitted: List[Sequence] = []    # admission order
+        self.iteration = 0
+        self._admit_seq = itertools.count()
+        self._quota_frac = self.quota_ceiling
+        # PR-15 time-series plane: the quota is SCHEDULED off these, not
+        # off instantaneous queue length — a single burst doesn't thrash
+        # the batch tenant, sustained pressure does.
+        self.wait_series = Series("serve_interactive_wait_ms",
+                                  history_window)
+        self.quota_series = Series("serve_batch_quota_slots",
+                                   history_window)
+        # Audit counters the engine mirrors into metrics.
+        self.preemptions = 0
+        self.prefix_hits = 0
+        self.admissions: Dict[str, int] = {t: 0 for t in TENANTS}
+
+    # -- submitter side ----------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        need = len(seq.tokens) + seq.max_new
+        if need > self.max_context:
+            raise ValueError(
+                f"sequence needs {need} positions > context bound "
+                f"{self.max_context} (HVDT_KV_SEQ_BLOCKS * "
+                f"HVDT_KV_BLOCK_SIZE)")
+        self.waiting[seq.tenant].append(seq)
+
+    def queue_depth(self, tenant: Optional[str] = None) -> int:
+        if tenant is not None:
+            return len(self.waiting[tenant])
+        return sum(len(q) for q in self.waiting.values())
+
+    def live_sequences(self) -> int:
+        return len(self.admitted) + self.queue_depth()
+
+    # -- quota -------------------------------------------------------------
+
+    def batch_quota_slots(self) -> int:
+        """Decode slots the batch tenant may hold right now."""
+        interactive_demand = (len(self.waiting["interactive"]) +
+                              sum(1 for s in self.admitted
+                                  if s.tenant == "interactive"))
+        if interactive_demand == 0:
+            return self.decode_slots         # work-conserving when idle
+        q = int(round(self._quota_frac * self.decode_slots))
+        return max(1, min(self.decode_slots, q))   # anti-starvation floor
+
+    def _adapt_quota(self, now: float) -> None:
+        """Record the interactive wait signal and adapt the batch share
+        off the recent window (AIMD: halve under sustained pressure,
+        creep back while quiet)."""
+        q = self.waiting["interactive"]
+        wait_ms = (now - q[0].t_submit) * 1000.0 if q else 0.0
+        self.wait_series.append(time.time(), self.iteration, wait_ms)
+        recent = self.wait_series.values()[-8:]
+        mean = sum(recent) / len(recent) if recent else 0.0
+        if mean > self.wait_hi_ms:
+            self._quota_frac = max(0.0, self._quota_frac * 0.5)
+        elif mean < self.wait_hi_ms * 0.25:
+            self._quota_frac = min(self.quota_ceiling,
+                                   self._quota_frac
+                                   + 0.25 / self.decode_slots)
+        self.quota_series.append(time.time(), self.iteration,
+                                 float(self.batch_quota_slots()))
+
+    # -- eviction ----------------------------------------------------------
+
+    def _victim(self, spare: Sequence, allow_interactive: bool,
+                exclude=()) -> Optional[Sequence]:
+        """Newest admitted batch sequence (then newest interactive when
+        allowed), never ``spare`` nor anything in ``exclude`` (work
+        already committed to this iteration's plan must not lose its
+        blocks mid-plan)."""
+        for tenant in (("batch", "interactive") if allow_interactive
+                       else ("batch",)):
+            for seq in reversed(self.admitted):
+                if (seq is not spare and seq.tenant == tenant
+                        and seq not in exclude):
+                    return seq
+        return None
+
+    def preempt(self, seq: Sequence) -> None:
+        """Evict: release blocks, requeue at the FRONT of its tenant
+        queue with prompt+generated as the new (recompute) prompt."""
+        self.alloc.free(seq.table)
+        if seq.slot is not None:
+            self.slots[seq.slot] = None
+            seq.slot = None
+        seq.prefilled = 0
+        seq.preemptions += 1
+        self.preemptions += 1
+        self.admitted.remove(seq)
+        self.waiting[seq.tenant].appendleft(seq)
+
+    def release(self, seq: Sequence) -> None:
+        """Finished sequence: free blocks, vacate the slot."""
+        self.alloc.free(seq.table)
+        if seq.slot is not None:
+            self.slots[seq.slot] = None
+            seq.slot = None
+        if seq in self.admitted:
+            self.admitted.remove(seq)
+
+    # -- admission ---------------------------------------------------------
+
+    def _find_prefix_parent(self, seq: Sequence) -> Optional[Sequence]:
+        """A live sequence whose PROMPT is identical and fully in cache
+        — its block table can be forked (CoW) and prefill skipped."""
+        for cand in self.admitted:
+            if (cand.n_prompt == seq.n_prompt
+                    and cand.prefilled >= cand.n_prompt - 1
+                    and len(cand.table) >= self.alloc.blocks_for(
+                        cand.n_prompt)
+                    and cand.tokens[:cand.n_prompt] == seq.tokens):
+                return cand
+        return None
+
+    def _admit(self, seq: Sequence) -> bool:
+        parent = self._find_prefix_parent(seq)
+        if parent is not None:
+            nb = self.alloc.blocks_for(seq.n_prompt)
+            seq.table = self.alloc.fork(parent.table[:nb])
+            seq.prefilled = seq.n_prompt - 1
+            seq.prefix_shared = True
+            self.prefix_hits += 1
+        else:
+            table = self.alloc.allocate(len(seq.tokens))
+            if table is None:
+                return False
+            seq.table = table
+            seq.prefilled = 0
+        seq.admit_order = next(self._admit_seq)
+        self.admitted.append(seq)
+        self.admissions[seq.tenant] += 1
+        return True
+
+    def _admission_pass(self, now: float) -> None:
+        batch_cap = self.batch_quota_slots()
+        for tenant in TENANTS:
+            q = self.waiting[tenant]
+            while q:
+                if len(self.admitted) >= self.decode_slots + 2:
+                    # A couple prefilling ahead is plenty — but an
+                    # interactive arrival may bump a batch resident
+                    # rather than wait behind it.
+                    if tenant != "interactive":
+                        return
+                    victim = self._victim(q[0], allow_interactive=False)
+                    if victim is None:
+                        return
+                    self.preempt(victim)
+                if tenant == "batch":
+                    n_batch = sum(1 for s in self.admitted
+                                  if s.tenant == "batch")
+                    if n_batch >= batch_cap:
+                        break
+                seq = q[0]
+                if not self._admit(seq):
+                    if tenant == "interactive":
+                        victim = self._victim(seq,
+                                              allow_interactive=False)
+                        if victim is not None:
+                            self.preempt(victim)
+                            continue   # retry the same head-of-queue
+                    break              # budget truly exhausted
+                q.popleft()
+                if tenant == "interactive":
+                    self.wait_series.append(
+                        time.time(), self.iteration,
+                        (now - seq.t_submit) * 1000.0)
+
+    # -- the per-iteration decision ----------------------------------------
+
+    def plan(self, now: Optional[float] = None) -> IterationPlan:
+        now = time.perf_counter() if now is None else now
+        self.iteration += 1
+        expired: List[Sequence] = []
+        for q in self.waiting.values():
+            keep: List[Sequence] = []
+            while q:
+                seq = q.popleft()
+                (expired if seq.expired(now) else keep).append(seq)
+            q.extend(keep)
+        self._adapt_quota(now)
+        self._admission_pass(now)
+
+        # One prefill chunk, interactive-admitted first then admit order.
+        prefill: Optional[Tuple[Sequence, int, int]] = None
+        pending = [s for s in self.admitted if not s.decode_ready]
+        pending.sort(key=lambda s: (s.tenant != "interactive",
+                                    s.admit_order))
+        if pending:
+            seq = pending[0]
+            n = min(self.prefill_chunk,
+                    (len(seq.tokens) - 1) - seq.prefilled)
+            prefill = (seq, seq.prefilled, n)
+
+        # Slot assignment: ready sequences, interactive first, batch
+        # under quota.  A shrunken quota preempts the newest batch
+        # holder when an interactive sequence needs its slot.
+        batch_cap = self.batch_quota_slots()
+        ready = [s for s in self.admitted
+                 if s.decode_ready and s.slot is None]
+        ready.sort(key=lambda s: (s.tenant != "interactive",
+                                  s.admit_order))
+        for seq in ready:
+            n_batch = sum(1 for s in self.slots
+                          if s is not None and s.tenant == "batch")
+            if seq.tenant == "batch" and n_batch >= batch_cap:
+                continue
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free and seq.tenant == "interactive":
+                victims = [s for s in self.slots
+                           if s is not None and s.tenant == "batch"]
+                if victims:
+                    self.preempt(max(victims,
+                                     key=lambda s: s.admit_order))
+                    free = [i for i, s in enumerate(self.slots)
+                            if s is None]
+            if not free:
+                break
+            seq.slot = free[0]
+            self.slots[seq.slot] = seq
+
+        # Decode capacity: every slotted sequence must own (unshared)
+        # the block its next write lands in.  Victims must come from
+        # OUTSIDE the work already committed this iteration — evicting a
+        # sequence the plan will decode (or prefill) would hand the
+        # engine a freed block table.
+        copies: List[Tuple[int, int]] = []
+        decode: List[Tuple[int, Sequence]] = []
+        committed = {prefill[0]} if prefill is not None else set()
+        for slot, seq in enumerate(self.slots):
+            if seq is None:
+                continue
+            got = self.alloc.append_token(seq.table, len(seq.tokens) - 1)
+            while got is None:
+                victim = self._victim(
+                    seq, allow_interactive=(seq.tenant == "interactive"),
+                    exclude=committed)
+                if victim is None:
+                    self.preempt(seq)      # nobody to evict but itself
+                    break
+                self.preempt(victim)
+                got = self.alloc.append_token(seq.table,
+                                              len(seq.tokens) - 1)
+            if got is None:
+                continue
+            copies.extend(got)
+            decode.append((slot, seq))
+            committed.add(seq)
+        return IterationPlan(copies=copies, prefill=prefill,
+                             decode=decode, expired=expired)
+
+    def has_work(self) -> bool:
+        return bool(self.admitted) or self.queue_depth() > 0

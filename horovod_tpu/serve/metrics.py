@@ -12,6 +12,14 @@ a registry **per engine** (an inference replica scrapes its own engine),
 while training instrumentation shares the process-wide
 ``telemetry.metrics.default_registry()`` behind the per-worker
 ``/metrics`` exporter.
+
+Percentile reads: the continuous LLM engine's ``hvdt_engine_*``
+summaries (decode/prefill step time, per-tenant submit-to-first-token
+``hvdt_engine_wait_ms_<tenant>``) are scraped by roll-ups that may run
+before any observation exists — use ``Summary.percentile(q)`` there
+(total: empty window reads 0.0).  ``Summary.quantile(q)`` keeps its
+``None``-when-empty contract for callers that must distinguish "no data
+yet" (the router's SLO ejection does).
 """
 
 from __future__ import annotations

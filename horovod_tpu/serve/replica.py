@@ -99,6 +99,9 @@ class ReplicaRegistrar:
             doc["draining"] = bool(getattr(self._server, "draining",
                                            False) or doc.get("draining"))
             doc["model_version"] = self._server.engine.params_version
+            doc["engine"] = ("continuous"
+                             if getattr(self._server, "continuous", False)
+                             else "static")
         return doc
 
     # -- lifecycle ---------------------------------------------------------

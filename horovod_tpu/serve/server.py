@@ -93,6 +93,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "checkpoint_step": (srv.watcher.current_step
                                     if srv.watcher else None),
                 "buckets": list(srv.engine.buckets),
+                "engine": ("continuous" if srv.continuous else "static"),
             }
             self._reply(200, payload)
             self._observe("healthz", t0, 200)
@@ -127,6 +128,9 @@ class _Handler(BaseHTTPRequestHandler):
             srv._inflight_exit()
 
     def _do_predict(self, srv: "ModelServer", t0: float) -> None:
+        if srv.continuous:
+            self._do_predict_llm(srv, t0)
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
             doc = json.loads(self.rfile.read(length))
@@ -180,6 +184,62 @@ class _Handler(BaseHTTPRequestHandler):
                           "model_version": version})
         self._observe("predict", t0, 200)
 
+    def _do_predict_llm(self, srv: "ModelServer", t0: float) -> None:
+        """Continuous-engine predict: rows are token-id prompts; the
+        response carries the generated token ids per row.  Same status
+        contract as the batcher path (400/503/504/500), so the router
+        and autoscaler need no engine awareness."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length))
+            prompts = doc["inputs"]
+            if (not isinstance(prompts, list) or not prompts
+                    or not all(isinstance(p, list) and p
+                               for p in prompts)):
+                raise ValueError("inputs must hold >= 1 non-empty "
+                                 "token-id rows")
+            max_new = doc.get("max_new_tokens")
+            tenant = doc.get("tenant", "interactive")
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            self._observe("predict", t0, 400)
+            return
+        inj = faults.get_injector()
+        if inj is not None:
+            inj.fire("serve.predict", step=srv.request_seq())
+        try:
+            version = srv.engine.params_version
+            futures = [
+                srv.engine.submit(
+                    [int(t) for t in p],
+                    max_new_tokens=(int(max_new) if max_new else None),
+                    tenant=tenant,
+                    deadline_s=srv.request_timeout_s)
+                for p in prompts]
+        except BackpressureError as e:
+            self._reply(503, {"error": str(e)},
+                        extra_headers={"Retry-After": "1"})
+            self._observe("predict", t0, 503)
+            return
+        except ValueError as e:        # tenant/context-bound validation
+            self._reply(400, {"error": f"bad request: {e}"})
+            self._observe("predict", t0, 400)
+            return
+        try:
+            outputs = [f.result(timeout=srv.request_timeout_s)
+                       for f in futures]
+        except (concurrent.futures.TimeoutError, TimeoutError,
+                RequestDeadlineExceeded):
+            self._reply(504, {"error": "deadline exceeded"})
+            self._observe("predict", t0, 504)
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"inference failed: {e}"})
+            self._observe("predict", t0, 500)
+            return
+        self._reply(200, {"outputs": outputs, "model_version": version})
+        self._observe("predict", t0, 200)
+
 
 class _HTTPServer(ThreadingHTTPServer):
     daemon_threads = True
@@ -226,10 +286,17 @@ class ModelServer:
             request_timeout_s if request_timeout_s is not None
             else config.get_float("HVDT_SERVE_REQUEST_TIMEOUT_S"))
         self.input_dtype = np.dtype(input_dtype)
-        self.batcher = DynamicBatcher(
-            engine.infer, max_batch_size=max_batch_size,
-            max_delay_ms=max_delay_ms, max_queue_depth=max_queue_depth,
-            metrics=self.metrics)
+        # Engine selection (HVDT_SERVE_ENGINE): the continuous LLM
+        # engine does its own per-iteration batching — a request-level
+        # gather in front of it would just re-serialize admissions — so
+        # the batcher only exists on the static path.
+        self.continuous = bool(getattr(engine, "is_continuous", False))
+        self.batcher: Optional[DynamicBatcher] = None
+        if not self.continuous:
+            self.batcher = DynamicBatcher(
+                engine.infer, max_batch_size=max_batch_size,
+                max_delay_ms=max_delay_ms, max_queue_depth=max_queue_depth,
+                metrics=self.metrics)
         self.watcher: Optional[CheckpointWatcher] = None
         if checkpoint_dir is not None:
             self.watcher = CheckpointWatcher(
@@ -348,7 +415,10 @@ class ModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
-        self.batcher.close()
+        if self.batcher is not None:
+            self.batcher.close()
+        elif hasattr(self.engine, "stop"):
+            self.engine.stop()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None

@@ -143,6 +143,21 @@ def serve_snapshot_dict(registry: MetricsRegistry) -> Dict[str, Any]:
     draining = registry.get("serve_draining")
     if draining is not None:
         out["draining"] = bool(draining.value() == 1.0)
+    # Continuous-engine extras (serve/llm): decode throughput and KV
+    # occupancy ride the same heartbeat so the autoscaler and dashboards
+    # see the LLM engine's load story without a second channel.
+    tps = registry.get("hvdt_engine_tokens_per_sec")
+    if tps is not None:
+        out["engine"] = "continuous"
+        v = tps.value()
+        out["tokens_per_sec"] = round(v, 3) if v == v else 0.0
+        for gname, key in (("hvdt_engine_kv_blocks_in_use",
+                            "kv_blocks_in_use"),
+                           ("hvdt_engine_active_seqs", "active_seqs")):
+            g = registry.get(gname)
+            if g is not None:
+                gv = g.value()
+                out[key] = gv if gv == gv else 0.0
     return out
 
 

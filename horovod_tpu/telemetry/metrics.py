@@ -253,6 +253,16 @@ class Summary(_Metric):
             return {q: None for q in self.QUANTILES}
         return {q: self._nearest_rank(data, q) for q in self.QUANTILES}
 
+    def percentile(self, q: float) -> float:
+        """Like :meth:`quantile` but TOTAL: an empty window reads 0.0,
+        never ``None``.  Dashboards and roll-ups over the per-tenant
+        ``hvdt_engine_*`` summaries read p50/p95/p99 before the first
+        observation lands (a fresh replica, an idle tenant) and must see
+        a number — callers that need to distinguish "no data yet" keep
+        :meth:`quantile`'s ``None`` contract (router ejection does)."""
+        v = self.quantile(q)
+        return 0.0 if v is None else float(v)
+
     def render(self) -> List[str]:
         lines = self._header()
         data = self._sorted_window()
@@ -574,6 +584,41 @@ CATALOG: Dict[str, MetricSpec] = {
            "Checkpoint step currently served"),
         _m("serve_last_good_step", "gauge", (),
            "Newest verified checkpoint step seen by the watcher"),
+        # --- continuous-batching LLM engine (serve/llm) ---
+        _m("hvdt_engine_iterations_total", "counter", (),
+           "Continuous-batching scheduler iterations executed"),
+        _m("hvdt_engine_decode_tokens_total", "counter", (),
+           "Tokens emitted by the paged decode step"),
+        _m("hvdt_engine_prefill_tokens_total", "counter", (),
+           "Prompt tokens written into the paged KV cache"),
+        _m("hvdt_engine_preemptions_total", "counter", (),
+           "Sequences evicted under block pressure (recompute on "
+           "return)"),
+        _m("hvdt_engine_prefix_hits_total", "counter", (),
+           "Admissions served by forking a live prompt's block table "
+           "(copy-on-write prefix sharing)"),
+        _m("hvdt_engine_admissions_total", "counter", ("tenant",),
+           "Sequences admitted to the block budget, by tenant"),
+        _m("hvdt_engine_tokens_per_sec", "gauge", (),
+           "Decode throughput (EMA over iterations)"),
+        _m("hvdt_engine_kv_blocks_total", "gauge", (),
+           "Allocatable KV blocks (sink block excluded)"),
+        _m("hvdt_engine_kv_blocks_in_use", "gauge", (),
+           "KV blocks held by live block tables (live probe)"),
+        _m("hvdt_engine_active_seqs", "gauge", (),
+           "Admitted (prefilling or decoding) sequences (live probe)"),
+        _m("hvdt_engine_batch_quota_slots", "gauge", (),
+           "Decode slots the batch tenant may hold (adapts off the "
+           "interactive-wait time series)"),
+        _m("hvdt_engine_queue_depth", "gauge", ("tenant",),
+           "Waiting (not yet admitted) sequences, by tenant"),
+        _m("hvdt_engine_decode_step_seconds", "summary", (),
+           "Wall time of one paged decode iteration"),
+        _m("hvdt_engine_prefill_chunk_seconds", "summary", (),
+           "Wall time of one prefill chunk (or ring prefill shot)"),
+        _m("hvdt_engine_wait_ms_*", "summary", (),
+           "Submit-to-first-token latency by tenant "
+           "(hvdt_engine_wait_ms_<tenant>; Summary carries no labels)"),
     ]
 }
 

@@ -338,6 +338,33 @@ class TestDynamicBatcher:
         with pytest.raises(RuntimeError):
             batcher.submit(np.zeros((1, 2)))
 
+    def test_deadline_expiry_between_gather_and_dispatch(self):
+        """Regression: a request whose deadline lapses AFTER the gather
+        loop pops it but BEFORE dispatch must fail fast, not burn a batch
+        slot — _dispatch_groups re-checks expiry on entry."""
+        from horovod_tpu.serve.batcher import (RequestDeadlineExceeded,
+                                               _Request)
+
+        calls = []
+        batcher = DynamicBatcher(lambda x: calls.append(x) or x,
+                                 max_batch_size=4, max_delay_ms=1.0,
+                                 max_queue_depth=16, deadline_s=30.0)
+        try:
+            expired = _Request(np.zeros((1, 2)), deadline_s=0.001)
+            live = _Request(np.ones((1, 2)), deadline_s=30.0)
+            time.sleep(0.01)            # lapse the first deadline
+            batcher._dispatch_groups([expired, live])
+            with pytest.raises(RequestDeadlineExceeded):
+                expired.future.result(timeout=5)
+            np.testing.assert_allclose(live.future.result(timeout=5),
+                                       np.ones((1, 2)))
+            assert len(calls) == 1, \
+                "the expired request must never reach the engine"
+            assert batcher.metrics.counter(
+                "serve_deadline_expired_total").value() >= 1
+        finally:
+            batcher.close()
+
 
 class TestCheckpointWatcher:
     def test_empty_dir_is_quiet(self, tmp_path, params):

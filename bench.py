@@ -186,8 +186,77 @@ def _parse_args(argv=None):
                          "the bench-side smoke of the attribution "
                          "plane.  Rides the telemetry doc, so it never "
                          "touches the last-good cache.")
+    ap.add_argument("--controller", action="store_true",
+                    help="Policy-controller micro-benchmark: drive a "
+                         "synthetic anomaly-event storm through "
+                         "control.PolicyController (offline cost-model "
+                         "pricing, guardrails, stub appliers) and emit "
+                         "decisions/s plus the decision mix and mean "
+                         "predicted delta as one JSON line.  Pure CPU, "
+                         "in-process; never touches the last-good "
+                         "cache.")
+    ap.add_argument("--controller-events", type=int, default=2000,
+                    help="Synthetic events to push for --controller.")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
+
+
+def _run_controller_bench(args) -> None:
+    """Policy-controller event-storm micro-bench (in-process): N
+    synthetic anomaly events of rotating classes through a full
+    PolicyController — real cost-model pricing on every candidate, real
+    guardrails, stub appliers — and one JSON line with decisions/s, the
+    applied/suppressed mix, and the mean predicted delta of applied
+    actions.  The number to watch: the control loop must price and
+    decide orders of magnitude faster than the discovery tick it rides
+    (one decision per tick in production)."""
+    from horovod_tpu.analysis import costmodel as _cm
+    from horovod_tpu.control import (ActionPricer, ControllerConfig,
+                                     ControllerState, PolicyController)
+    from horovod_tpu.telemetry.metrics import MetricsRegistry
+
+    MiB = 2 ** 20
+    applied = []
+    ctl = PolicyController(
+        cfg=ControllerConfig(cooldown_s=0.0, enter_ratio=1.2,
+                             exit_ratio=1.05, recovery_window=1),
+        pricer=ActionPricer(_cm.CostModel(_cm.Calibration())),
+        state=ControllerState(pods=4, grad_bytes=64 * MiB,
+                              bucket_bytes=32 * MiB, overlap=True,
+                              step_time_s=1.0),
+        registry=MetricsRegistry())
+    ctl.bind_appliers(
+        {k: (lambda a, _applied=applied: _applied.append(a) or True)
+         for k in ("flip_transport", "retune_bucket", "toggle_overlap",
+                   "toggle_zero", "evict_pod", "resize",
+                   "scale_replicas")})
+    kinds = ("step_time_shift", "wire_drift", "mfu_regression",
+             "perf_deviation", "straggler_onset", "goodput_drop")
+    n = max(1, args.controller_events)
+    deltas = []
+    t0 = time.perf_counter()
+    for i in range(n):
+        ev = {"kind": kinds[i % len(kinds)], "scope": "cluster",
+              "ratio": 1.5, "step": i, "pod": "podB"}
+        decisions = ctl.tick([ev], deviation_ratio=1.5,
+                             observed_step_s=1.0, step=i)
+        for d in decisions:
+            if d.outcome == "applied" and d.chosen is not None:
+                deltas.append(d.chosen.predicted_delta_s)
+        # recover immediately so guardrails re-arm and every event is a
+        # fresh decision, not a pile-up of pending verifications
+        ctl.tick([], deviation_ratio=1.0, observed_step_s=1.0, step=i)
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "controller_decisions_per_s",
+        "value": round(n / elapsed, 1),
+        "unit": "decisions/s",
+        "events": n,
+        "applied": len(applied),
+        "suppressed": int(ctl._m_suppressed.total()),
+        "mean_predicted_delta_ms": round(
+            1e3 * sum(deltas) / len(deltas), 3) if deltas else 0.0,
+    }))
 
 
 def _run_serve_child(args) -> None:
@@ -1046,6 +1115,12 @@ def main() -> None:
             _run_serve_child(args)
         else:
             _run_child(args)
+        return
+
+    if args.controller:
+        # Pure-CPU in-process control-loop storm — no child, no
+        # accelerator, no last-good cache.
+        _run_controller_bench(args)
         return
 
     if args.serve_llm:

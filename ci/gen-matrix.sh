@@ -17,7 +17,9 @@
 #       static-cost-model/perf-gate +
 #       live-attribution/time-series/anomaly-detection +
 #       continuous-batching-llm-serve (paged KV / scheduler /
-#       prefix-sharing / ring-prefill) tests on
+#       prefix-sharing / ring-prefill) +
+#       closed-loop-policy-controller (pricing / guardrails /
+#       leg-actuation / driver-hook) tests on
 #       CPU) — the pre-merge gate.  The full matrix additionally
 #       emits the `analysis` service: python -m horovod_tpu.analysis
 #       --all --perf as a hard gate over the hvdt-lint ratchet

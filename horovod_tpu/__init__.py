@@ -143,7 +143,7 @@ def __getattr__(name):
                     "callbacks", "sync_batch_norm", "optimizer", "autotune",
                     "data", "native", "orchestrate", "interop",
                     "step_pipeline", "serve", "quant", "resilience",
-                    "telemetry"):
+                    "telemetry", "control"):
             import importlib
 
             return importlib.import_module(f".{name}", __name__)

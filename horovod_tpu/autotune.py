@@ -26,7 +26,7 @@ import csv
 import dataclasses
 import math
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -768,6 +768,12 @@ class AutotunedStep:
         self._t0: Optional[float] = None
         self._pending = 0
         self._skip_sample = False
+        # Controller seam (horovod_tpu/control): leg overrides queued by
+        # apply_leg, adopted at the next __call__ boundary and merged
+        # LAST into every later rebuild so the tuner doesn't stomp them.
+        self._pending_legs: Dict[str, Any] = {}
+        self._leg_overrides: Dict[str, Any] = {}
+        self._override_threshold: Optional[int] = None
 
     @property
     def autotuner(self) -> Optional[BenchmarkAutotuner]:
@@ -785,7 +791,8 @@ class AutotunedStep:
     def _rebuild(self):
         """Re-jit at the tuner's current knob point (fused/quant
         dimensions forwarded only when both the tuner and the builder
-        carry them)."""
+        carry them).  Controller leg overrides merge last — an applied
+        policy decision survives the tuner's own rebuilds."""
         pm = self._tuner.pm
         kw = {}
         if pm.tune_fused and self._accepts_fused:
@@ -800,7 +807,52 @@ class AutotunedStep:
             kw["transport"] = pm.transport_policy
         if pm.tune_zero and self._accepts_zero:
             kw["zero"] = pm.zero_sharding
-        return self._builder(self._tuner.bucket_bytes, **kw)
+        kw.update(self._filtered_overrides())
+        threshold = (self._override_threshold
+                     if self._override_threshold is not None
+                     else self._tuner.bucket_bytes)
+        return self._builder(threshold, **kw)
+
+    # -- controller seam (horovod_tpu/control) -----------------------------
+
+    _LEG_ACCEPTS = {"fused": "_accepts_fused", "quant": "_accepts_quant",
+                    "quant_leg": "_accepts_quant_leg",
+                    "overlap": "_accepts_overlap",
+                    "transport": "_accepts_transport",
+                    "zero": "_accepts_zero"}
+
+    def apply_leg(self, **legs: Any) -> None:
+        """Queue a policy-controller leg override, adopted at the NEXT
+        ``__call__`` — never mid-step.  Accepts the builder leg
+        keywords (``transport=bool``, ``overlap=bool``, ``zero=bool``,
+        ``quant=bool``, ``quant_leg=str``, ``fused=bool``) plus
+        ``threshold_bytes=int`` for a bucket retune.  Adoption is the
+        same state-compatible rebuild the tuner performs: one optimizer
+        state tree, re-jit only, and a leg-memoizing builder flips back
+        to an already-compiled program without recompiling.  Works with
+        the tuner off (``HVDT_AUTOTUNE`` unset) — the controller can
+        steer an untuned run."""
+        self._pending_legs.update(legs)
+
+    def _filtered_overrides(self) -> Dict[str, Any]:
+        return {k: v for k, v in self._leg_overrides.items()
+                if getattr(self, self._LEG_ACCEPTS.get(k, ""), False)}
+
+    def _adopt_legs(self) -> None:
+        pending, self._pending_legs = self._pending_legs, {}
+        if "threshold_bytes" in pending:
+            self._override_threshold = int(pending.pop("threshold_bytes"))
+        self._leg_overrides.update(pending)
+        self._step = (self._rebuild() if self._tuner is not None
+                      else self._builder(self._override_threshold,
+                                         **self._filtered_overrides()))
+        if self._tuner is not None:
+            # The adopting region includes a possible re-jit: discard
+            # its sample so compile time can't poison the tuner score.
+            self._skip_sample = True
+        log.info("controller leg adopted: %s%s", pending,
+                 (f" threshold={self._override_threshold}"
+                  if self._override_threshold is not None else ""))
 
     @staticmethod
     def _fetch(out) -> None:
@@ -820,6 +872,8 @@ class AutotunedStep:
             np.asarray(smallest)
 
     def __call__(self, *args, **kwargs):
+        if self._pending_legs:
+            self._adopt_legs()
         if not self.enabled:
             return self._step(*args, **kwargs)
         if self._tuner is None:

@@ -232,6 +232,58 @@ KNOBS: Dict[str, Knob] = {
            "off (telemetry.anomaly.get_event_log() is None); "
            "hvdt_anomaly_total{kind} counters ride the registry either "
            "way when detectors run."),
+        _k("HVDT_EVENT_LOG_MAX_BYTES", 0, int,
+           "Size bound for the HVDT_EVENT_LOG JSONL file: when an "
+           "append would push it past this many bytes the file rotates "
+           "to <path>.1 (keep-1 — the previous .1 is replaced) and a "
+           "fresh file starts, so a long run with a chatty online "
+           "controller cannot grow the log unboundedly.  0 (default) = "
+           "unbounded (the pre-rotation behavior)."),
+        # --- online policy controller (horovod_tpu/control: the
+        #     driver-side loop that prices anomaly events with the cost
+        #     model and acts at step boundaries) ---
+        _k("HVDT_CONTROLLER", "", str,
+           "Engage the online policy controller on the elastic driver: "
+           "anomaly events from the HVDT_EVENT_LOG sensor plane are "
+           "mapped to candidate actions (flip a transport leg, retune "
+           "the bucket threshold, toggle the overlap/ZeRO legs, evict "
+           "a straggler pod, resize the world, scale serve replicas), "
+           "priced OFFLINE with the analytical cost model, and the "
+           "best candidate clearing the guardrails is applied at a "
+           "step boundary through the no-recompile autotune leg "
+           "machinery, then verified against "
+           "hvdt_perf_deviation_ratio with a never-worse rollback.  "
+           "Values: empty/0 (default) = off "
+           "(control.get_controller() is None, zero overhead); 1/on = "
+           "act; observe = decide + log but never apply (dry run).  "
+           "Decisions append controller_decision / controller_outcome "
+           "records to the event JSONL — auditable and replayable."),
+        _k("HVDT_CONTROLLER_COOLDOWN_S", 60.0, float,
+           "Per-action-kind cooldown: after the controller applies an "
+           "action, the same kind is ineligible for this many seconds "
+           "(doubled after each never-worse rollback of that kind) so "
+           "one bad actuator cannot thrash the run."),
+        _k("HVDT_CONTROLLER_ENTER_RATIO", 1.2, float,
+           "Hysteresis ENTER band: a triggering event's slowdown ratio "
+           "must be at least this factor before the controller acts "
+           "(events below it are recorded as suppressed:hysteresis)."),
+        _k("HVDT_CONTROLLER_EXIT_RATIO", 1.05, float,
+           "Hysteresis EXIT band: hvdt_perf_deviation_ratio must fall "
+           "back under this factor for an applied action to count as "
+           "recovered and for its trigger to re-arm — the enter/exit "
+           "split is what prevents flapping on an oscillating series."),
+        _k("HVDT_CONTROLLER_RECOVERY_WINDOW", 3, int,
+           "Controller ticks an applied action gets to bring the "
+           "deviation ratio under the exit band before the never-worse "
+           "rollback re-applies the inverse leg (one-way actions — "
+           "evict/resize/replica-scale — just expire)."),
+        _k("HVDT_CONTROLLER_MIN_GAIN_S", 0.0, float,
+           "Minimum predicted step-seconds improvement a candidate "
+           "must clear (from the offline cost-model pricing) to be "
+           "applied; candidates below it are suppressed:no_gain."),
+        _k("HVDT_CONTROLLER_MAX_ACTIONS", 0, int,
+           "Total actions the controller may apply over one run (0 = "
+           "unbounded) — the blast-radius bound for unattended runs."),
         _k("HVDT_PERF_DEVIATION_RATIO", 2.0, float,
            "Fire a perf_deviation anomaly event when "
            "hvdt_perf_deviation_ratio (observed EWMA step seconds vs "

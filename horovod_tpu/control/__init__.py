@@ -1,0 +1,50 @@
+"""Online policy controller — the actuator over the sensor plane.
+
+PR-15 built the sensors (anomaly events, ``hvdt_perf_deviation_ratio``,
+per-axis wire-byte series, straggler/pod attribution) and earlier PRs
+built every actuator (state-compatible no-recompile autotune legs,
+``ElasticDriver.resize``, pod blacklisting, the serve replica target);
+nothing ACTED on the sensors mid-run.  This package closes the loop,
+ROADMAP item 4: the static ``horovodrun`` control model (Sergeev & Del
+Balso, 1802.05799) generalized into a self-tuning elastic driver.
+
+The loop, one tick::
+
+    anomaly event ──> candidates_for(event, state)       (actions.py)
+                  ──> ActionPricer.rank(...)             (pricing.py)
+                        offline CostModel pricing — no live probing
+                  ──> guardrails: hysteresis band, per-action cooldown,
+                      action budget                      (controller.py)
+                  ──> applier(action) at a step boundary
+                        transport/bucket/overlap/zero ride the autotune
+                        leg machinery (AutotunedStep.apply_leg — one
+                        optimizer state tree, re-jit only, memoized
+                        flip-back = zero recompiles); evict/resize/
+                        replica-scale ride the elastic driver seams
+                  ──> verify hvdt_perf_deviation_ratio recovers within
+                      HVDT_CONTROLLER_RECOVERY_WINDOW ticks, else the
+                      never-worse rollback re-flips
+                  ──> auditable decision record (event -> candidates ->
+                      predicted deltas -> chosen -> observed outcome)
+                      appended to the HVDT_EVENT_LOG JSONL
+
+Zero-overhead contract: with ``HVDT_CONTROLLER`` unset,
+:func:`get_controller` returns ``None`` from one cached env read and no
+wrapper or thread exists anywhere — the same engagement idiom as
+faults/telemetry/overlap.  The driver hook
+(``ElasticDriver._check_controller``) and the worker-side leg listener
+(:mod:`horovod_tpu.control.apply`) both gate on it.
+"""
+
+from .actions import (ACTION_KINDS, Action, ControllerState, EVENT_ACTIONS,
+                      candidates_for)
+from .pricing import ActionPricer, PricedAction
+from .controller import (ControllerConfig, Decision, PolicyController,
+                         get_controller, reset)
+from . import apply
+
+__all__ = [
+    "ACTION_KINDS", "Action", "ControllerState", "EVENT_ACTIONS",
+    "candidates_for", "ActionPricer", "PricedAction", "ControllerConfig",
+    "Decision", "PolicyController", "get_controller", "reset", "apply",
+]

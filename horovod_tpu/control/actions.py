@@ -1,0 +1,182 @@
+"""Action vocabulary + the event-class -> candidate-actions mapping.
+
+Every remediation the framework can perform mid-run is one
+:class:`Action`: a kind from :data:`ACTION_KINDS` plus a small
+parameter tuple (hashable, JSONable — decisions are replayed from the
+event log).  :func:`candidates_for` turns one anomaly event (the JSONL
+documents ``telemetry/anomaly.py`` emits) into the candidate set the
+pricer ranks; the mapping is a plain table (:data:`EVENT_ACTIONS`) so
+tests pin it and operators can read it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["ACTION_KINDS", "Action", "ControllerState", "EVENT_ACTIONS",
+           "candidates_for"]
+
+
+# The complete actuator set.  flip_transport / retune_bucket /
+# toggle_overlap / toggle_zero apply through AutotunedStep.apply_leg
+# (state-compatible rebuild, no recompile on flip-back); evict_pod /
+# resize ride the elastic driver; scale_replicas rides the serve
+# autoscaler's KV target override.
+ACTION_KINDS = ("flip_transport", "retune_bucket", "toggle_overlap",
+                "toggle_zero", "evict_pod", "resize", "scale_replicas")
+
+# Actions with an exact inverse — eligible for the never-worse
+# rollback.  Membership changes (evict/resize) and replica scaling are
+# one-way: the evicted pod re-joins through the blacklist cooldown, not
+# through the controller.
+REVERSIBLE_KINDS = frozenset(
+    {"flip_transport", "retune_bucket", "toggle_overlap", "toggle_zero"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One candidate remediation.  ``params`` is a sorted key/value
+    tuple so Action is hashable (cooldown bookkeeping keys on it)."""
+
+    kind: str
+    params: Tuple[Tuple[str, Any], ...] = ()
+    reason: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in ACTION_KINDS:
+            raise ValueError(f"unknown action kind {self.kind!r} "
+                             f"(one of {ACTION_KINDS})")
+
+    def param(self, key: str, default: Any = None) -> Any:
+        for k, v in self.params:
+            if k == key:
+                return v
+        return default
+
+    @property
+    def reversible(self) -> bool:
+        return self.kind in REVERSIBLE_KINDS
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "params": dict(self.params),
+                "reason": self.reason}
+
+    @staticmethod
+    def make(kind: str, reason: str = "", **params: Any) -> "Action":
+        return Action(kind=kind,
+                      params=tuple(sorted(params.items())),
+                      reason=reason)
+
+
+@dataclasses.dataclass
+class ControllerState:
+    """The controller's picture of the knobs it may move — the pricing
+    input and the thing appliers mutate.  Mirrors the autotune leg
+    dimensions plus the elastic/serve geometry."""
+
+    grad_bytes: float = 64 * 2 ** 20
+    bucket_bytes: int = 32 * 2 ** 20
+    transport_hier: bool = False
+    ici_wire: str = "f32"
+    dcn_wire: str = "f32"
+    overlap: bool = True
+    zero: bool = False
+    pods: int = 1
+    chips_per_pod: int = 4
+    pod_size: int = 4
+    replicas: int = 0
+    max_replicas: int = 0
+    step_time_s: Optional[float] = None
+
+    @property
+    def n_buckets(self) -> int:
+        return max(1, int(round(self.grad_bytes
+                                / max(1, self.bucket_bytes))))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# Event kind -> ordered candidate action kinds.  Order is a tie-break
+# only — the pricer ranks by predicted delta; equal-delta candidates
+# keep this (most-specific-remedy-first) order.
+EVENT_ACTIONS: Dict[str, Tuple[str, ...]] = {
+    # A pod (or rank) stepping slower than the cluster: cut it loose,
+    # or cheapen the exchange it is bottlenecking.
+    "step_time_shift": ("evict_pod", "flip_transport", "retune_bucket"),
+    "straggler_onset": ("evict_pod", "resize"),
+    # Throughput sagging without a named culprit: shrink the world to
+    # healthy pods, or (serving) add replicas.
+    "goodput_drop": ("resize", "scale_replicas"),
+    # Compute utilization down with comm exposed: move comm under
+    # compute or re-bucket the exchange.
+    "mfu_regression": ("toggle_overlap", "retune_bucket"),
+    # Wire-byte series drifted off the predicted schedule: the
+    # transport leg or bucketing no longer matches the topology.
+    "wire_drift": ("flip_transport", "retune_bucket"),
+    # Observed vs cost-model deviation: try every cheap leg.
+    "perf_deviation": ("flip_transport", "toggle_overlap",
+                       "toggle_zero", "retune_bucket"),
+}
+
+
+def _bucket_candidates(state: ControllerState, reason: str
+                       ) -> List[Action]:
+    """Retune candidates: halve and double the current threshold (the
+    two adjacent log2 legs the autotuner itself would explore)."""
+    out = []
+    for factor in (2.0, 0.5):
+        nb = int(state.bucket_bytes * factor)
+        if 2 ** 20 <= nb <= 2 ** 31:
+            out.append(Action.make("retune_bucket", reason=reason,
+                                   bucket_bytes=nb,
+                                   prev_bucket_bytes=state.bucket_bytes))
+    return out
+
+
+def candidates_for(event: Dict[str, Any],
+                   state: ControllerState) -> List[Action]:
+    """Expand one anomaly event into concrete candidate actions against
+    the current knob state.  Unknown event kinds map to no candidates
+    (the controller never guesses)."""
+    kinds = EVENT_ACTIONS.get(str(event.get("kind", "")), ())
+    reason = (f"{event.get('kind')}@"
+              f"{event.get('scope', 'cluster')}")
+    pod = str(event.get("pod") or "")
+    ratio = float(event.get("ratio") or 1.0)
+    out: List[Action] = []
+    for kind in kinds:
+        if kind == "flip_transport":
+            if state.pods > 1:
+                out.append(Action.make(
+                    "flip_transport", reason=reason,
+                    to="flat" if state.transport_hier else "hier",
+                    ratio=ratio))
+        elif kind == "retune_bucket":
+            out.extend(_bucket_candidates(state, reason))
+        elif kind == "toggle_overlap":
+            out.append(Action.make("toggle_overlap", reason=reason,
+                                   to=not state.overlap))
+        elif kind == "toggle_zero":
+            out.append(Action.make("toggle_zero", reason=reason,
+                                   to=not state.zero))
+        elif kind == "evict_pod":
+            # Only a pod-attributed event names an evictee, and never
+            # the last pod standing.
+            if pod and state.pods > 1:
+                out.append(Action.make("evict_pod", reason=reason,
+                                       pod=pod, ratio=ratio))
+        elif kind == "resize":
+            if state.pods > 1:
+                np_new = (state.pods - 1) * state.pod_size
+                out.append(Action.make("resize", reason=reason,
+                                       min_np=np_new, max_np=np_new,
+                                       pods=state.pods - 1,
+                                       ratio=ratio))
+        elif kind == "scale_replicas":
+            if state.replicas and state.replicas < state.max_replicas:
+                out.append(Action.make("scale_replicas", reason=reason,
+                                       target=state.replicas + 1,
+                                       ratio=ratio))
+    return out

@@ -1,0 +1,114 @@
+"""Leg application glue: how a driver-side decision reaches worker
+step functions without a recompile storm.
+
+The actuation channel is the rendezvous KV (the same channel autotune
+uses to broadcast rank 0's knob point): the driver publishes the wanted
+leg overrides under :data:`LEGS_KV_KEY` with a monotonically increasing
+``seq``; each worker polls the key at its step boundary (one KV read
+per commit cadence) and, when the seq advances, queues the legs on its
+``AutotunedStep`` via :meth:`~horovod_tpu.autotune.AutotunedStep.
+apply_leg`.  apply_leg adopts at the next ``__call__`` through the
+same state-compatible rebuild the tuner uses — one optimizer state
+tree, re-jit only, and a leg-memoizing builder flips back to an
+already-compiled program with zero recompiles (the contract
+tests/test_transport.py pins and tests/test_controller.py re-asserts
+under controller-driven flips).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .actions import Action
+
+log = logging.getLogger("horovod_tpu.control")
+
+__all__ = ["LEGS_KV_KEY", "legs_for_action", "publish_legs",
+           "poll_legs", "LegListener"]
+
+LEGS_KV_KEY = "/controller/legs"
+
+# Action kind -> builder keyword the AutotunedStep rebuild understands.
+_LEG_KW = {"flip_transport": "transport", "toggle_overlap": "overlap",
+           "toggle_zero": "zero"}
+
+
+def legs_for_action(action: Action) -> Dict[str, Any]:
+    """Translate one comm-shaped action into AutotunedStep builder
+    kwargs ({} for actions that don't move a leg)."""
+    if action.kind == "retune_bucket":
+        return {"threshold_bytes": int(action.param("bucket_bytes"))}
+    kw = _LEG_KW.get(action.kind)
+    if kw is None:
+        return {}
+    to = action.param("to")
+    if action.kind == "flip_transport":
+        return {kw: to == "hier"}
+    return {kw: bool(to)}
+
+
+def publish_legs(kv, legs: Dict[str, Any], seq: int) -> bool:
+    """Driver side: write the override document to the rendezvous KV.
+    Works against anything exposing either ``put(key, bytes)`` or the
+    in-process ``lock``/``store`` pair the elastic KV server has."""
+    doc = json.dumps({"seq": int(seq), "legs": dict(legs)},
+                     sort_keys=True).encode()
+    try:
+        if hasattr(kv, "put"):
+            kv.put(LEGS_KV_KEY, doc)
+        else:
+            with kv.lock:
+                kv.store[LEGS_KV_KEY] = doc
+        return True
+    except Exception as e:    # actuation must never sink the driver
+        log.warning("controller leg publish failed: %s", e)
+        return False
+
+
+def poll_legs(kv_get: Callable[[str], Optional[bytes]],
+              last_seq: int) -> Tuple[int, Dict[str, Any]]:
+    """Worker side: one KV read; returns ``(seq, legs)`` — legs is
+    empty when nothing new was published since ``last_seq``."""
+    try:
+        raw = kv_get(LEGS_KV_KEY)
+    except Exception:
+        return last_seq, {}
+    if not raw:
+        return last_seq, {}
+    try:
+        doc = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        seq = int(doc.get("seq", 0))
+        if seq <= last_seq:
+            return last_seq, {}
+        return seq, dict(doc.get("legs") or {})
+    except (ValueError, AttributeError, TypeError):
+        return last_seq, {}
+
+
+class LegListener:
+    """Per-worker adoption loop body: poll the KV override key and
+    queue fresh legs on the wrapped :class:`AutotunedStep`.
+
+    ::
+
+        listener = control.apply.LegListener(step, kv_client.get_local)
+        ...
+        listener.poll()     # at each commit point / step boundary
+    """
+
+    def __init__(self, step, kv_get: Callable[[str], Optional[bytes]]):
+        self._step = step
+        self._kv_get = kv_get
+        self._seq = 0
+
+    def poll(self) -> Dict[str, Any]:
+        """Returns the legs adopted this poll ({} when none)."""
+        seq, legs = poll_legs(self._kv_get, self._seq)
+        if seq == self._seq or not legs:
+            return {}
+        self._seq = seq
+        self._step.apply_leg(**legs)
+        log.info("controller legs adopted at seq %d: %s", seq, legs)
+        return legs

@@ -1,0 +1,428 @@
+"""The policy controller: guardrailed decide/apply/verify over the
+anomaly event stream.
+
+One :meth:`PolicyController.tick` (the driver calls it every discovery
+tick; tests drive it synthetically):
+
+1. **verify** — every previously applied action is watched for
+   ``HVDT_CONTROLLER_RECOVERY_WINDOW`` ticks: if the deviation ratio
+   falls back under the hysteresis exit band the decision is marked
+   ``recovered`` (observed delta recorded next to the predicted one);
+   if the window expires the never-worse rollback re-applies the
+   inverse action and the action kind goes on a doubled cooldown.
+2. **decide** — each new event is expanded to candidates
+   (:func:`~.actions.candidates_for`), priced offline
+   (:class:`~.pricing.ActionPricer`), and the best candidate clearing
+   the guardrails is applied through the bound applier — at a step
+   boundary by construction, since appliers either queue on
+   ``AutotunedStep.apply_leg`` (adopted at the next ``__call__``) or
+   ride driver seams that only act at the next rendezvous/commit.
+
+Guardrails, in suppression-precedence order (each suppression is an
+auditable record too):
+
+* **budget** — ``HVDT_CONTROLLER_MAX_ACTIONS`` total applies per run;
+* **hysteresis** — a trigger series must overshoot the ENTER band to
+  act and come back under the EXIT band before the same trigger key
+  may act again (no flapping on an oscillating series);
+* **cooldown** — ``HVDT_CONTROLLER_COOLDOWN_S`` per action kind
+  (doubled after a rollback), so one bad actuator can't thrash;
+* **min gain** — candidates must clear
+  ``HVDT_CONTROLLER_MIN_GAIN_S`` predicted seconds.
+
+Every decision — applied, suppressed, observed (dry-run), recovered,
+or rolled back — is appended to the ``HVDT_EVENT_LOG`` JSONL as a
+``controller_decision`` / ``controller_outcome`` record: event ->
+candidates -> predicted deltas -> chosen action -> observed outcome,
+replayable offline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .actions import Action, ControllerState, candidates_for
+from .pricing import ActionPricer, PricedAction
+from ..common import config
+
+log = logging.getLogger("horovod_tpu.control")
+
+__all__ = ["ControllerConfig", "Decision", "PolicyController",
+           "get_controller", "reset"]
+
+
+@dataclasses.dataclass
+class ControllerConfig:
+    """Knob bundle (``HVDT_CONTROLLER_*``; see docs/knobs.md)."""
+
+    mode: str = "act"                 # act | observe (dry-run)
+    cooldown_s: float = 60.0
+    enter_ratio: float = 1.2          # hysteresis: act at/above this
+    exit_ratio: float = 1.05          # ...re-arm/recover below this
+    recovery_window: int = 3          # verification ticks before rollback
+    min_gain_s: float = 0.0
+    max_actions: int = 0              # 0 = unbounded
+
+    @classmethod
+    def from_env(cls) -> "ControllerConfig":
+        raw = (config.get_str("HVDT_CONTROLLER") or "").strip().lower()
+        mode = "observe" if raw in ("observe", "dry-run", "dryrun") \
+            else "act"
+        return cls(
+            mode=mode,
+            cooldown_s=config.get_float("HVDT_CONTROLLER_COOLDOWN_S"),
+            enter_ratio=config.get_float("HVDT_CONTROLLER_ENTER_RATIO"),
+            exit_ratio=config.get_float("HVDT_CONTROLLER_EXIT_RATIO"),
+            recovery_window=config.get_int(
+                "HVDT_CONTROLLER_RECOVERY_WINDOW"),
+            min_gain_s=config.get_float("HVDT_CONTROLLER_MIN_GAIN_S"),
+            max_actions=config.get_int("HVDT_CONTROLLER_MAX_ACTIONS"))
+
+
+@dataclasses.dataclass
+class Decision:
+    """One decide() outcome — the in-memory twin of the JSONL record."""
+
+    event: Dict[str, Any]
+    candidates: List[PricedAction]
+    chosen: Optional[PricedAction]
+    outcome: str          # applied | observed | suppressed:<reason>
+    step: Optional[int] = None
+    ts: float = 0.0
+
+    def to_record(self) -> Dict[str, Any]:
+        return {
+            "kind": "controller_decision",
+            "event": {k: self.event.get(k) for k in
+                      ("kind", "scope", "pod", "rank", "ratio", "step")
+                      if k in self.event},
+            "candidates": [p.to_dict() for p in self.candidates],
+            "chosen": self.chosen.to_dict() if self.chosen else None,
+            "outcome": self.outcome,
+            "step": self.step,
+        }
+
+
+@dataclasses.dataclass
+class _PendingVerify:
+    """A committed action awaiting deviation recovery."""
+
+    decision: Decision
+    prior_state: ControllerState
+    trigger_key: str
+    deviation_at_decision: Optional[float]
+    ticks_left: int
+    rollback: Optional[Action]
+
+
+class PolicyController:
+    """See module docstring.  Thread-safe; the driver ticks it from the
+    discovery thread while tests tick it inline."""
+
+    def __init__(self, cfg: Optional[ControllerConfig] = None,
+                 pricer: Optional[ActionPricer] = None,
+                 state: Optional[ControllerState] = None,
+                 event_log=None, registry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg or ControllerConfig.from_env()
+        self.pricer = pricer or ActionPricer()
+        self.state = state or ControllerState()
+        self._explicit_log = event_log
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._appliers: Dict[str, Callable[[Action], bool]] = {}
+        self._cooldown_until: Dict[str, float] = {}
+        self._cooldown_s: Dict[str, float] = {}
+        self._disarmed: set = set()     # trigger keys awaiting exit band
+        self._pending: List[_PendingVerify] = []
+        self._applied_total = 0
+        reg = registry
+        if reg is None:
+            from ..telemetry.metrics import default_registry
+
+            reg = default_registry()
+        self._m_decisions = reg.counter(
+            "hvdt_controller_decisions_total",
+            "Controller decisions by action kind and outcome")
+        self._m_suppressed = reg.counter(
+            "hvdt_controller_suppressed_total",
+            "Controller decisions suppressed by guardrail")
+        self._m_rollbacks = reg.counter(
+            "hvdt_controller_rollbacks_total",
+            "Never-worse rollbacks (deviation failed to recover)")
+        self._m_pending = reg.gauge(
+            "hvdt_controller_pending",
+            "Applied actions awaiting deviation-recovery verification")
+        self._m_predicted = reg.gauge(
+            "hvdt_controller_predicted_delta_s",
+            "Predicted step-seconds delta of the last applied action")
+        self._m_observed = reg.gauge(
+            "hvdt_controller_observed_delta_s",
+            "Observed deviation-ratio delta of the last verified action")
+
+    # -- wiring ------------------------------------------------------------
+
+    def bind(self, kind: str, fn: Callable[[Action], bool]) -> None:
+        """Attach the applier for one action kind (driver seams or test
+        stubs).  The applier returns True when the action took."""
+        self._appliers[kind] = fn
+
+    def bind_appliers(self, appliers: Dict[str, Callable[[Action], bool]]
+                      ) -> None:
+        for k, fn in appliers.items():
+            self.bind(k, fn)
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- event log ---------------------------------------------------------
+
+    def _emit(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        sink = self._explicit_log
+        if sink is None:
+            from ..telemetry import anomaly
+
+            sink = anomaly.get_event_log()
+        if sink is not None:
+            return sink.emit(doc)
+        return doc
+
+    # -- the loop ----------------------------------------------------------
+
+    def tick(self, events: Sequence[Dict[str, Any]] = (),
+             deviation_ratio: Optional[float] = None,
+             observed_step_s: Optional[float] = None,
+             step: Optional[int] = None) -> List[Decision]:
+        """One control tick: verify pending actions, then decide on the
+        new events.  Returns the decisions made this tick."""
+        if observed_step_s is not None:
+            self.state.step_time_s = float(observed_step_s)
+        self._verify(deviation_ratio, step)
+        out = []
+        for ev in events or ():
+            d = self.decide(ev, deviation_ratio=deviation_ratio,
+                            step=step)
+            if d is not None:
+                out.append(d)
+        with self._lock:
+            self._m_pending.set(len(self._pending))
+        return out
+
+    def _trigger_key(self, event: Dict[str, Any]) -> str:
+        return (f"{event.get('kind', '')}:{event.get('scope', '')}:"
+                f"{event.get('pod') or event.get('rank') or ''}")
+
+    def decide(self, event: Dict[str, Any],
+               deviation_ratio: Optional[float] = None,
+               step: Optional[int] = None) -> Optional[Decision]:
+        """Price one event's candidates and apply the best one that
+        clears every guardrail.  Returns None for unmapped events."""
+        now = self._clock()
+        candidates = candidates_for(event, self.state)
+        if not candidates:
+            return None
+        priced = self.pricer.rank(self.state, candidates)
+        key = self._trigger_key(event)
+        if step is None:
+            step = event.get("step")
+        decision = Decision(event=event, candidates=priced, chosen=None,
+                            outcome="", step=step, ts=now)
+
+        with self._lock:
+            if (self.cfg.max_actions
+                    and self._applied_total >= self.cfg.max_actions):
+                return self._suppress(decision, "budget")
+            ratio = float(event.get("ratio") or 0.0)
+            if ratio and ratio < self.cfg.enter_ratio:
+                return self._suppress(decision, "hysteresis")
+            if key in self._disarmed:
+                return self._suppress(decision, "hysteresis")
+            chosen: Optional[PricedAction] = None
+            cooled = False
+            for p in priced:
+                if p.predicted_delta_s < self.cfg.min_gain_s:
+                    break   # ranked — nothing further clears the bar
+                if now < self._cooldown_until.get(p.action.kind, 0.0):
+                    cooled = True
+                    continue
+                chosen = p
+                break
+            if chosen is None:
+                return self._suppress(
+                    decision, "cooldown" if cooled else "no_gain")
+            decision.chosen = chosen
+            if self.cfg.mode == "observe":
+                decision.outcome = "observed"
+                self._m_decisions.inc(action=chosen.action.kind,
+                                      outcome="observed")
+                self._emit(decision.to_record())
+                return decision
+            applier = self._appliers.get(chosen.action.kind)
+
+        ok = False
+        if applier is not None:
+            try:
+                ok = bool(applier(chosen.action))
+            except Exception as e:    # an actuator must never sink us
+                log.warning("controller applier %s failed: %s",
+                            chosen.action.kind, e)
+        with self._lock:
+            if not ok:
+                return self._suppress(decision, "apply_failed")
+            decision.outcome = "applied"
+            self._applied_total += 1
+            cd = self._cooldown_s.get(chosen.action.kind,
+                                      self.cfg.cooldown_s)
+            self._cooldown_until[chosen.action.kind] = now + cd
+            self._disarmed.add(key)
+            prior = self.state
+            self.state = self.pricer.apply(prior, chosen.action)
+            self._pending.append(_PendingVerify(
+                decision=decision, prior_state=prior, trigger_key=key,
+                deviation_at_decision=deviation_ratio,
+                ticks_left=max(1, self.cfg.recovery_window),
+                rollback=self.pricer.inverse(prior, chosen.action)))
+            self._m_decisions.inc(action=chosen.action.kind,
+                                  outcome="applied")
+            self._m_predicted.set(chosen.predicted_delta_s)
+        self._emit(decision.to_record())
+        log.info("controller applied %s (predicted %.3gs/step) on %s",
+                 chosen.action.kind, chosen.predicted_delta_s,
+                 event.get("kind"))
+        return decision
+
+    def _suppress(self, decision: Decision, reason: str) -> Decision:
+        """(lock held) Record a guardrail suppression."""
+        decision.outcome = f"suppressed:{reason}"
+        self._m_suppressed.inc(reason=reason)
+        self._emit(decision.to_record())
+        return decision
+
+    # -- verification / rollback -------------------------------------------
+
+    def _verify(self, deviation_ratio: Optional[float],
+                step: Optional[int]) -> None:
+        rollbacks: List[_PendingVerify] = []
+        with self._lock:
+            still: List[_PendingVerify] = []
+            for p in self._pending:
+                recovered = (deviation_ratio is not None
+                             and deviation_ratio <= self.cfg.exit_ratio)
+                if recovered:
+                    before = p.deviation_at_decision
+                    observed = ((before - deviation_ratio)
+                                if before is not None else None)
+                    self._disarmed.discard(p.trigger_key)
+                    self._m_decisions.inc(
+                        action=p.decision.chosen.action.kind,
+                        outcome="recovered")
+                    if observed is not None:
+                        self._m_observed.set(observed)
+                    self._emit({
+                        "kind": "controller_outcome",
+                        "outcome": "recovered",
+                        "action": p.decision.chosen.action.to_dict(),
+                        "predicted_delta_s":
+                            p.decision.chosen.predicted_delta_s,
+                        "deviation_before": before,
+                        "deviation_after": deviation_ratio,
+                        "observed_delta": observed,
+                        "step": step,
+                    })
+                    continue
+                p.ticks_left -= 1
+                if p.ticks_left <= 0:
+                    rollbacks.append(p)
+                else:
+                    still.append(p)
+            self._pending = still
+        for p in rollbacks:
+            self._rollback(p, deviation_ratio, step)
+
+    def _rollback(self, p: _PendingVerify,
+                  deviation_ratio: Optional[float],
+                  step: Optional[int]) -> None:
+        """Never-worse: the deviation did not recover inside the window
+        — re-apply the inverse leg (one-way actions just expire) and
+        double this action kind's cooldown."""
+        kind = p.decision.chosen.action.kind
+        ok = None
+        if p.rollback is not None:
+            applier = self._appliers.get(kind)
+            if applier is not None:
+                try:
+                    ok = bool(applier(p.rollback))
+                except Exception as e:
+                    log.warning("controller rollback %s failed: %s",
+                                kind, e)
+                    ok = False
+            if ok:
+                with self._lock:
+                    self.state = self.pricer.apply(self.state,
+                                                   p.rollback)
+        with self._lock:
+            now = self._clock()
+            cd = 2 * self._cooldown_s.get(kind, self.cfg.cooldown_s)
+            self._cooldown_s[kind] = cd
+            self._cooldown_until[kind] = now + cd
+            # The trigger stays disarmed until the series itself exits
+            # the band — rollback is not permission to flap.
+            self._m_rollbacks.inc()
+            self._m_decisions.inc(action=kind, outcome="rolled_back")
+        self._emit({
+            "kind": "controller_outcome",
+            "outcome": "rolled_back" if p.rollback is not None
+            else "expired",
+            "action": p.decision.chosen.action.to_dict(),
+            "rollback": (p.rollback.to_dict()
+                         if p.rollback is not None else None),
+            "rollback_applied": ok,
+            "predicted_delta_s": p.decision.chosen.predicted_delta_s,
+            "deviation_before": p.deviation_at_decision,
+            "deviation_after": deviation_ratio,
+            "step": step,
+        })
+        log.warning("controller rolled back %s (deviation %.3s did not "
+                    "recover)", kind, str(deviation_ratio))
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead engagement (the faults/telemetry/overlap idiom)
+# ---------------------------------------------------------------------------
+
+
+_lock = threading.Lock()
+_cached_env: Optional[str] = "\0unset"
+_cached: Optional[PolicyController] = None
+
+
+def get_controller() -> Optional[PolicyController]:
+    """The process-wide controller, or ``None`` when ``HVDT_CONTROLLER``
+    is unset/empty/0 — one cached env read, no object, no thread."""
+    global _cached_env, _cached
+    raw = os.environ.get("HVDT_CONTROLLER")
+    if raw != _cached_env:
+        with _lock:
+            if raw != _cached_env:
+                val = (raw or "").strip().lower()
+                if val and val not in ("0", "off", "false"):
+                    _cached = PolicyController()
+                else:
+                    _cached = None
+                _cached_env = raw
+    return _cached
+
+
+def reset() -> None:
+    """Drop the cached controller (test isolation)."""
+    global _cached_env, _cached
+    with _lock:
+        _cached_env = "\0unset"
+        _cached = None

@@ -1,0 +1,184 @@
+"""Offline candidate pricing — every action is costed by the analytical
+model BEFORE it is committed, never by live probing.
+
+Comm-shaped actions (transport flip, bucket retune, overlap toggle)
+are priced in predicted exposed-comm seconds per step: the candidate is
+applied to a copy of the :class:`~.actions.ControllerState`, the
+per-step gradient exchange is re-priced with
+``CostModel.allreduce_seconds`` on the state's topology, and the delta
+vs the current state is the predicted gain.  When the caller holds real
+:class:`ScheduleFingerprint` objects per transport leg (the driver does
+when ``HVDT_EXPECTED_SCHEDULE`` names one), those are priced with
+``CostModel.evaluate`` instead — the controller then picks exactly what
+the offline ranking picks on the same fingerprint (acceptance scenario
+b pins this).
+
+Membership actions (evict a straggler pod, resize) are priced from the
+event's observed slowdown ratio: a synchronous step runs at the
+straggler's pace, so removing a pod stepping at ``ratio``x the median
+buys ``step_time * (1 - 1/ratio)`` per step, minus whatever the
+exchange on the shrunken topology costs extra.  Replica scaling
+(serving) has no cost-model term; it is priced from the ratio alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .actions import Action, ControllerState
+
+__all__ = ["PricedAction", "ActionPricer"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PricedAction:
+    """One candidate with its offline price tag."""
+
+    action: Action
+    predicted_s: float        # predicted exposed comm s/step after it
+    predicted_delta_s: float  # baseline - predicted (positive = gain)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"action": self.action.to_dict(),
+                "predicted_s": round(self.predicted_s, 9),
+                "predicted_delta_s": round(self.predicted_delta_s, 9)}
+
+
+class ActionPricer:
+    """CostModel-backed candidate pricing over a ControllerState.
+
+    Args:
+      model: a ``CostModel`` (default: from the checked-in
+        calibration).  Scenario (b): hand in a model whose calibration
+        reflects the CHANGED dcn bandwidth and the ranking moves with
+        it — same code path offline and in the loop.
+      fingerprints: optional ``{"flat"|"hier": ScheduleFingerprint}``;
+        when both legs are present, transport candidates are priced by
+        ``CostModel.evaluate`` on the real fingerprints instead of the
+        closed-form allreduce.
+    """
+
+    def __init__(self, model=None, fingerprints: Optional[Dict[str, Any]]
+                 = None):
+        if model is None:
+            from ..analysis.costmodel import CostModel
+
+            model = CostModel()
+        self.model = model
+        self.fingerprints = dict(fingerprints or {})
+
+    # -- state pricing -----------------------------------------------------
+
+    def _topo(self, state: ControllerState):
+        from ..analysis.topology import TopologySpec
+
+        return TopologySpec(pods=max(1, int(state.pods)),
+                            chips_per_pod=max(1, int(state.chips_per_pod)))
+
+    def comm_seconds(self, state: ControllerState) -> float:
+        """Predicted EXPOSED comm seconds of one step's gradient
+        exchange under ``state``: n_buckets allreduces of
+        grad_bytes/n_buckets each; an overlapped schedule hides every
+        bucket but the last under compute (the same accounting
+        ``CostModel.evaluate`` applies to barrier groups)."""
+        leg = "hier" if (state.transport_hier and state.pods > 1) \
+            else "flat"
+        fp = self.fingerprints.get(leg)
+        if fp is not None:
+            return float(self.model.evaluate(
+                fp, self._topo(state)).exposed_comm_s)
+        n = state.n_buckets
+        per_bytes = state.grad_bytes / n
+        per = self.model.allreduce_seconds(
+            per_bytes, self._topo(state),
+            hierarchical=state.transport_hier and state.pods > 1,
+            ici_wire=state.ici_wire, dcn_wire=state.dcn_wire)["seconds"]
+        total = per * n
+        return per if (state.overlap and n > 1) else total
+
+    # -- action application (pure) ----------------------------------------
+
+    def apply(self, state: ControllerState, action: Action
+              ) -> ControllerState:
+        """The candidate's effect on the knob state — pure, used both
+        for pricing what-ifs and to advance the controller's state
+        after a commit."""
+        k = action.kind
+        if k == "flip_transport":
+            return dataclasses.replace(
+                state, transport_hier=not state.transport_hier)
+        if k == "retune_bucket":
+            return dataclasses.replace(
+                state, bucket_bytes=int(action.param(
+                    "bucket_bytes", state.bucket_bytes)))
+        if k == "toggle_overlap":
+            return dataclasses.replace(state, overlap=not state.overlap)
+        if k == "toggle_zero":
+            return dataclasses.replace(state, zero=not state.zero)
+        if k in ("evict_pod", "resize"):
+            pods = int(action.param("pods", state.pods - 1))
+            return dataclasses.replace(state, pods=max(1, pods))
+        if k == "scale_replicas":
+            return dataclasses.replace(
+                state, replicas=int(action.param(
+                    "target", state.replicas)))
+        return state
+
+    def inverse(self, state: ControllerState, action: Action
+                ) -> Optional[Action]:
+        """The rollback action undoing ``action`` from ``state`` (the
+        state BEFORE the action), or None for one-way actions."""
+        if not action.reversible:
+            return None
+        k = action.kind
+        reason = f"rollback:{action.reason}"
+        if k == "retune_bucket":
+            return Action.make("retune_bucket", reason=reason,
+                               bucket_bytes=state.bucket_bytes,
+                               prev_bucket_bytes=int(action.param(
+                                   "bucket_bytes", state.bucket_bytes)))
+        if k == "flip_transport":
+            return Action.make(
+                "flip_transport", reason=reason,
+                to="hier" if state.transport_hier else "flat")
+        if k == "toggle_overlap":
+            return Action.make("toggle_overlap", reason=reason,
+                               to=state.overlap)
+        return Action.make("toggle_zero", reason=reason, to=state.zero)
+
+    # -- pricing -----------------------------------------------------------
+
+    def price(self, state: ControllerState, action: Action
+              ) -> PricedAction:
+        base = self.comm_seconds(state)
+        after = self.apply(state, action)
+        if action.kind in ("flip_transport", "retune_bucket",
+                           "toggle_overlap"):
+            predicted = self.comm_seconds(after)
+            return PricedAction(action, predicted, base - predicted)
+        if action.kind == "toggle_zero":
+            # ZeRO trades optimizer HBM for a reduce-scatter-shaped
+            # wire; its step-time effect is second-order, so it prices
+            # neutral and only wins when nothing else does.
+            return PricedAction(action, base, 0.0)
+        if action.kind in ("evict_pod", "resize"):
+            ratio = max(1.0, float(action.param("ratio", 1.0)))
+            step_s = state.step_time_s if state.step_time_s else base
+            straggler_gain = step_s * (1.0 - 1.0 / ratio)
+            predicted = self.comm_seconds(after)
+            return PricedAction(action, predicted,
+                                straggler_gain + (base - predicted))
+        # scale_replicas — no comm term; gain scales with how far the
+        # triggering series overshot its threshold.
+        ratio = max(1.0, float(action.param("ratio", 1.0)))
+        step_s = state.step_time_s if state.step_time_s else base
+        return PricedAction(action, base,
+                            step_s * (1.0 - 1.0 / ratio))
+
+    def rank(self, state: ControllerState, actions: List[Action]
+             ) -> List[PricedAction]:
+        """All candidates priced, best predicted delta first; ties keep
+        the mapping table's order (stable sort)."""
+        priced = [self.price(state, a) for a in actions]
+        return sorted(priced, key=lambda p: -p.predicted_delta_s)

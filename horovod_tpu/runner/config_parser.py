@@ -41,6 +41,11 @@ YAML shape (mirrors the reference's config sections)::
     elastic:
       pod_size: 4
       pod_straggler_evict: 3
+    controller:
+      enabled: on
+      cooldown_s: 60.0
+      recovery_window: 3
+      max_actions: 8
     telemetry:
       enabled: true
       metrics_port: 9090
@@ -235,6 +240,33 @@ KNOB_FLAGS: List[_Flag] = [
           "Seconds a failed host sits out of elastic discovery before "
           "becoming eligible again (0 = permanent blacklist).",
           type=float),
+    # --- closed-loop policy controller (control/controller.py; runs in
+    #     the elastic driver's discovery loop and prices sensor-plane
+    #     events with the cost model before acting) ---
+    _Flag("--controller", "controller", "HVDT_CONTROLLER",
+          "controller", "enabled",
+          "Enable the driver-side policy controller (on | observe | "
+          "off): subscribes to the cluster anomaly event stream, prices "
+          "candidate actions (transport flip, bucket retune, "
+          "overlap/ZeRO toggle, pod evict, resize, replica scale) with "
+          "the cost model offline, and applies the winner at a step "
+          "boundary through the no-recompile autotune legs; 'observe' "
+          "logs priced decisions without acting (needs --telemetry)."),
+    _Flag("--controller-cooldown-s", "controller_cooldown_s",
+          "HVDT_CONTROLLER_COOLDOWN_S", "controller", "cooldown_s",
+          "Per-action-kind cooldown (seconds) between controller "
+          "actions of the same kind; doubled after a rollback.",
+          type=float),
+    _Flag("--controller-recovery-window", "controller_recovery_window",
+          "HVDT_CONTROLLER_RECOVERY_WINDOW", "controller",
+          "recovery_window",
+          "Telemetry ticks the controller waits for "
+          "hvdt_perf_deviation_ratio to recover below the exit band "
+          "before rolling a reversible action back.", type=int),
+    _Flag("--controller-max-actions", "controller_max_actions",
+          "HVDT_CONTROLLER_MAX_ACTIONS", "controller", "max_actions",
+          "Lifetime cap on applied controller actions per run "
+          "(0 = unlimited).", type=int),
     # --- telemetry / observability ---
     _Flag("--telemetry", "telemetry", "HVDT_TELEMETRY",
           "telemetry", "enabled",

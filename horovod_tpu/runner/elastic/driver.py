@@ -100,6 +100,12 @@ class ElasticDriver:
         # configured — cluster events (a pod-wide step-time shift is
         # ONE event) land in the driver's JSONL event log.
         self._cluster_anomalies = None
+        # Online policy controller (horovod_tpu/control): bound lazily
+        # on the first tick that finds HVDT_CONTROLLER set — the
+        # zero-overhead contract (control.get_controller() is None
+        # otherwise, and nothing below exists).
+        self._controller = None
+        self._controller_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -144,7 +150,8 @@ class ElasticDriver:
                 self._notify_hosts_updated()
             self._poll_worker_registry()
             self._check_pod_stragglers()
-            self._check_cluster_anomalies()
+            events = self._check_cluster_anomalies()
+            self._check_controller(events)
 
     def _poll_worker_registry(self) -> None:
         """Feed KV-reported worker states (workers put
@@ -244,28 +251,126 @@ class ElasticDriver:
 
         return _aggregate.rollup(snaps)
 
-    def _check_cluster_anomalies(self) -> None:
+    def _check_cluster_anomalies(self):
         """Run the cluster anomaly rules over the fleet snapshots each
         discovery tick (active only when HVDT_EVENT_LOG names a driver-
-        side event log — the zero-overhead gate)."""
+        side event log — the zero-overhead gate).  Returns the events
+        that newly fired this tick — the controller's input."""
         if self._kv is None:
-            return
+            return []
+        events = []
         try:
             from ...telemetry import anomaly as _anomaly
 
             if self._cluster_anomalies is None:
                 if _anomaly.get_event_log() is None:
-                    return
+                    return []
                 self._cluster_anomalies = _anomaly.ClusterAnomalyMonitor()
             snaps = self.telemetry_snapshots()
             if not snaps:
-                return
-            for ev in self._cluster_anomalies.observe(snaps):
+                return []
+            events = self._cluster_anomalies.observe(snaps)
+            for ev in events:
                 print(f"elastic: anomaly {ev.get('kind')} "
                       f"({ev.get('scope')}): {ev.get('message')}",
                       file=sys.stderr)
         except Exception as e:   # detection must never sink the driver
             print(f"elastic: cluster anomaly check failed: {e}",
+                  file=sys.stderr)
+        return events
+
+    # -- online policy controller (horovod_tpu/control) --------------------
+
+    def _bind_controller(self, ctl) -> None:
+        """Wire the controller's action kinds to the driver seams it
+        acts through.  Comm-leg actions publish a KV override the
+        workers' LegListener adopts at their next step boundary;
+        membership actions ride the same paths the straggler rung and
+        the serving autoscaler already use."""
+        from ... import control as _control
+
+        def _evict(action) -> bool:
+            pod = str(action.param("pod") or "")
+            if not pod:
+                return False
+            self._hm.blacklist_pod(pod)
+            self._hm.update_available_hosts()
+            self._notify_hosts_updated()
+            return True
+
+        def _resize(action) -> bool:
+            self.resize(min_np=action.param("min_np"),
+                        max_np=action.param("max_np"))
+            return True
+
+        def _scale(action) -> bool:
+            if self._kv is None:
+                return False
+            from ...serve.autoscale import TARGET_KV_KEY
+
+            with self._kv.lock:
+                self._kv.store[TARGET_KV_KEY] = str(
+                    int(action.param("target"))).encode()
+            return True
+
+        def _leg(action) -> bool:
+            if self._kv is None:
+                return False
+            legs = _control.apply.legs_for_action(action)
+            if not legs:
+                return False
+            self._controller_seq += 1
+            return _control.apply.publish_legs(self._kv, legs,
+                                               self._controller_seq)
+
+        ctl.bind_appliers({
+            "evict_pod": _evict, "resize": _resize,
+            "scale_replicas": _scale, "flip_transport": _leg,
+            "retune_bucket": _leg, "toggle_overlap": _leg,
+            "toggle_zero": _leg,
+        })
+
+    def _check_controller(self, events) -> None:
+        """One controller tick per discovery tick: feed the fresh
+        anomaly events plus the fleet's deviation/step picture, let it
+        verify pending actions and decide on the new ones."""
+        try:
+            from ... import control as _control
+
+            ctl = _control.get_controller()
+            if ctl is None:
+                return
+            if ctl is not self._controller:
+                self._bind_controller(ctl)
+                # Seed the geometry the pricer needs from the live
+                # cluster picture.
+                pods = {s.pod for s in self.assignments if s.pod}
+                if pods:
+                    ctl.state.pods = len(pods)
+                if self._pod_slots:
+                    ctl.state.pod_size = self._pod_slots
+                    ctl.state.chips_per_pod = self._pod_slots
+                self._controller = ctl
+            snaps = self.telemetry_snapshots()
+            deviation = None
+            step = None
+            step_s = None
+            if snaps:
+                ratios = [float(s.get("perf_deviation_ratio") or 0.0)
+                          for s in snaps.values()]
+                deviation = max(ratios) if any(ratios) else None
+                steps = [int(s.get("step") or 0) for s in snaps.values()]
+                step = max(steps) if steps else None
+                from ...telemetry import aggregate as _aggregate
+
+                means = _aggregate.recent_step_means(snaps)
+                if means:
+                    vals = sorted(means.values())
+                    step_s = vals[(len(vals) - 1) // 2]
+            ctl.tick(events or (), deviation_ratio=deviation,
+                     observed_step_s=step_s, step=step)
+        except Exception as e:   # the loop must never sink the driver
+            print(f"elastic: controller tick failed: {e}",
                   file=sys.stderr)
 
     def _check_pod_stragglers(self) -> None:
@@ -485,6 +590,12 @@ def run_elastic(args) -> int:
     from ..launch import knob_env_for
 
     knob_env = knob_env_for(args)
+    # The policy controller lives in THIS process (discovery loop), not
+    # in the workers, so its knobs must reach the driver's own env —
+    # knob_env is only forwarded into worker processes.
+    for _k, _v in knob_env.items():
+        if _k.startswith("HVDT_CONTROLLER") or _k == "HVDT_EVENT_LOG":
+            os.environ[_k] = _v
     if knob_env.get("HVDT_CPU_OPERATIONS", "").lower() == "tcp":
         # The static rank->addr contract HVDT_TCP_ADDRS encodes cannot
         # survive elastic membership changes; reject up front instead of

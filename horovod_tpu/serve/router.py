@@ -59,6 +59,11 @@ __all__ = ["Router", "ReplicaView", "NoReplicaAvailable"]
 
 log = get_logger(__name__)
 
+# The SLO classes the continuous engine schedules (serve/llm/scheduler
+# TENANTS) plus the bucket everything else lands in — a fixed set so
+# request bodies can't mint unbounded label cardinality.
+_TENANTS = ("interactive", "batch", "default")
+
 
 class NoReplicaAvailable(RuntimeError):
     """No admitting replica in the routing set (all dead, draining, or
@@ -140,14 +145,16 @@ class _Handler(BaseHTTPRequestHandler):
             return
         retry_ok = self.headers.get("X-HVDT-No-Retry", "") not in ("1",
                                                                    "true")
+        tenant = rt.tenant_of(body)
         try:
             status, payload, replica_id = rt.dispatch(body,
-                                                      retry=retry_ok)
+                                                      retry=retry_ok,
+                                                      tenant=tenant)
         except NoReplicaAvailable as e:
             rt._no_replica.inc()
             self._reply(503, json.dumps({"error": str(e)}).encode(),
                         extra_headers={"Retry-After": "1"})
-            rt._observe("predict", t0, 503)
+            rt._observe("predict", t0, 503, tenant=tenant)
             return
         headers = {}
         if replica_id is not None:
@@ -155,7 +162,7 @@ class _Handler(BaseHTTPRequestHandler):
         if status == 503:
             headers["Retry-After"] = "1"
         self._reply(status, payload, extra_headers=headers)
-        rt._observe("predict", t0, status)
+        rt._observe("predict", t0, status, tenant=tenant)
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -210,11 +217,20 @@ class Router:
         m = self.metrics
         self._requests = m.counter(
             "hvdt_router_requests_total",
-            "Requests through the router by route and upstream status")
+            "Requests through the router by route, upstream status and "
+            "tenant class")
         self._latency = m.summary(
             "hvdt_router_request_latency_ms",
             "End-to-end router /predict latency (ms), retries and "
             "hedges included")
+        # Per-tenant latency rides the same name-family idiom as the
+        # continuous engine's hvdt_engine_wait_ms_<tenant> (a Summary
+        # carries no labels); tenant classes come from the request body
+        # the continuous engine already carries.
+        self._tenant_latency = {
+            t: m.summary(f"hvdt_router_request_latency_ms_{t}",
+                         f"End-to-end /predict latency, {t} tenant (ms)")
+            for t in _TENANTS}
         self._upstream = m.summary(
             "hvdt_router_upstream_latency_ms",
             "Single-attempt replica round-trip latency (ms) — feeds "
@@ -225,10 +241,10 @@ class Router:
             "wire/5xx failure")
         self._hedges = m.counter(
             "hvdt_router_hedges_total",
-            "Hedge requests issued past the hedge threshold")
+            "Hedge requests issued past the hedge threshold, by tenant")
         self._hedge_wins = m.counter(
             "hvdt_router_hedge_wins_total",
-            "Hedge requests that answered before the primary")
+            "Hedge requests that answered before the primary, by tenant")
         self._ejections = m.counter(
             "hvdt_router_ejections_total",
             "Replicas pulled from routing, labelled reason="
@@ -436,7 +452,8 @@ class Router:
         return status, payload
 
     def _forward_hedged(self, view: ReplicaView, body: bytes,
-                        timeout: float) -> Tuple[int, bytes, int]:
+                        timeout: float, tenant: str = "default"
+                        ) -> Tuple[int, bytes, int]:
         """Forward with tail hedging: fire a duplicate to a second
         replica past the hedge threshold; first completion wins, a
         failed first completion falls back to the other."""
@@ -475,7 +492,7 @@ class Router:
                 second = self._pick(exclude={view.id})
                 if second is None:
                     continue    # nobody to hedge to; keep waiting
-                self._hedges.inc()
+                self._hedges.inc(tenant=tenant)
                 threading.Thread(target=attempt, args=(second, True),
                                  daemon=True).start()
                 outstanding += 1
@@ -487,7 +504,7 @@ class Router:
                 # job; the hedge only fights latency.
                 status, payload = res
                 if was_hedge:
-                    self._hedge_wins.inc()
+                    self._hedge_wins.inc(tenant=tenant)
                 return status, payload, v.id
             first_err = err
         if first_err is not None:
@@ -497,11 +514,14 @@ class Router:
         raise TimeoutError(f"no replica answered within "
                            f"{timeout:.1f}s")
 
-    def dispatch(self, body: bytes, retry: bool = True
+    def dispatch(self, body: bytes, retry: bool = True,
+                 tenant: Optional[str] = None
                  ) -> Tuple[int, bytes, Optional[int]]:
         """Route one /predict body.  Returns (status, payload,
         replica_id).  Raises :class:`NoReplicaAvailable` when the
         routing set is (and stays) empty."""
+        if tenant is None:
+            tenant = self.tenant_of(body)
         inj = faults.get_injector()
         if inj is not None:
             with self._lock:
@@ -528,7 +548,8 @@ class Router:
                 continue
             try:
                 status, payload, rid = self._forward_hedged(
-                    view, body, max(0.05, deadline - time.monotonic()))
+                    view, body, max(0.05, deadline - time.monotonic()),
+                    tenant=tenant)
             except (ConnectionError, OSError, TimeoutError) as e:
                 # Wire death mid-request: the replica is suspect — eject
                 # (cooldown applies) and retry the request elsewhere.
@@ -558,9 +579,30 @@ class Router:
 
     # -- HTTP front --------------------------------------------------------
 
-    def _observe(self, route: str, t0: float, status: int) -> None:
-        self._latency.observe((time.perf_counter() - t0) * 1000.0)
-        self._requests.inc(route=route, status=str(status))
+    @staticmethod
+    def tenant_of(body: bytes) -> str:
+        """The request's tenant class for metric attribution: the
+        ``tenant`` field the continuous engine carries in the /predict
+        JSON, folded into the fixed class set.  Bodies without one (the
+        static engine, non-JSON payloads) attribute to ``default`` —
+        and skip the JSON parse entirely."""
+        if b'"tenant"' not in body:
+            return "default"
+        try:
+            t = json.loads(body.decode("utf-8", "replace")).get("tenant")
+        except (ValueError, AttributeError):
+            return "default"
+        return t if t in _TENANTS else "default"
+
+    def _observe(self, route: str, t0: float, status: int,
+                 tenant: str = "default") -> None:
+        ms = (time.perf_counter() - t0) * 1000.0
+        self._latency.observe(ms)
+        lat = self._tenant_latency.get(tenant)
+        if lat is not None:
+            lat.observe(ms)
+        self._requests.inc(route=route, status=str(status),
+                           tenant=tenant)
 
     def describe(self) -> Dict[str, Any]:
         with self._lock:

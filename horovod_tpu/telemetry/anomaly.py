@@ -154,11 +154,32 @@ def rate_shift(points: Sequence[Tuple[float, int, float]],
 
 class EventLog:
     """Append-only JSONL anomaly event log (one JSON object per line,
-    flushed per event so a crashed run keeps everything it saw)."""
+    flushed per event so a crashed run keeps everything it saw).
 
-    def __init__(self, path: str):
+    Bounded: when ``HVDT_EVENT_LOG_MAX_BYTES`` is set (> 0) and an
+    append would push the file past it, the current file rotates to
+    ``<path>.1`` (keep-1 — the previous ``.1`` is replaced) and the
+    append starts a fresh file, so a long run with a chatty controller
+    can't grow the log unboundedly while the newest window plus one
+    rotation of history always survives."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = str(path)
+        self.max_bytes = int(
+            config.get_int("HVDT_EVENT_LOG_MAX_BYTES")
+            if max_bytes is None else max_bytes)
         self._lock = threading.Lock()
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """(lock held) keep-1 size rotation before an oversize append."""
+        if self.max_bytes <= 0:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size and size + incoming > self.max_bytes:
+            os.replace(self.path, self.path + ".1")
 
     def emit(self, event: Dict[str, Any]) -> Dict[str, Any]:
         doc = dict(event)
@@ -167,6 +188,7 @@ class EventLog:
         line = json.dumps(doc, sort_keys=True)
         with self._lock:
             try:
+                self._maybe_rotate(len(line) + 1)
                 with open(self.path, "a") as fh:
                     fh.write(line + "\n")
             except OSError as e:   # the log must never sink training

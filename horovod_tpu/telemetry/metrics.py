@@ -474,6 +474,28 @@ CATALOG: Dict[str, MetricSpec] = {
            "Driver-side roll-ups that skipped a rank whose KV snapshot "
            "carried no step id / time series (old snapshot schema or "
            "history off on that worker)"),
+        # -- online policy controller (horovod_tpu/control) --
+        _m("hvdt_controller_decisions_total", "counter",
+           ("action", "outcome"),
+           "Controller decisions by action kind (flip_transport | "
+           "retune_bucket | toggle_overlap | toggle_zero | evict_pod | "
+           "resize | scale_replicas) and outcome (applied | observed | "
+           "recovered | rolled_back)"),
+        _m("hvdt_controller_suppressed_total", "counter", ("reason",),
+           "Controller decisions suppressed by guardrail (budget | "
+           "hysteresis | cooldown | no_gain | apply_failed)"),
+        _m("hvdt_controller_rollbacks_total", "counter", (),
+           "Never-worse rollbacks: applied actions whose deviation "
+           "ratio failed to recover inside the window"),
+        _m("hvdt_controller_pending", "gauge", (),
+           "Applied actions currently awaiting deviation-recovery "
+           "verification"),
+        _m("hvdt_controller_predicted_delta_s", "gauge", (),
+           "Cost-model-predicted step-seconds gain of the last applied "
+           "action"),
+        _m("hvdt_controller_observed_delta_s", "gauge", (),
+           "Observed deviation-ratio improvement of the last verified "
+           "action (predicted-vs-observed closes the audit loop)"),
         # -- straggler (telemetry/straggler.py) --
         _m("hvdt_straggler_rank", "gauge", (),
            "Worst straggler rank over the last window (-1 = none)"),
@@ -524,17 +546,24 @@ CATALOG: Dict[str, MetricSpec] = {
         _m("hvdt_distributed_optimizer_builds_total", "counter", (),
            "DistributedOptimizer/GradientTransformation constructions"),
         # -- serving router (serve/router.py) --
-        _m("hvdt_router_requests_total", "counter", (),
-           "Requests admitted by the serving router front tier"),
+        _m("hvdt_router_requests_total", "counter",
+           ("route", "status", "tenant"),
+           "Requests admitted by the serving router front tier, by "
+           "route, upstream status and tenant class (interactive | "
+           "batch | default)"),
         _m("hvdt_router_request_latency_ms", "summary", (),
-           "Router end-to-end /predict latency (ms)"),
+           "Router end-to-end /predict latency (ms), all tenants"),
+        _m("hvdt_router_request_latency_ms_*", "summary", (),
+           "Per-tenant router /predict latency "
+           "(hvdt_router_request_latency_ms_<tenant>; Summary carries "
+           "no labels)"),
         _m("hvdt_router_upstream_latency_ms", "summary", (),
            "Router upstream (replica) dispatch latency (ms)"),
         _m("hvdt_router_retries_total", "counter", (),
            "Wire-death retries dispatched to another replica"),
-        _m("hvdt_router_hedges_total", "counter", (),
+        _m("hvdt_router_hedges_total", "counter", ("tenant",),
            "Hedge requests issued past the hedge threshold"),
-        _m("hvdt_router_hedge_wins_total", "counter", (),
+        _m("hvdt_router_hedge_wins_total", "counter", ("tenant",),
            "Hedge requests that answered before the primary"),
         _m("hvdt_router_ejections_total", "counter", ("reason",),
            "Replica ejections by reason (probe | slo | dispatch)"),

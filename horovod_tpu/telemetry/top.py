@@ -7,7 +7,10 @@ polls one or more workers' ``/timeseries`` endpoints (the history layer,
 * a per-rank step-time sparkline with current/median step time,
 * goodput fraction, MFU, and the perf-deviation ratio where published,
 * the worst pod by recent step time,
-* the tail of the anomaly event log (``--event-log``).
+* the tail of the anomaly event log (``--event-log``),
+* the last few policy-controller decisions (``controller_decision`` /
+  ``controller_outcome`` records in the same event log): event ->
+  chosen action -> predicted delta -> outcome.
 
 Example frame::
 
@@ -33,7 +36,10 @@ import threading
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-__all__ = ["main", "sparkline", "render_frame", "fetch_timeseries"]
+__all__ = ["main", "sparkline", "render_frame", "fetch_timeseries",
+           "controller_lines"]
+
+_CONTROLLER_KINDS = ("controller_decision", "controller_outcome")
 
 _BLOCKS = "▁▂▃▄▅▆▇█"
 
@@ -88,6 +94,45 @@ def _median(vals: List[float]) -> Optional[float]:
     return ordered[(len(ordered) - 1) // 2]
 
 
+def _action_str(action: Optional[Dict[str, Any]]) -> str:
+    if not action:
+        return "?"
+    params = action.get("params") or {}
+    inner = ",".join(f"{k}={v}" for k, v in sorted(params.items()))
+    return f"{action.get('kind', '?')}({inner})" if inner \
+        else str(action.get("kind", "?"))
+
+
+def controller_lines(events: List[Dict[str, Any]], last: int = 4
+                     ) -> List[str]:
+    """Render the last ``last`` controller records from the event log —
+    one line each: what fired, what was chosen at what predicted delta,
+    and how it ended (applied/suppressed/recovered/rolled back)."""
+    recs = [e for e in events if e.get("kind") in _CONTROLLER_KINDS]
+    out = []
+    for r in recs[-last:]:
+        step = r.get("step", "?")
+        if r.get("kind") == "controller_decision":
+            chosen = r.get("chosen") or {}
+            delta = chosen.get("predicted_delta_s")
+            deltas = (f" pred {delta * 1e3:+.1f}ms"
+                      if isinstance(delta, (int, float)) else "")
+            out.append(
+                f"  [step {step}] {(r.get('event') or {}).get('kind', '?')}"
+                f" -> {_action_str(chosen.get('action'))}{deltas}"
+                f" [{r.get('outcome', '?')}]")
+        else:
+            before, after = r.get("deviation_before"), \
+                r.get("deviation_after")
+            dev = (f" dev {before:.2f}->{after:.2f}"
+                   if isinstance(before, (int, float))
+                   and isinstance(after, (int, float)) else "")
+            out.append(
+                f"  [step {step}] {_action_str(r.get('action'))}"
+                f" -> {r.get('outcome', '?')}{dev}")
+    return out
+
+
 def render_frame(docs: Dict[str, Optional[Dict[str, Any]]],
                  events: Optional[List[Dict[str, Any]]] = None,
                  width: int = 24) -> str:
@@ -140,16 +185,23 @@ def render_frame(docs: Dict[str, Optional[Dict[str, Any]]],
     if footer:
         lines.append("   ".join(footer))
     if events:
-        lines.append("anomalies:")
-        for ev in events[-5:]:
-            who = []
-            if ev.get("rank") is not None:
-                who.append(f"rank={ev['rank']}")
-            if ev.get("pod"):
-                who.append(f"pod={ev['pod']}")
-            lines.append(f"  [step {ev.get('step', '?')}] "
-                         f"{ev.get('kind', '?')} {' '.join(who)}: "
-                         f"{ev.get('message', '')}")
+        anomalies = [e for e in events
+                     if e.get("kind") not in _CONTROLLER_KINDS]
+        if anomalies:
+            lines.append("anomalies:")
+            for ev in anomalies[-5:]:
+                who = []
+                if ev.get("rank") is not None:
+                    who.append(f"rank={ev['rank']}")
+                if ev.get("pod"):
+                    who.append(f"pod={ev['pod']}")
+                lines.append(f"  [step {ev.get('step', '?')}] "
+                             f"{ev.get('kind', '?')} {' '.join(who)}: "
+                             f"{ev.get('message', '')}")
+        ctl = controller_lines(events)
+        if ctl:
+            lines.append("controller:")
+            lines.extend(ctl)
     return "\n".join(lines)
 
 

@@ -197,6 +197,24 @@ def _parse_args(argv=None):
                          "cache.")
     ap.add_argument("--controller-events", type=int, default=2000,
                     help="Synthetic events to push for --controller.")
+    ap.add_argument("--fleet", metavar="TRACE", default=None,
+                    help="Fleet-scheduler trace replay: run the "
+                         "trace-driven CPU chaos simulation "
+                         "(horovod_tpu.fleet.simulate) for a builtin "
+                         "trace name (diurnal, flash_crowd, "
+                         "step_function) or a trace JSON path "
+                         "(tools/traces/*.json) and emit the "
+                         "goodput-vs-SLO report — goodput_fraction, "
+                         "slo_compliance, reclaims, drains, "
+                         "dropped_requests — as one JSON line.  Pure "
+                         "CPU, in-process; never touches the last-good "
+                         "cache.")
+    ap.add_argument("--fleet-pods", type=int, default=5,
+                    help="Fleet size (pods) for --fleet.")
+    ap.add_argument("--fleet-fault-plan", default=None,
+                    help="resilience.faults plan injected into the "
+                         "--fleet replay (e.g. "
+                         "'pod_crash@step=12:pod=pod3').")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
@@ -256,6 +274,33 @@ def _run_controller_bench(args) -> None:
         "suppressed": int(ctl._m_suppressed.total()),
         "mean_predicted_delta_ms": round(
             1e3 * sum(deltas) / len(deltas), 3) if deltas else 0.0,
+    }))
+
+
+def _run_fleet_bench(args) -> None:
+    """Fleet-scheduler trace replay (in-process): the REAL scheduler —
+    same pricing, guardrails, and event records as the live launcher —
+    against a fluid-queue serving model and a TopologySpec-priced pod
+    fleet.  One JSON line: goodput_fraction, slo_compliance, reclaims,
+    drains, dropped_requests (the acceptance numbers of the
+    fleet-scheduler PR)."""
+    from horovod_tpu.fleet.simulate import simulate_trace
+    from horovod_tpu.fleet.traces import load_trace
+
+    report = simulate_trace(
+        load_trace(args.fleet), pods=max(2, args.fleet_pods),
+        fault_plan=args.fleet_fault_plan)
+    print(json.dumps({
+        "metric": "fleet_trace_replay",
+        "trace": report["trace"],
+        "pods": report["pods"],
+        "goodput_fraction": report["goodput_fraction"],
+        "slo_compliance": report["slo_compliance"],
+        "reclaims": report["reclaims"],
+        "backfills": report["backfills"],
+        "drains": report["drains"],
+        "rollbacks": report["rollbacks"],
+        "dropped_requests": report["dropped_requests"],
     }))
 
 
@@ -1121,6 +1166,12 @@ def main() -> None:
         # Pure-CPU in-process control-loop storm — no child, no
         # accelerator, no last-good cache.
         _run_controller_bench(args)
+        return
+
+    if args.fleet:
+        # Pure-CPU in-process fleet trace replay — no child, no
+        # accelerator, no last-good cache.
+        _run_fleet_bench(args)
         return
 
     if args.serve_llm:

@@ -19,7 +19,9 @@
 #       continuous-batching-llm-serve (paged KV / scheduler /
 #       prefix-sharing / ring-prefill) +
 #       closed-loop-policy-controller (pricing / guardrails /
-#       leg-actuation / driver-hook) tests on
+#       leg-actuation / driver-hook) +
+#       fleet-scheduler (shared inventory / seq-guarded target doc /
+#       bin-packing reclaim-backfill / trace-driven chaos sim) tests on
 #       CPU) — the pre-merge gate.  The full matrix additionally
 #       emits the `analysis` service: python -m horovod_tpu.analysis
 #       --all --perf as a hard gate over the hvdt-lint ratchet

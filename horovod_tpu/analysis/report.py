@@ -130,6 +130,46 @@ def render_report(target: str) -> str:
             lines.append(f"| {kind} | {len(steps_k)} | {min(steps_k)} "
                          f"| {max(steps_k)} |")
         lines.append("")
+
+        fleet = [e for e in events
+                 if e.get("kind") in ("fleet_decision", "fleet_outcome")]
+        if fleet:
+            lines += [
+                "## Fleet scheduler",
+                "",
+                "| step | record | move | predicted gain | pressure |"
+                " outcome |",
+                "|---|---|---|---|---|---|",
+            ]
+            for e in fleet:
+                if e.get("kind") == "fleet_decision":
+                    chosen = e.get("chosen") or {}
+                    move = chosen.get("move") or {}
+                    gain = chosen.get("predicted_gain")
+                    press = (e.get("trigger") or {}).get("ratio")
+                else:
+                    move = e.get("move") or {}
+                    gain = e.get("predicted_gain")
+                    before = e.get("pressure_before")
+                    after = e.get("pressure_after")
+                    press = (f"{before:.2f}→{after:.2f}"
+                             if isinstance(before, (int, float))
+                             and isinstance(after, (int, float))
+                             else None)
+                move_s = (f"{move.get('kind', '?')}({move.get('pod')})"
+                          if move else "—")
+                lines.append("| " + " | ".join([
+                    str(e.get("step", "")),
+                    str(e.get("kind", "")),
+                    move_s,
+                    (f"{gain:+.3f}"
+                     if isinstance(gain, (int, float)) else "—"),
+                    (f"{press:.2f}"
+                     if isinstance(press, (int, float))
+                     else press or "—"),
+                    str(e.get("outcome", "")),
+                ]) + " |")
+            lines.append("")
     else:
         lines += ["## Run timeline", "",
                   "No anomaly events found — either a clean run, or "

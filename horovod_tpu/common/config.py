@@ -284,6 +284,65 @@ KNOBS: Dict[str, Knob] = {
         _k("HVDT_CONTROLLER_MAX_ACTIONS", 0, int,
            "Total actions the controller may apply over one run (0 = "
            "unbounded) — the blast-radius bound for unattended runs."),
+        # --- fleet scheduler (horovod_tpu/fleet: one pod inventory,
+        #     two workloads — training backfills serving's trough and
+        #     drains when router pressure crosses the band) ---
+        _k("HVDT_FLEET", "", str,
+           "Engage the bin-packing fleet scheduler over the shared pod "
+           "inventory: serving pressure (router queue depth per "
+           "replica vs HVDT_SERVE_QUEUE_HI, p99 vs the SLO) above the "
+           "ENTER band reclaims a training pod for serving (exit-83 "
+           "drain; emergency commit + peer-RAM restore make it cheap), "
+           "and a deep trough backfills a serve pod to training — "
+           "every move priced offline (cost model at the candidate "
+           "world size vs predicted SLO headroom) and wrapped in the "
+           "controller guardrail battery.  Values: empty/0 (default) "
+           "= off (fleet.get_scheduler() is None, zero overhead); "
+           "1/on = act; observe = decide + log but never move a pod.  "
+           "Decisions append fleet_decision / fleet_outcome records "
+           "to the event JSONL.  When active it owns the "
+           "/serve/target_replicas key via a seq-guarded doc; the "
+           "controller's scale_replicas action becomes a hint routed "
+           "through it; raw-int KV / --target-file overrides still "
+           "win."),
+        _k("HVDT_FLEET_COOLDOWN_S", 60.0, float,
+           "Per-move-kind cooldown: after the fleet scheduler applies "
+           "a reclaim or backfill, the same kind is ineligible for "
+           "this many seconds (doubled after each never-worse "
+           "rollback) so one workload cannot thrash the other."),
+        _k("HVDT_FLEET_ENTER_RATIO", 1.2, float,
+           "Hysteresis ENTER band on the serving pressure ratio "
+           "(queue/HVDT_SERVE_QUEUE_HI or p99/SLO, whichever is "
+           "worse): pressure must reach this factor before a reclaim "
+           "fires (below it -> suppressed:hysteresis)."),
+        _k("HVDT_FLEET_EXIT_RATIO", 1.05, float,
+           "Hysteresis EXIT band: pressure must fall back under this "
+           "factor for an applied reclaim to count as recovered and "
+           "for the pressure trigger to re-arm — the enter/exit split "
+           "that keeps a flappy traffic series from ping-ponging "
+           "pods."),
+        _k("HVDT_FLEET_BACKFILL_RATIO", 0.5, float,
+           "Trough band: serving pressure at/below this fraction of "
+           "threshold marks a trough, releasing one serve pod back to "
+           "training (never below the serve floor, and charged the "
+           "predicted pressure increase before commit)."),
+        _k("HVDT_FLEET_RECOVERY_WINDOW", 3, int,
+           "Scheduler ticks an applied move gets to prove itself: a "
+           "reclaim must bring pressure under the exit band before "
+           "the window expires or the never-worse rollback backfills "
+           "the pod home; a backfill that pushes pressure over the "
+           "ENTER band inside the window is reclaimed back."),
+        _k("HVDT_FLEET_MIN_GAIN", 0.0, float,
+           "Minimum predicted gain (dimensionless: serving relief "
+           "minus training throughput cost) a candidate move must "
+           "clear; candidates below it are suppressed:no_gain."),
+        _k("HVDT_FLEET_MAX_MOVES", 0, int,
+           "Total moves the fleet scheduler may apply over one run "
+           "(0 = unbounded) — the blast-radius bound."),
+        _k("HVDT_FLEET_MIN_TRAIN_PODS", 1, int,
+           "Floor on pods leased to training: reclaims never shrink "
+           "the training world below this many pods (the elastic "
+           "min_np analog at fleet granularity)."),
         _k("HVDT_PERF_DEVIATION_RATIO", 2.0, float,
            "Fire a perf_deviation anomaly event when "
            "hvdt_perf_deviation_ratio (observed EWMA step seconds vs "

@@ -1,0 +1,38 @@
+"""One fleet, two workloads — the bin-packing scheduler that lets
+elastic training and elastic serving share a single pod inventory.
+
+ROADMAP item 5's utilization story ("Exploring the limits of
+Concurrency in ML Training on Google TPUs", PAPERS.md): a diurnal
+serving trough leaves chips idle and a flash crowd has no sanctioned
+way to reclaim them as long as training and serving are launched as
+two separate worlds.  This package closes the loop:
+
+* :mod:`inventory` — the single pod inventory with per-workload
+  leases, sharing ``PodTracker``/``HostManager`` blacklist+cooldown
+  state so one crashed pod is unavailable to BOTH workloads with one
+  correlated event.
+* :mod:`scheduler` — the bin-packing reconciler above
+  ``ElasticDriver.resize()`` and ``ServeDriver``'s replica-target KV
+  key, every move priced before commit and wrapped in the PR-18
+  guardrail battery (cooldown, hysteresis, min-gain, budget, observe
+  mode, never-worse rollback).
+* :mod:`traces` + :mod:`simulate` — synthetic traffic traces and the
+  CPU chaos simulator that replays them (plus ``resilience.faults``
+  plans) against the same scheduler code, pricing pod-scale capacity
+  with ``TopologySpec`` + the cost model and no devices.
+
+Engagement follows the faults/controller idiom: ``get_scheduler()``
+returns ``None`` unless ``HVDT_FLEET`` is set — the unset path is one
+env read, zero objects, zero threads.
+"""
+
+from .inventory import FleetInventory, Lease                   # noqa: F401
+from .scheduler import (FleetConfig, FleetScheduler, Move,     # noqa: F401
+                        PricedMove, get_scheduler, install, read_target,
+                        reset, write_target)
+from .traces import TrafficTrace, load_trace                   # noqa: F401
+
+__all__ = ["FleetInventory", "Lease", "FleetConfig", "FleetScheduler",
+           "Move", "PricedMove", "TrafficTrace", "load_trace",
+           "get_scheduler", "install", "reset", "read_target",
+           "write_target"]

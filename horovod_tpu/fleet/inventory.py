@@ -1,0 +1,169 @@
+"""The single pod inventory both workloads lease from.
+
+Training and serving already share the rendezvous/KV machinery and the
+exit taxonomy; what they did NOT share was the answer to "who owns pod
+X right now".  :class:`FleetInventory` is that answer: an ordered pod
+set (the discovery ``@pod`` columns / ``HVDT_POD_SIZE`` chunking that
+:func:`runner.elastic.pods.group_pods` produces) with at most one
+**lease** per pod, keyed by workload kind (``"train"`` / ``"serve"``).
+
+Failure state is *shared, not duplicated*: the inventory rides the same
+:class:`~..runner.elastic.pods.PodTracker` exit-window correlation and
+:class:`~..runner.elastic.discovery.HostManager` blacklist-with-cooldown
+the two drivers already use, so a crashed pod is unavailable to BOTH
+workloads through ONE correlated removal event — N ranks of a dying
+slice cost one blacklist entry and one lease release, never one per
+workload per rank (the drain-under-failure test pins exactly this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..common.logging_util import get_logger
+from ..runner.elastic import pods as pods_mod
+from ..runner.elastic.discovery import HostManager
+
+__all__ = ["Lease", "FleetInventory", "WORKLOAD_KINDS"]
+
+log = get_logger(__name__)
+
+WORKLOAD_KINDS = ("train", "serve")
+
+
+@dataclasses.dataclass(frozen=True)
+class Lease:
+    """One pod leased to one workload."""
+
+    pod: str
+    kind: str            # one of WORKLOAD_KINDS
+    acquired_at: float
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"pod": self.pod, "kind": self.kind,
+                "acquired_at": round(self.acquired_at, 3)}
+
+
+class FleetInventory:
+    """Leases over an ordered pod set, sharing the elastic failure state.
+
+    ``host_manager`` / ``pod_tracker`` are the SAME objects the training
+    and serving drivers hold (or fresh ones for standalone simulation):
+    a pod blacklisted by either driver is excluded from
+    :meth:`available` here, and :meth:`record_failure` folds correlated
+    exits into one removal event via the tracker window before it
+    blacklists + releases — so the scheduler's retry lands elsewhere and
+    the lease is released exactly once per loss.
+    """
+
+    def __init__(self, pods: Sequence[str],
+                 host_manager: Optional[HostManager] = None,
+                 pod_tracker: Optional[pods_mod.PodTracker] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._order: List[str] = list(dict.fromkeys(pods))
+        self._hm = host_manager
+        self._tracker = pod_tracker or pods_mod.PodTracker()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._leases: Dict[str, Lease] = {}
+        self.release_events = 0    # audit: every lease release, once each
+
+    @property
+    def tracker(self) -> pods_mod.PodTracker:
+        return self._tracker
+
+    @property
+    def pods(self) -> List[str]:
+        return list(self._order)
+
+    # -- leases ------------------------------------------------------------
+
+    def acquire(self, pod: str, kind: str) -> bool:
+        """Lease ``pod`` to ``kind``.  Refused (False) when the pod is
+        unknown, already leased, blacklisted, or draining."""
+        if kind not in WORKLOAD_KINDS:
+            raise ValueError(f"unknown workload kind {kind!r}; "
+                             f"valid: {WORKLOAD_KINDS}")
+        if pod not in self._order or not self._usable(pod):
+            return False
+        with self._lock:
+            if pod in self._leases:
+                return False
+            self._leases[pod] = Lease(pod, kind, self._clock())
+            return True
+
+    def release(self, pod: str) -> bool:
+        """Release ``pod``'s lease.  Exactly-once: True only when a
+        lease was actually held — the double-release a crash landing
+        mid-reclaim could cause is a no-op, not a second event."""
+        with self._lock:
+            if self._leases.pop(pod, None) is None:
+                return False
+            self.release_events += 1
+            return True
+
+    def lease_of(self, pod: str) -> Optional[Lease]:
+        with self._lock:
+            return self._leases.get(pod)
+
+    def leased(self, kind: Optional[str] = None) -> List[str]:
+        """Pods currently leased (inventory order), optionally filtered
+        to one workload kind."""
+        with self._lock:
+            held = {p: ls for p, ls in self._leases.items()}
+        return [p for p in self._order if p in held
+                and (kind is None or held[p].kind == kind)]
+
+    # -- availability (shared failure state) -------------------------------
+
+    def _usable(self, pod: str) -> bool:
+        if self._hm is not None and self._hm.is_pod_blacklisted(pod):
+            return False
+        return pod not in self._tracker.drained_pods()
+
+    def available(self) -> List[str]:
+        """Unleased pods placeable for EITHER workload: not leased, not
+        blacklisted, not draining — one view, both drivers' state."""
+        with self._lock:
+            held = set(self._leases)
+        return [p for p in self._order
+                if p not in held and self._usable(p)]
+
+    def record_failure(self, pod: str, now: Optional[float] = None) -> bool:
+        """One rank's failure exit on ``pod``.  Returns True only when
+        this OPENS the pod-removal event (the PodTracker window folds
+        the slice's remaining exits into it) — and only then does the
+        pod get blacklisted and its lease released, so a pod_crash
+        landing DURING a reclaim still costs exactly one event, one
+        blacklist entry, and one release."""
+        if not self._tracker.record_failure(pod, now=now):
+            return False
+        if self._hm is not None:
+            self._hm.blacklist_pod(pod)
+        released = self.release(pod)
+        log.warning("fleet: pod %s removed (correlated failure event; "
+                    "lease %sreleased)", pod,
+                    "" if released else "already ")
+        return True
+
+    def drain(self, pod: str, now: Optional[float] = None) -> bool:
+        """Mark ``pod`` draining (preemption / platform reclaim) for
+        both workloads and release its lease."""
+        fresh = self._tracker.drain(pod, now=now)
+        self.release(pod)
+        return fresh
+
+    # -- introspection -----------------------------------------------------
+
+    def describe(self) -> Dict[str, object]:
+        with self._lock:
+            leases = [ls.to_dict() for _, ls in
+                      sorted(self._leases.items())]
+        return {"pods": list(self._order),
+                "leases": leases,
+                "available": self.available(),
+                "removal_events": self._tracker.removal_events,
+                "release_events": self.release_events}

@@ -1,0 +1,764 @@
+"""The bin-packing fleet reconciler: priced, guardrailed pod moves
+between elastic training and elastic serving.
+
+Sits ABOVE the two drivers' existing seams — ``ElasticDriver.resize()``
+(training joins/leaves at pod granularity; exit-83 drains plus
+emergency-commit + peer-RAM restore make a shrink cheap) and
+``ServeDriver``'s replica-target KV key — and owns exactly two move
+kinds over the shared :class:`~.inventory.FleetInventory`:
+
+* ``reclaim`` — serving pressure (router queue depth per replica /
+  p99-vs-SLO headroom) crossed the ENTER band: drain one training pod
+  and hand it to serving.
+* ``backfill`` — the diurnal trough: serving pressure is far below the
+  band, so a serve pod goes back to training.
+
+Every move is **priced before commit**, never probed live: the training
+side by ``CostModel.allreduce_seconds`` at the candidate world size
+plus the compute anchor (the goodput the chips would earn), the serving
+side by predicted SLO headroom under queue-proportional p99 scaling.
+Reclaim candidates are ranked slowest-pod-first: a synchronous step
+runs at the straggler's pace, so reclaiming the pod with the worst
+step-time median costs the least goodput — and the SAME ranking
+function drives the CPU simulator (:mod:`.simulate`), which is how the
+acceptance criterion "simulated reclaim ranking agrees with the live
+decision on the same inputs" holds by construction.
+
+The guardrail battery is the PR-18 controller's, verbatim in spirit:
+per-move-kind cooldown (doubled after a rollback), hysteresis
+enter/exit bands over the pressure series, a min-gain floor, a total
+move budget, observe (dry-run) mode, and a never-worse rollback — a
+reclaim that fails to bring pressure back under the exit band within
+the recovery window is inverted (the pod backfills home).  Every
+decision and outcome is an auditable ``fleet_decision`` /
+``fleet_outcome`` record in the ``HVDT_EVENT_LOG`` JSONL, rendered by
+``hvdtrun top`` and ``analysis --report``.
+
+The scheduler also owns ``/serve/target_replicas``: it writes a
+**seq-guarded JSON doc** (:func:`write_target`) carrying a last-writer
+audit field, while a raw-int KV value or ``--target-file`` stays the
+operator override that beats everyone.  The PR-18 controller's
+``scale_replicas`` action is routed here as a *hint*
+(:meth:`FleetScheduler.hint_scale`) whenever a scheduler is active,
+which resolves the two-writers race on the key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common import config
+from ..common.logging_util import get_logger
+from .inventory import FleetInventory
+
+__all__ = ["MOVE_KINDS", "Move", "PricedMove", "FleetConfig",
+           "FleetDecision", "FleetScheduler", "read_target",
+           "write_target", "get_scheduler", "install", "reset"]
+
+log = get_logger(__name__)
+
+MOVE_KINDS = ("reclaim", "backfill")
+
+
+# ---------------------------------------------------------------------------
+# The seq-guarded replica-target doc (satellite: one key, many writers)
+# ---------------------------------------------------------------------------
+
+
+def read_target(raw: Optional[bytes]) -> Optional[Dict[str, Any]]:
+    """Decode the ``/serve/target_replicas`` value into a uniform doc.
+
+    Three on-wire forms, by precedence at the reader:
+
+    * raw int (``b"3"``) — the operator's out-of-band override
+      (``seq`` is None: it beats every doc writer);
+    * JSON doc ``{"target": n, "seq": k, "writer": ...}`` — the
+      fleet scheduler / routed controller hint, seq-guarded;
+    * anything else — None (garbage never scales a fleet).
+    """
+    if raw is None:
+        return None
+    try:
+        text = raw.decode()
+    except UnicodeDecodeError:
+        return None
+    try:
+        return {"target": int(text), "seq": None, "writer": "operator"}
+    except ValueError:
+        pass
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return None
+    if not isinstance(doc, dict) or "target" not in doc:
+        return None
+    try:
+        doc["target"] = int(doc["target"])
+    except (TypeError, ValueError):
+        return None
+    return doc
+
+
+def write_target(kv: Any, target: int, writer: str, reason: str = "",
+                 expect_seq: Optional[int] = None
+                 ) -> Optional[Dict[str, Any]]:
+    """Seq-guarded write of the replica-target doc.
+
+    Read-increment-write under the KV lock: each successful write bumps
+    ``seq`` by one and stamps the last-writer audit field.  Refused
+    (None) when a raw-int operator override currently owns the key, or
+    when ``expect_seq`` is given and the key's seq moved underneath the
+    caller — the compare-and-swap that makes two concurrent writers
+    (fleet scheduler vs controller hint) serialize instead of racing.
+    """
+    from ..serve.autoscale import TARGET_KV_KEY
+
+    with kv.lock:
+        cur = read_target(kv.store.get(TARGET_KV_KEY))
+        if cur is not None and cur.get("seq") is None:
+            return None     # operator raw int owns the key
+        seq = int(cur.get("seq") or 0) if cur else 0
+        if expect_seq is not None and seq != expect_seq:
+            return None
+        doc = {"target": int(target), "seq": seq + 1,
+               "writer": str(writer), "reason": str(reason),
+               "ts": time.time()}
+        kv.store[TARGET_KV_KEY] = json.dumps(doc).encode()
+        return doc
+
+
+# ---------------------------------------------------------------------------
+# Moves + pricing
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Move:
+    """One candidate pod move between the workloads."""
+
+    kind: str            # reclaim | backfill
+    pod: str
+    reason: str = ""
+
+    def __post_init__(self) -> None:
+        if self.kind not in MOVE_KINDS:
+            raise ValueError(f"unknown move kind {self.kind!r}; "
+                             f"valid: {MOVE_KINDS}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "pod": self.pod, "reason": self.reason}
+
+
+@dataclasses.dataclass(frozen=True)
+class PricedMove:
+    """A move with its offline price tag (all terms dimensionless
+    fractions of entitlement, so train and serve sides compare)."""
+
+    move: Move
+    predicted_gain: float        # serve relief minus train cost
+    train_fraction_after: float  # predicted training throughput keep
+    pressure_after: float        # predicted serving pressure after
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"move": self.move.to_dict(),
+                "predicted_gain": round(self.predicted_gain, 6),
+                "train_fraction_after":
+                    round(self.train_fraction_after, 6),
+                "pressure_after": round(self.pressure_after, 6)}
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knob bundle (``HVDT_FLEET_*``; see docs/knobs.md)."""
+
+    mode: str = "act"               # act | observe (dry-run)
+    cooldown_s: float = 60.0
+    enter_ratio: float = 1.2        # pressure at/above this -> reclaim
+    exit_ratio: float = 1.05        # ...recovered/re-armed below this
+    backfill_ratio: float = 0.5     # pressure below this -> trough
+    recovery_window: int = 3        # verify ticks before rollback
+    min_gain: float = 0.0           # predicted-gain floor (fraction)
+    max_moves: int = 0              # 0 = unbounded
+    min_train_pods: int = 1
+    min_serve_units: int = 1
+    queue_hi: float = 8.0           # pressure denominator (serve knob)
+
+    @classmethod
+    def from_env(cls) -> "FleetConfig":
+        raw = (config.get_str("HVDT_FLEET") or "").strip().lower()
+        mode = "observe" if raw in ("observe", "dry-run", "dryrun") \
+            else "act"
+        return cls(
+            mode=mode,
+            cooldown_s=config.get_float("HVDT_FLEET_COOLDOWN_S"),
+            enter_ratio=config.get_float("HVDT_FLEET_ENTER_RATIO"),
+            exit_ratio=config.get_float("HVDT_FLEET_EXIT_RATIO"),
+            backfill_ratio=config.get_float("HVDT_FLEET_BACKFILL_RATIO"),
+            recovery_window=config.get_int("HVDT_FLEET_RECOVERY_WINDOW"),
+            min_gain=config.get_float("HVDT_FLEET_MIN_GAIN"),
+            max_moves=config.get_int("HVDT_FLEET_MAX_MOVES"),
+            min_train_pods=config.get_int("HVDT_FLEET_MIN_TRAIN_PODS"),
+            queue_hi=config.get_float("HVDT_SERVE_QUEUE_HI"))
+
+
+@dataclasses.dataclass
+class FleetDecision:
+    """One tick outcome — the in-memory twin of the JSONL record."""
+
+    trigger: Dict[str, Any]
+    candidates: List[PricedMove]
+    chosen: Optional[PricedMove]
+    outcome: str          # applied | observed | suppressed:<reason>
+    step: Optional[int] = None
+    train_pods: int = 0
+    serve_units: int = 0
+
+    def to_record(self) -> Dict[str, Any]:
+        return {
+            "kind": "fleet_decision",
+            "trigger": self.trigger,
+            "candidates": [p.to_dict() for p in self.candidates],
+            "chosen": self.chosen.to_dict() if self.chosen else None,
+            "outcome": self.outcome,
+            "step": self.step,
+            "train_pods": self.train_pods,
+            "serve_units": self.serve_units,
+        }
+
+
+@dataclasses.dataclass
+class _PendingVerify:
+    decision: FleetDecision
+    trigger_key: str
+    pressure_at_decision: float
+    ticks_left: int
+    rollback: Optional[Move]
+
+
+class FleetScheduler:
+    """See module docstring.  Thread-safe; the launcher ticks it from a
+    control thread while the simulator and tests tick it inline.
+
+    The 1-pod-per-serve-unit model: a reclaimed pod adds exactly one
+    replica-unit of serving capacity and a backfilled pod removes one —
+    the bin the packing happens in IS the pod, matching the whole-pod
+    join/leave invariant on the training side.
+    """
+
+    def __init__(self, inventory: FleetInventory,
+                 cfg: Optional[FleetConfig] = None,
+                 model=None, kv: Any = None,
+                 event_log=None, registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 grad_bytes: Optional[float] = None,
+                 flops_per_step: Optional[float] = None,
+                 chips_per_pod: int = 4,
+                 peak_flops: Optional[float] = None):
+        from ..analysis.topology import (REFERENCE_STEP_WORKLOAD,
+                                         chip_peak_flops)
+
+        self.inventory = inventory
+        self.cfg = cfg or FleetConfig.from_env()
+        if model is None:
+            from ..analysis.costmodel import CostModel
+
+            model = CostModel()
+        self.model = model
+        self.kv = kv
+        self._explicit_log = event_log
+        self._clock = clock
+        self.grad_bytes = float(
+            grad_bytes if grad_bytes is not None
+            else REFERENCE_STEP_WORKLOAD["grad_bytes"])
+        self.flops_per_step = float(
+            flops_per_step if flops_per_step is not None
+            else REFERENCE_STEP_WORKLOAD["flops_per_step"])
+        self.chips_per_pod = max(1, int(chips_per_pod))
+        # Same peak-rate source as the MFU gauge and the perf gate —
+        # never a literal here (v5e is the fleet's reference chip).
+        self.peak_flops = float(
+            peak_flops if peak_flops is not None
+            else chip_peak_flops("v5e") or 0.0)
+        self._lock = threading.Lock()
+        self._appliers: Dict[str, Callable[[Move], bool]] = {}
+        self._cooldown_until: Dict[str, float] = {}
+        self._cooldown_s: Dict[str, float] = {}
+        self._disarmed: set = set()
+        self._pending: List[_PendingVerify] = []
+        self._applied_total = 0
+        self._last_signals: Dict[str, Any] = {}
+        self.moves_applied: Dict[str, int] = {k: 0 for k in MOVE_KINDS}
+        self.rollbacks = 0      # audit: never-worse rollbacks fired
+        reg = registry
+        if reg is None:
+            from ..telemetry.metrics import default_registry
+
+            reg = default_registry()
+        self._m_decisions = reg.counter(
+            "hvdt_fleet_decisions_total",
+            "Fleet scheduler decisions by move kind and outcome")
+        self._m_suppressed = reg.counter(
+            "hvdt_fleet_suppressed_total",
+            "Fleet scheduler decisions suppressed by guardrail")
+        self._m_rollbacks = reg.counter(
+            "hvdt_fleet_rollbacks_total",
+            "Never-worse fleet rollbacks (pressure failed to recover)")
+        self._m_pending = reg.gauge(
+            "hvdt_fleet_pending",
+            "Applied fleet moves awaiting pressure verification")
+        self._m_pressure = reg.gauge(
+            "hvdt_fleet_pressure",
+            "Serving pressure the fleet scheduler last acted on")
+        self._m_train_pods = reg.gauge(
+            "hvdt_fleet_train_pods",
+            "Pods currently leased to training")
+        self._m_serve_units = reg.gauge(
+            "hvdt_fleet_serve_units",
+            "Pods currently leased to serving")
+
+    # -- wiring ------------------------------------------------------------
+
+    def bind(self, kind: str, fn: Callable[[Move], bool]) -> None:
+        """Attach the applier for one move kind (driver seams in the
+        launcher, state mutators in the simulator/tests)."""
+        if kind not in MOVE_KINDS:
+            raise ValueError(f"unknown move kind {kind!r}")
+        self._appliers[kind] = fn
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def _emit(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        sink = self._explicit_log
+        if sink is None:
+            from ..telemetry import anomaly
+
+            sink = anomaly.get_event_log()
+        if sink is not None:
+            return sink.emit(doc)
+        return doc
+
+    # -- pricing -----------------------------------------------------------
+
+    def train_step_seconds(self, pods: int) -> float:
+        """Predicted step seconds at ``pods`` training pods: the cost
+        model's gradient exchange on that topology plus the compute
+        anchor — the same closed form the PR-18 pricer uses, evaluated
+        on CPU with no devices (TopologySpec is declarative)."""
+        from ..analysis.topology import TopologySpec
+
+        pods = max(1, int(pods))
+        topo = TopologySpec(pods=pods, chips_per_pod=self.chips_per_pod)
+        comm = self.model.allreduce_seconds(
+            self.grad_bytes, topo, hierarchical=pods > 1)["seconds"]
+        compute = self.flops_per_step / (
+            self.peak_flops * topo.total_chips)
+        return comm + compute
+
+    def train_throughput(self, pods: int) -> float:
+        """Relative training throughput (examples/sec shape):
+        chips served per step second."""
+        pods = max(1, int(pods))
+        return (pods * self.chips_per_pod) / self.train_step_seconds(pods)
+
+    def pressure(self, queue_per_replica: float = 0.0,
+                 p99_ms: Optional[float] = None,
+                 slo_p99_ms: float = 0.0) -> float:
+        """The serving pressure ratio the hysteresis bands run over:
+        max of queue depth per replica vs ``HVDT_SERVE_QUEUE_HI`` and
+        p99 vs the SLO — 1.0 means exactly at threshold."""
+        terms = [0.0]
+        if self.cfg.queue_hi > 0:
+            terms.append(float(queue_per_replica) / self.cfg.queue_hi)
+        if slo_p99_ms and p99_ms is not None:
+            terms.append(float(p99_ms) / float(slo_p99_ms))
+        return max(terms)
+
+    def price_move(self, move: Move, *, train_pods: int,
+                   serve_units: int, pressure: float,
+                   pod_step_medians: Optional[Dict[str, float]] = None
+                   ) -> PricedMove:
+        """Offline price of one move under the current signals.
+
+        Reclaim: serve relief is queue-proportional (pressure scales
+        with offered load per unit, so +1 unit divides it by
+        (units+1)/units); train cost is the throughput fraction lost at
+        the shrunken world — discounted by the candidate pod's
+        straggler ratio, because a synchronous step already runs at the
+        slowest pod's pace.  Backfill is the mirror image, charged the
+        predicted pressure increase on the remaining units.
+        """
+        medians = pod_step_medians or {}
+        if move.kind == "reclaim":
+            after_units = serve_units + 1
+            pressure_after = pressure * serve_units / after_units \
+                if serve_units > 0 else 0.0
+            ratio = 1.0
+            if medians.get(move.pod):
+                ordered = sorted(medians.values())
+                base = ordered[(len(ordered) - 1) // 2]
+                if base > 0:
+                    ratio = max(1.0, medians[move.pod] / base)
+            thr_now = self.train_throughput(train_pods) / ratio
+            thr_after = self.train_throughput(train_pods - 1)
+            frac_after = thr_after / thr_now if thr_now > 0 else 1.0
+            train_cost = max(0.0, 1.0 - frac_after)
+            relief = pressure - pressure_after
+            return PricedMove(move, relief - train_cost,
+                              min(1.0, frac_after), pressure_after)
+        # backfill
+        after_units = max(1, serve_units - 1)
+        pressure_after = pressure * serve_units / after_units \
+            if serve_units > 1 else float("inf")
+        thr_now = self.train_throughput(train_pods)
+        thr_after = self.train_throughput(train_pods + 1)
+        train_gain = thr_after / thr_now - 1.0 if thr_now > 0 else 0.0
+        risk = max(0.0, pressure_after - self.cfg.backfill_ratio)
+        return PricedMove(move, train_gain - risk,
+                          min(1.0, thr_after / max(thr_after, thr_now)),
+                          pressure_after)
+
+    def rank_reclaims(self, *, train_pods: Optional[List[str]] = None,
+                      serve_units: int,
+                      pressure: float,
+                      pod_step_medians: Optional[Dict[str, float]] = None
+                      ) -> List[PricedMove]:
+        """All reclaim candidates priced, best first — slowest pod
+        ranks highest because its straggler discount shrinks the train
+        cost.  This single function is the ranking BOTH the live tick
+        and the CPU simulator use (the sim-vs-live agreement
+        acceptance pins it)."""
+        pods = (train_pods if train_pods is not None
+                else self.inventory.leased("train"))
+        if len(pods) <= self.cfg.min_train_pods:
+            return []
+        priced = [self.price_move(
+            Move("reclaim", p, reason="serve_pressure"),
+            train_pods=len(pods), serve_units=serve_units,
+            pressure=pressure, pod_step_medians=pod_step_medians)
+            for p in pods]
+        return sorted(priced, key=lambda pm: -pm.predicted_gain)
+
+    # -- the loop ----------------------------------------------------------
+
+    def tick(self, *, queue_per_replica: float = 0.0,
+             p99_ms: Optional[float] = None, slo_p99_ms: float = 0.0,
+             pod_step_medians: Optional[Dict[str, float]] = None,
+             goodput_fraction: Optional[float] = None,
+             step: Optional[int] = None) -> List[FleetDecision]:
+        """One reconcile tick: verify pending moves against the fresh
+        pressure, then decide.  Returns the decisions made."""
+        pressure = self.pressure(queue_per_replica, p99_ms, slo_p99_ms)
+        self._last_signals = {
+            "queue_per_replica": queue_per_replica, "p99_ms": p99_ms,
+            "slo_p99_ms": slo_p99_ms, "pressure": pressure,
+            "pod_step_medians": dict(pod_step_medians or {}),
+            "goodput_fraction": goodput_fraction, "step": step,
+        }
+        self._m_pressure.set(pressure)
+        self._verify(pressure, step)
+        # Leases are read AFTER verification: a rollback just relabeled.
+        train = self.inventory.leased("train")
+        serve = self.inventory.leased("serve")
+        self._m_train_pods.set(len(train))
+        self._m_serve_units.set(len(serve))
+        out: List[FleetDecision] = []
+        if pressure >= self.cfg.enter_ratio:
+            d = self._decide(
+                trigger={"kind": "serve_pressure", "ratio": pressure},
+                candidates=self.rank_reclaims(
+                    train_pods=train, serve_units=len(serve),
+                    pressure=pressure,
+                    pod_step_medians=pod_step_medians),
+                pressure=pressure, step=step,
+                train_pods=len(train), serve_units=len(serve))
+            if d is not None:
+                out.append(d)
+        elif (pressure <= self.cfg.backfill_ratio
+              and len(serve) > self.cfg.min_serve_units
+              and self.inventory.leased("serve")):
+            # Trough: give the *newest* serve pod back to training —
+            # the oldest serve placements hold the warmest caches,
+            # matching the ServeDriver's drain-newest-first policy.
+            pod = serve[-1]
+            cand = self.price_move(
+                Move("backfill", pod, reason="serve_trough"),
+                train_pods=len(train), serve_units=len(serve),
+                pressure=pressure, pod_step_medians=pod_step_medians)
+            d = self._decide(
+                trigger={"kind": "serve_trough", "ratio": pressure},
+                candidates=[cand], pressure=pressure, step=step,
+                train_pods=len(train), serve_units=len(serve))
+            if d is not None:
+                out.append(d)
+        with self._lock:
+            self._m_pending.set(len(self._pending))
+        return out
+
+    def hint_scale(self, target: int, source: str = "controller",
+                   reason: str = "") -> bool:
+        """The PR-18 controller's ``scale_replicas`` action, routed
+        through the fleet instead of racing it on the KV key.  A hint
+        for MORE capacity becomes a reclaim decision under the full
+        guardrail battery (so a hint can be suppressed — that is the
+        point); a hint at/below current capacity is recorded and
+        dropped (the trough path owns scale-down).  Returns True when
+        the hint was accepted for audit, whatever the verdict."""
+        sig = dict(self._last_signals)
+        serve = self.inventory.leased("serve")
+        train = self.inventory.leased("train")
+        trigger = {"kind": "controller_hint", "source": source,
+                   "target": int(target), "reason": reason,
+                   "ratio": sig.get("pressure", 0.0)}
+        if int(target) <= len(serve):
+            self._emit(FleetDecision(
+                trigger=trigger, candidates=[], chosen=None,
+                outcome="suppressed:hint_not_growth",
+                step=sig.get("step"), train_pods=len(train),
+                serve_units=len(serve)).to_record())
+            self._m_suppressed.inc(reason="hint_not_growth")
+            return True
+        pressure = max(float(sig.get("pressure") or 0.0),
+                       self.cfg.enter_ratio)
+        self._decide(
+            trigger=trigger,
+            candidates=self.rank_reclaims(
+                train_pods=train, serve_units=len(serve),
+                pressure=pressure,
+                pod_step_medians=sig.get("pod_step_medians")),
+            pressure=pressure, step=sig.get("step"),
+            train_pods=len(train), serve_units=len(serve))
+        return True
+
+    def _trigger_key(self, trigger: Dict[str, Any]) -> str:
+        return str(trigger.get("kind", ""))
+
+    def _decide(self, *, trigger: Dict[str, Any],
+                candidates: List[PricedMove], pressure: float,
+                step: Optional[int], train_pods: int,
+                serve_units: int) -> Optional[FleetDecision]:
+        if not candidates:
+            return None
+        now = self._clock()
+        key = self._trigger_key(trigger)
+        decision = FleetDecision(
+            trigger=trigger, candidates=candidates, chosen=None,
+            outcome="", step=step, train_pods=train_pods,
+            serve_units=serve_units)
+        with self._lock:
+            if (self.cfg.max_moves
+                    and self._applied_total >= self.cfg.max_moves):
+                return self._suppress(decision, "budget")
+            if key in self._disarmed:
+                return self._suppress(decision, "hysteresis")
+            chosen: Optional[PricedMove] = None
+            cooled = False
+            for pm in candidates:
+                if pm.predicted_gain < self.cfg.min_gain:
+                    break   # ranked — nothing further clears the bar
+                if now < self._cooldown_until.get(pm.move.kind, 0.0):
+                    cooled = True
+                    continue
+                chosen = pm
+                break
+            if chosen is None:
+                return self._suppress(
+                    decision, "cooldown" if cooled else "no_gain")
+            decision.chosen = chosen
+            if self.cfg.mode == "observe":
+                decision.outcome = "observed"
+                self._m_decisions.inc(move=chosen.move.kind,
+                                      outcome="observed")
+                self._emit(decision.to_record())
+                return decision
+            applier = self._appliers.get(chosen.move.kind)
+
+        ok = False
+        if applier is not None:
+            try:
+                ok = bool(applier(chosen.move))
+            except Exception as e:  # an actuator must never sink us
+                log.warning("fleet applier %s failed: %s",
+                            chosen.move.kind, e)
+        with self._lock:
+            if not ok:
+                return self._suppress(decision, "apply_failed")
+            decision.outcome = "applied"
+            self._applied_total += 1
+            self.moves_applied[chosen.move.kind] += 1
+            cd = self._cooldown_s.get(chosen.move.kind,
+                                      self.cfg.cooldown_s)
+            self._cooldown_until[chosen.move.kind] = now + cd
+            self._disarmed.add(key)
+            inverse = Move(
+                "backfill" if chosen.move.kind == "reclaim"
+                else "reclaim",
+                chosen.move.pod,
+                reason=f"rollback:{chosen.move.reason}")
+            self._pending.append(_PendingVerify(
+                decision=decision, trigger_key=key,
+                pressure_at_decision=pressure,
+                ticks_left=max(1, self.cfg.recovery_window),
+                rollback=inverse))
+            self._m_decisions.inc(move=chosen.move.kind,
+                                  outcome="applied")
+        self._relabel(chosen.move)
+        self._emit(decision.to_record())
+        log.info("fleet applied %s of pod %s (predicted gain %.3g)",
+                 chosen.move.kind, chosen.move.pod,
+                 chosen.predicted_gain)
+        return decision
+
+    def _relabel(self, move: Move) -> None:
+        """Flip the applied move's pod lease to the receiving workload
+        (release + re-acquire; a pod the applier already lost to a
+        concurrent failure simply stays unleased)."""
+        self.inventory.release(move.pod)
+        self.inventory.acquire(
+            move.pod, "serve" if move.kind == "reclaim" else "train")
+
+    def _suppress(self, decision: FleetDecision, reason: str
+                  ) -> FleetDecision:
+        """(lock held) Record a guardrail suppression."""
+        decision.outcome = f"suppressed:{reason}"
+        self._m_suppressed.inc(reason=reason)
+        self._emit(decision.to_record())
+        return decision
+
+    # -- verification / rollback -------------------------------------------
+
+    def _verify(self, pressure: float, step: Optional[int]) -> None:
+        """Judge pending moves against the fresh pressure.
+
+        A reclaim recovers EARLY when pressure drops under the exit
+        band; at window expiry it recovers as long as pressure did not
+        get WORSE than at decision time — a sustained flash crowd may
+        need several reclaims, and never-worse means "roll back moves
+        that hurt", not "roll back moves that weren't singly
+        sufficient".  A backfill fails FAST when pressure crosses the
+        enter band (it tipped serving over) and recovers by surviving
+        its window.
+        """
+        rollbacks: List[_PendingVerify] = []
+        recovered: List[_PendingVerify] = []
+        with self._lock:
+            still: List[_PendingVerify] = []
+            for p in self._pending:
+                kind = p.decision.chosen.move.kind
+                if kind == "reclaim" and pressure <= self.cfg.exit_ratio:
+                    recovered.append(p)
+                    continue
+                if kind == "backfill" \
+                        and pressure >= self.cfg.enter_ratio:
+                    rollbacks.append(p)
+                    continue
+                p.ticks_left -= 1
+                if p.ticks_left > 0:
+                    still.append(p)
+                elif kind == "reclaim" \
+                        and pressure > p.pressure_at_decision + 1e-9:
+                    rollbacks.append(p)
+                else:
+                    recovered.append(p)
+            self._pending = still
+            for p in recovered:
+                self._disarmed.discard(p.trigger_key)
+                self._m_decisions.inc(
+                    move=p.decision.chosen.move.kind,
+                    outcome="recovered")
+        for p in recovered:
+            self._emit({
+                "kind": "fleet_outcome",
+                "outcome": "recovered",
+                "move": p.decision.chosen.move.to_dict(),
+                "predicted_gain": p.decision.chosen.predicted_gain,
+                "pressure_before": p.pressure_at_decision,
+                "pressure_after": pressure,
+                "step": step,
+            })
+        for p in rollbacks:
+            self._rollback(p, pressure, step)
+
+    def _rollback(self, p: _PendingVerify, pressure: float,
+                  step: Optional[int]) -> None:
+        """Never-worse: the move did not help inside the window — apply
+        the inverse move and double the kind's cooldown."""
+        kind = p.decision.chosen.move.kind
+        ok = None
+        if p.rollback is not None:
+            applier = self._appliers.get(p.rollback.kind)
+            if applier is not None:
+                try:
+                    ok = bool(applier(p.rollback))
+                except Exception as e:
+                    log.warning("fleet rollback %s failed: %s",
+                                p.rollback.kind, e)
+                    ok = False
+            if ok:
+                self._relabel(p.rollback)
+        with self._lock:
+            now = self._clock()
+            cd = 2 * self._cooldown_s.get(kind, self.cfg.cooldown_s)
+            self._cooldown_s[kind] = cd
+            self._cooldown_until[kind] = now + cd
+            # The trigger stays disarmed until the pressure series
+            # itself exits the band — rollback is not a license to flap.
+            self.rollbacks += 1
+            self._m_rollbacks.inc()
+            self._m_decisions.inc(move=kind, outcome="rolled_back")
+        self._emit({
+            "kind": "fleet_outcome",
+            "outcome": "rolled_back",
+            "move": p.decision.chosen.move.to_dict(),
+            "rollback": (p.rollback.to_dict()
+                         if p.rollback is not None else None),
+            "rollback_applied": ok,
+            "predicted_gain": p.decision.chosen.predicted_gain,
+            "pressure_before": p.pressure_at_decision,
+            "pressure_after": pressure,
+            "step": step,
+        })
+        log.warning("fleet rolled back %s of pod %s (pressure %.3g did "
+                    "not recover)", kind, p.decision.chosen.move.pod,
+                    pressure)
+
+
+# ---------------------------------------------------------------------------
+# Zero-overhead engagement (the faults/controller idiom)
+# ---------------------------------------------------------------------------
+
+
+_lock = threading.Lock()
+_installed: Optional[FleetScheduler] = None
+
+
+def install(scheduler: Optional[FleetScheduler]) -> None:
+    """Install the process-wide scheduler instance (the launcher wires
+    it; tests and the simulator install their own)."""
+    global _installed
+    with _lock:
+        _installed = scheduler
+
+
+def get_scheduler() -> Optional[FleetScheduler]:
+    """The installed scheduler when ``HVDT_FLEET`` is active, else None
+    — one env read on the unset path, zero objects, zero threads.  The
+    controller's ``scale_replicas`` applier calls this to decide
+    whether its action routes as a fleet hint."""
+    raw = (os.environ.get("HVDT_FLEET") or "").strip().lower()
+    if not raw or raw in ("0", "off", "false"):
+        return None
+    with _lock:
+        return _installed
+
+
+def reset() -> None:
+    """Drop the installed scheduler (test isolation)."""
+    install(None)

@@ -1,0 +1,162 @@
+"""Synthetic traffic traces for the fleet scenario harness.
+
+A :class:`TrafficTrace` is a piecewise-linear offered-load curve —
+``(t_seconds, requests_per_second)`` breakpoints plus the serving SLO —
+small enough to check into the repo as JSON (``tools/traces/*.json``)
+and deterministic enough that a simulation report is reproducible
+byte-for-byte from the trace + fault plan + seed.
+
+Three builders cover the shapes the utilization story is about:
+
+* :func:`diurnal` — the day curve: a long overnight trough (training's
+  backfill window), a morning ramp, a sustained daytime plateau, an
+  evening fall-off.
+* :func:`flash_crowd` — a step onto a multiple of baseline within
+  seconds: the reclaim path's forcing function.
+* :func:`step_function` — a square wave between low and high: the
+  hysteresis/cooldown battery's worst case (a flappy scheduler fails
+  this one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["TrafficTrace", "diurnal", "flash_crowd", "step_function",
+           "BUILTIN_TRACES", "load_trace"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficTrace:
+    """Piecewise-linear offered load over time."""
+
+    name: str
+    points: Tuple[Tuple[float, float], ...]   # (t_s, rps), t ascending
+    slo_p99_ms: float = 250.0
+
+    def __post_init__(self) -> None:
+        if not self.points:
+            raise ValueError("a trace needs at least one (t, rps) point")
+        ts = [t for t, _ in self.points]
+        if ts != sorted(ts):
+            raise ValueError("trace points must be time-ascending")
+
+    @property
+    def duration_s(self) -> float:
+        return self.points[-1][0]
+
+    def rps_at(self, t: float) -> float:
+        """Offered load at ``t`` (linear between breakpoints, clamped
+        to the endpoints outside the trace)."""
+        pts = self.points
+        if t <= pts[0][0]:
+            return pts[0][1]
+        for (t0, r0), (t1, r1) in zip(pts, pts[1:]):
+            if t <= t1:
+                if t1 <= t0:
+                    return r1
+                frac = (t - t0) / (t1 - t0)
+                return r0 + frac * (r1 - r0)
+        return pts[-1][1]
+
+    # -- JSON round-trip ---------------------------------------------------
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"name": self.name,
+                "slo_p99_ms": self.slo_p99_ms,
+                "points": [[t, r] for t, r in self.points]}
+
+    @classmethod
+    def from_dict(cls, doc: Dict[str, object]) -> "TrafficTrace":
+        pts = tuple((float(t), float(r))
+                    for t, r in doc.get("points") or ())
+        return cls(name=str(doc.get("name") or "trace"),
+                   points=pts,
+                   slo_p99_ms=float(doc.get("slo_p99_ms") or 250.0))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "TrafficTrace":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def diurnal(base_rps: float = 40.0, peak_rps: float = 400.0,
+            day_s: float = 3600.0, slo_p99_ms: float = 250.0
+            ) -> TrafficTrace:
+    """One compressed "day": trough, morning ramp, plateau, fall-off.
+    ``day_s`` scales the whole curve (default one simulated hour)."""
+    d = day_s
+    return TrafficTrace(
+        name="diurnal", slo_p99_ms=slo_p99_ms,
+        points=(
+            (0.0, base_rps),            # overnight trough
+            (0.25 * d, base_rps),
+            (0.40 * d, peak_rps),       # morning ramp
+            (0.70 * d, peak_rps),       # daytime plateau
+            (0.85 * d, base_rps),       # evening fall-off
+            (d, base_rps),
+        ))
+
+
+def flash_crowd(base_rps: float = 50.0, spike_rps: float = 600.0,
+                onset_s: float = 300.0, hold_s: float = 600.0,
+                total_s: float = 1800.0, slo_p99_ms: float = 250.0
+                ) -> TrafficTrace:
+    """Baseline, then a near-instant step to ``spike_rps`` at
+    ``onset_s`` held for ``hold_s`` — the reclaim forcing function."""
+    return TrafficTrace(
+        name="flash_crowd", slo_p99_ms=slo_p99_ms,
+        points=(
+            (0.0, base_rps),
+            (onset_s, base_rps),
+            (onset_s + 10.0, spike_rps),
+            (onset_s + hold_s, spike_rps),
+            (onset_s + hold_s + 60.0, base_rps),
+            (max(total_s, onset_s + hold_s + 120.0), base_rps),
+        ))
+
+
+def step_function(low_rps: float = 40.0, high_rps: float = 300.0,
+                  period_s: float = 600.0, cycles: int = 3,
+                  slo_p99_ms: float = 250.0) -> TrafficTrace:
+    """A square wave between ``low_rps`` and ``high_rps`` — the
+    anti-flap battery: hysteresis + cooldown must keep the scheduler
+    from chasing every edge."""
+    pts: List[Tuple[float, float]] = [(0.0, low_rps)]
+    t = 0.0
+    for _ in range(max(1, cycles)):
+        half = period_s / 2.0
+        pts.append((t + half, low_rps))
+        pts.append((t + half + 5.0, high_rps))
+        pts.append((t + period_s, high_rps))
+        pts.append((t + period_s + 5.0, low_rps))
+        t += period_s + 5.0
+    return TrafficTrace(name="step_function", slo_p99_ms=slo_p99_ms,
+                        points=tuple(pts))
+
+
+BUILTIN_TRACES = {
+    "diurnal": diurnal,
+    "flash_crowd": flash_crowd,
+    "step_function": step_function,
+}
+
+
+def load_trace(name_or_path: str,
+               slo_p99_ms: Optional[float] = None) -> TrafficTrace:
+    """A builtin trace by name, or a checked-in JSON trace by path."""
+    builder = BUILTIN_TRACES.get(name_or_path)
+    if builder is not None:
+        return builder() if slo_p99_ms is None \
+            else builder(slo_p99_ms=slo_p99_ms)
+    trace = TrafficTrace.load(name_or_path)
+    if slo_p99_ms is not None:
+        trace = dataclasses.replace(trace, slo_p99_ms=slo_p99_ms)
+    return trace

@@ -51,6 +51,14 @@ i.e. comma-separated ``kind@key=value:key=value`` entries.  Kinds:
   probability ``p`` (``slow_replica@p=0.1:secs=2``): a degraded
   replica.  The router's hedging and p99-SLO ejection are the
   production answer; this is how they are chaos-tested.
+* ``traffic_spike`` — add ``rps`` requests/second of synthetic offered
+  load for ``secs`` seconds, e.g.
+  ``traffic_spike@step=20:rps=300:secs=120``: the flash crowd.  A
+  data-only fault — firing (at the router's ``serve.traffic`` point,
+  ``step`` = the router's dispatch count, or per-tick in the fleet
+  simulator) opens a spike window that
+  :meth:`FaultInjector.extra_rps` reports until it expires; the fleet
+  scheduler's reclaim path is the production answer.
 
 Match keys: ``step`` (fires once at the first point whose step >= it —
 commits are periodic, so exact equality would silently never fire),
@@ -94,7 +102,7 @@ log = get_logger(__name__)
 
 KINDS = ("crash", "hang", "exc", "corrupt_ckpt", "kv_drop",
          "pod_crash", "pod_partition", "slow_disk",
-         "serve_crash", "slow_replica")
+         "serve_crash", "slow_replica", "traffic_spike")
 
 # Default injection point per kind (spec may override with point=).
 _DEFAULT_POINT = {
@@ -108,6 +116,7 @@ _DEFAULT_POINT = {
     "slow_disk": "checkpoint.write",
     "serve_crash": "serve.predict",
     "slow_replica": "serve.predict",
+    "traffic_spike": "serve.traffic",
 }
 
 
@@ -158,6 +167,7 @@ class FaultSpec:
     p: Optional[float] = None
     secs: float = 30.0
     code: int = 1
+    rps: float = 0.0        # traffic_spike: synthetic offered load
     mode: str = "payload"   # corrupt_ckpt: payload | truncate_manifest
     times: Optional[int] = None   # None = resolved default (see __post_init__)
     fired: int = 0
@@ -237,7 +247,7 @@ def parse_plan(plan: str) -> List[FaultSpec]:
                     kwargs[key] = int(val)
                 elif key == "rank":
                     kwargs[key] = parse_rank_set(val)
-                elif key in ("p", "secs"):
+                elif key in ("p", "secs", "rps"):
                     kwargs[key] = float(val)
                 elif key in ("point", "pod", "mode"):
                     kwargs[key] = val
@@ -245,7 +255,7 @@ def parse_plan(plan: str) -> List[FaultSpec]:
                     raise ValueError(
                         f"fault plan entry {entry!r}: unknown key {key!r}; "
                         f"valid: step, rank, pod, point, p, secs, code, "
-                        f"mode, times")
+                        f"mode, times, rps")
         point = kwargs.pop("point", None) or _DEFAULT_POINT.get(kind)
         if point is None:
             raise ValueError(f"fault plan entry {entry!r}: unknown fault "
@@ -286,6 +296,10 @@ class FaultInjector:
         self._sleep = sleep_fn
         self._exit = exit_fn
         self.counters: Dict[str, int] = {}
+        # traffic_spike windows: (expires_at, rps).  Timestamps come
+        # from the firing context (``now=``) when given — the fleet
+        # simulator runs on a virtual clock — else time.monotonic().
+        self._spikes: List[tuple] = []
         # Fired-fault journal: the elastic model is PROCESS RESTART, so a
         # respawned worker builds a fresh injector — without persisted
         # fire counts, a once-only crash@step=N would kill the worker
@@ -391,6 +405,23 @@ class FaultInjector:
         elif spec.kind == "kv_drop":
             raise ConnectionError(
                 f"injected kv drop at point={point} (p={spec.p})")
+        elif spec.kind == "traffic_spike":
+            # Data-only: open a spike window instead of breaking
+            # anything — extra_rps() reports it until it expires.
+            now = ctx.get("now")
+            now = float(now) if now is not None else time.monotonic()
+            self._spikes.append((now + spec.secs, spec.rps))
+
+    def extra_rps(self, now: Optional[float] = None) -> float:
+        """Synthetic offered load (requests/second) from currently open
+        ``traffic_spike`` windows.  Expired windows are pruned; ``now``
+        follows the same clock the windows were opened on."""
+        if not self._spikes:
+            return 0.0
+        t = float(now) if now is not None else time.monotonic()
+        self._spikes = [(until, rps) for until, rps in self._spikes
+                        if t < until]
+        return sum(rps for _, rps in self._spikes)
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5) -> bool:

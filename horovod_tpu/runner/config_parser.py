@@ -46,6 +46,15 @@ YAML shape (mirrors the reference's config sections)::
       cooldown_s: 60.0
       recovery_window: 3
       max_actions: 8
+    fleet:
+      enabled: on
+      cooldown_s: 60.0
+      enter_ratio: 1.2
+      exit_ratio: 1.05
+      backfill_ratio: 0.5
+      recovery_window: 3
+      max_moves: 0
+      min_train_pods: 1
     telemetry:
       enabled: true
       metrics_port: 9090
@@ -267,6 +276,50 @@ KNOB_FLAGS: List[_Flag] = [
           "HVDT_CONTROLLER_MAX_ACTIONS", "controller", "max_actions",
           "Lifetime cap on applied controller actions per run "
           "(0 = unlimited).", type=int),
+    # --- fleet scheduler (fleet/scheduler.py; bin-packs one pod fleet
+    #     between elastic training and SLO serving, pricing every
+    #     reclaim/backfill with the cost model before committing) ---
+    _Flag("--fleet", "fleet", "HVDT_FLEET", "fleet", "enabled",
+          "Enable the fleet scheduler (on | observe | off): one "
+          "bin-packing reconciler over the shared pod inventory that "
+          "reclaims training pods for serving when SLO pressure "
+          "crosses the enter band and backfills training from "
+          "serving's trough, pricing each move with the cost model "
+          "(training throughput at the candidate world size vs "
+          "serving headroom); 'observe' logs priced decisions without "
+          "moving a pod."),
+    _Flag("--fleet-cooldown-s", "fleet_cooldown_s",
+          "HVDT_FLEET_COOLDOWN_S", "fleet", "cooldown_s",
+          "Seconds between fleet moves of the same kind; doubled "
+          "after a rollback.", type=float),
+    _Flag("--fleet-enter-ratio", "fleet_enter_ratio",
+          "HVDT_FLEET_ENTER_RATIO", "fleet", "enter_ratio",
+          "Serving-pressure ratio at which the scheduler starts "
+          "reclaiming training pods for serving.", type=float),
+    _Flag("--fleet-exit-ratio", "fleet_exit_ratio",
+          "HVDT_FLEET_EXIT_RATIO", "fleet", "exit_ratio",
+          "Serving-pressure ratio below which a pending reclaim "
+          "counts as recovered (hysteresis exit band).", type=float),
+    _Flag("--fleet-backfill-ratio", "fleet_backfill_ratio",
+          "HVDT_FLEET_BACKFILL_RATIO", "fleet", "backfill_ratio",
+          "Serving-pressure ratio below which serving's trough is "
+          "backfilled into training.", type=float),
+    _Flag("--fleet-recovery-window", "fleet_recovery_window",
+          "HVDT_FLEET_RECOVERY_WINDOW", "fleet", "recovery_window",
+          "Scheduler ticks a move has to prove itself before the "
+          "never-worse check considers rolling it back.", type=int),
+    _Flag("--fleet-min-gain", "fleet_min_gain",
+          "HVDT_FLEET_MIN_GAIN", "fleet", "min_gain",
+          "Minimum predicted gain for a fleet move to apply.",
+          type=float),
+    _Flag("--fleet-max-moves", "fleet_max_moves",
+          "HVDT_FLEET_MAX_MOVES", "fleet", "max_moves",
+          "Lifetime cap on applied fleet moves per run "
+          "(0 = unlimited).", type=int),
+    _Flag("--fleet-min-train-pods", "fleet_min_train_pods",
+          "HVDT_FLEET_MIN_TRAIN_PODS", "fleet", "min_train_pods",
+          "Floor on training pods the scheduler will never reclaim "
+          "below.", type=int),
     # --- telemetry / observability ---
     _Flag("--telemetry", "telemetry", "HVDT_TELEMETRY",
           "telemetry", "enabled",

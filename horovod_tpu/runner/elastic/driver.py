@@ -306,12 +306,22 @@ class ElasticDriver:
         def _scale(action) -> bool:
             if self._kv is None:
                 return False
-            from ...serve.autoscale import TARGET_KV_KEY
+            from ... import fleet as _fleet
 
-            with self._kv.lock:
-                self._kv.store[TARGET_KV_KEY] = str(
-                    int(action.param("target"))).encode()
-            return True
+            target = int(action.param("target"))
+            sched = _fleet.get_scheduler()
+            if sched is not None:
+                # A fleet scheduler owns /serve/target_replicas: the
+                # controller's scale becomes a HINT through its
+                # guardrails instead of a second writer on the key.
+                return sched.hint_scale(target, source="controller",
+                                        reason=action.reason)
+            # No scheduler: write the seq-guarded doc directly — the
+            # audited form, refused while a raw-int operator override
+            # owns the key (the two-writers race regression).
+            return _fleet.write_target(
+                self._kv, target, writer="controller",
+                reason=action.reason) is not None
 
         def _leg(action) -> bool:
             if self._kv is None:

@@ -387,6 +387,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..telemetry.top import main as top_main
 
         return top_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `hvdtrun fleet <trace> ...` — trace-driven CPU simulation of
+        # the bin-packing fleet scheduler (fleet/simulate.py): replay a
+        # diurnal/flash-crowd/step-function traffic trace (or a trace
+        # JSON) plus an optional resilience fault plan against the real
+        # scheduler over a TopologySpec-priced pod fleet, e.g.
+        #   hvdtrun fleet diurnal --pods 8 \
+        #       --fault-plan pod_crash@step=40:pod=pod5
+        # Prints the goodput-vs-SLO report as one JSON doc.
+        from ..fleet.simulate import main as fleet_main
+
+        return fleet_main(argv[1:])
     if argv and argv[0] == "lint":
         # `hvdtrun lint ...` — the static-analysis gate (collective-
         # schedule verifier + hvdt-lint rule registry + lock-order

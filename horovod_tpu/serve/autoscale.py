@@ -33,6 +33,16 @@ requests.
 Operators (and the autotuner, ROADMAP item 5) can force a target by
 writing ``/serve/target_replicas`` on the rendezvous KV; the policy
 resumes from there when the key is cleared.
+
+The key has two on-wire forms and a fixed precedence
+(``fleet.scheduler.read_target`` decodes both): a **raw int** is the
+operator's out-of-band override and beats everything, including the
+``--target-file`` channel; a **seq-guarded JSON doc**
+(``{"target": n, "seq": k, "writer": ...}``, written by the fleet
+scheduler — the PR-18 controller's ``scale_replicas`` hint routes
+through it) ranks between the file override and the autoscale policy.
+Every adoption stamps ``last_target_writer`` / ``last_target_seq`` —
+the audit trail for "who scaled the fleet last".
 """
 
 from __future__ import annotations
@@ -202,6 +212,12 @@ class ServeDriver:
         self._no_slot_warned = False
         self.removal_events = 0     # audit: replica-removal events
         self.scale_events: List[str] = []
+        # Last adopted /serve/target_replicas writer (audit trail for
+        # the one-key-many-writers reconciliation): "operator" for the
+        # raw-int / --target-file channels, the doc's writer field
+        # ("fleet", "controller", ...) otherwise.
+        self.last_target_writer: Optional[str] = None
+        self.last_target_seq: Optional[int] = None
 
     # -- introspection -----------------------------------------------------
 
@@ -246,14 +262,20 @@ class ServeDriver:
         print(msg, file=sys.stderr)
         return n
 
+    def _kv_target_doc(self) -> Optional[Dict[str, Any]]:
+        """The decoded ``/serve/target_replicas`` doc (raw operator int
+        or seq-guarded fleet doc), or None when unset/garbage."""
+        from ..fleet.scheduler import read_target
+
+        return read_target(self._kv.get_local(TARGET_KV_KEY))
+
     def _kv_target_override(self) -> Optional[int]:
-        raw = self._kv.get_local(TARGET_KV_KEY)
-        if raw is None:
-            return None
-        try:
-            return int(raw.decode())
-        except (ValueError, UnicodeDecodeError):
-            return None
+        """The raw-int operator form only — the highest-precedence
+        channel (a fleet doc on the key is NOT an operator override)."""
+        doc = self._kv_target_doc()
+        if doc is not None and doc.get("seq") is None:
+            return doc["target"]
+        return None
 
     def _file_target_override(self) -> Optional[int]:
         """Operator override from ``--target-file`` (a plain int in a
@@ -376,11 +398,25 @@ class ServeDriver:
     def reconcile(self) -> None:
         """One control pass: adopt overrides/policy, then converge the
         live set toward the target (spawn up, drain down)."""
-        override = self._kv_target_override()
+        doc = self._kv_target_doc()
+        override = doc["target"] if doc is not None \
+            and doc.get("seq") is None else None
         if override is None:
             override = self._file_target_override()
+            doc = None if override is not None else doc
         if override is not None:
             self.set_target(override, reason="operator override")
+            self.last_target_writer = "operator"
+            self.last_target_seq = None
+        elif doc is not None:
+            # The fleet scheduler's seq-guarded doc (or a controller
+            # hint it routed): below the operator channels, above the
+            # local autoscale policy.
+            self.set_target(doc["target"],
+                            reason=f"fleet: {doc.get('writer', '?')} "
+                                   f"seq={doc.get('seq')}")
+            self.last_target_writer = str(doc.get("writer", "?"))
+            self.last_target_seq = doc.get("seq")
         elif self._autoscale:
             snaps = self.replica_snapshots()
             desired = self.policy.decide(self.target, snaps)
@@ -388,6 +424,8 @@ class ServeDriver:
                 self.set_target(desired,
                                 reason=f"autoscale: "
                                        f"{self.policy.last_reason}")
+                self.last_target_writer = "autoscale"
+                self.last_target_seq = None
         with self._lock:
             live = [r for r in self._live.values() if not r.draining]
             target = self._target

@@ -374,10 +374,29 @@ class Router:
         while not self._stop.wait(period):
             try:
                 self.refresh()
+                self._check_traffic_faults()
                 if self._probe:
                     self.probe_replicas()
             except Exception:   # pragma: no cover - defensive
                 log.exception("router control loop error")
+
+    def _check_traffic_faults(self) -> None:
+        """Fire the ``serve.traffic`` injection point (``traffic_spike``
+        faults arm here; ``step`` = the dispatch count, matching the
+        ``serve_crash`` convention) and account any open spike windows
+        as synthetic offered load — the rps shows up in ``describe()``
+        and the fleet scheduler's pressure picture, so a chaos plan can
+        force a flash crowd without a load generator."""
+        from ..resilience import faults
+
+        inj = faults.get_injector()
+        if inj is None:
+            self.synthetic_rps = 0.0
+            return
+        with self._lock:
+            seq = getattr(self, "_dispatch_seq", 0)
+        inj.fire("serve.traffic", step=seq)
+        self.synthetic_rps = inj.extra_rps()
 
     # -- routing -----------------------------------------------------------
 
@@ -613,6 +632,7 @@ class Router:
             "replicas": [v.describe() for v in views],
             "routable": sorted(routable),
             "slo_p99_ms": self.slo_p99_ms,
+            "synthetic_rps": getattr(self, "synthetic_rps", 0.0),
         }
 
     def start(self) -> int:

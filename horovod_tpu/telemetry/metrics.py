@@ -496,6 +496,28 @@ CATALOG: Dict[str, MetricSpec] = {
         _m("hvdt_controller_observed_delta_s", "gauge", (),
            "Observed deviation-ratio improvement of the last verified "
            "action (predicted-vs-observed closes the audit loop)"),
+        # -- fleet scheduler (horovod_tpu/fleet) --
+        _m("hvdt_fleet_decisions_total", "counter",
+           ("move", "outcome"),
+           "Fleet-scheduler decisions by move kind (reclaim | "
+           "backfill) and outcome (applied | observed | recovered | "
+           "rolled_back)"),
+        _m("hvdt_fleet_suppressed_total", "counter", ("reason",),
+           "Fleet moves suppressed by guardrail (budget | hysteresis | "
+           "cooldown | no_gain | hint_not_growth | apply_failed)"),
+        _m("hvdt_fleet_rollbacks_total", "counter", (),
+           "Never-worse rollbacks: fleet moves whose serving pressure "
+           "got worse than at decision time inside the window"),
+        _m("hvdt_fleet_pending", "gauge", (),
+           "Applied fleet moves currently awaiting pressure-recovery "
+           "verification"),
+        _m("hvdt_fleet_pressure", "gauge", (),
+           "Serving-pressure ratio the scheduler last acted on "
+           "(max of queue-depth and p99 ratios; 1.0 = at SLO)"),
+        _m("hvdt_fleet_train_pods", "gauge", (),
+           "Pods currently leased to the training workload"),
+        _m("hvdt_fleet_serve_units", "gauge", (),
+           "Pods currently leased to the serving workload"),
         # -- straggler (telemetry/straggler.py) --
         _m("hvdt_straggler_rank", "gauge", (),
            "Worst straggler rank over the last window (-1 = none)"),

@@ -37,9 +37,10 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 __all__ = ["main", "sparkline", "render_frame", "fetch_timeseries",
-           "controller_lines"]
+           "controller_lines", "fleet_lines"]
 
 _CONTROLLER_KINDS = ("controller_decision", "controller_outcome")
+_FLEET_KINDS = ("fleet_decision", "fleet_outcome")
 
 _BLOCKS = "▁▂▃▄▅▆▇█"
 
@@ -133,6 +134,43 @@ def controller_lines(events: List[Dict[str, Any]], last: int = 4
     return out
 
 
+def _move_str(move: Optional[Dict[str, Any]]) -> str:
+    if not move:
+        return "?"
+    return f"{move.get('kind', '?')}({move.get('pod', '?')})"
+
+
+def fleet_lines(events: List[Dict[str, Any]], last: int = 4
+                ) -> List[str]:
+    """Render the last ``last`` fleet-scheduler records from the event
+    log — one line each: the trigger, the chosen move at its predicted
+    gain, and the outcome (applied/suppressed/recovered/rolled back)."""
+    recs = [e for e in events if e.get("kind") in _FLEET_KINDS]
+    out = []
+    for r in recs[-last:]:
+        step = r.get("step", "?")
+        if r.get("kind") == "fleet_decision":
+            chosen = r.get("chosen") or {}
+            gain = chosen.get("predicted_gain")
+            gains = (f" gain {gain:+.3f}"
+                     if isinstance(gain, (int, float)) else "")
+            out.append(
+                f"  [step {step}] "
+                f"{(r.get('trigger') or {}).get('kind', '?')}"
+                f" -> {_move_str(chosen.get('move'))}{gains}"
+                f" [{r.get('outcome', '?')}]")
+        else:
+            before, after = r.get("pressure_before"), \
+                r.get("pressure_after")
+            press = (f" pressure {before:.2f}->{after:.2f}"
+                     if isinstance(before, (int, float))
+                     and isinstance(after, (int, float)) else "")
+            out.append(
+                f"  [step {step}] {_move_str(r.get('move'))}"
+                f" -> {r.get('outcome', '?')}{press}")
+    return out
+
+
 def render_frame(docs: Dict[str, Optional[Dict[str, Any]]],
                  events: Optional[List[Dict[str, Any]]] = None,
                  width: int = 24) -> str:
@@ -186,7 +224,8 @@ def render_frame(docs: Dict[str, Optional[Dict[str, Any]]],
         lines.append("   ".join(footer))
     if events:
         anomalies = [e for e in events
-                     if e.get("kind") not in _CONTROLLER_KINDS]
+                     if e.get("kind") not in _CONTROLLER_KINDS
+                     and e.get("kind") not in _FLEET_KINDS]
         if anomalies:
             lines.append("anomalies:")
             for ev in anomalies[-5:]:
@@ -202,6 +241,10 @@ def render_frame(docs: Dict[str, Optional[Dict[str, Any]]],
         if ctl:
             lines.append("controller:")
             lines.extend(ctl)
+        flt = fleet_lines(events)
+        if flt:
+            lines.append("fleet:")
+            lines.extend(flt)
     return "\n".join(lines)
 
 

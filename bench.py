@@ -197,6 +197,27 @@ def _parse_args(argv=None):
                          "cache.")
     ap.add_argument("--controller-events", type=int, default=2000,
                     help="Synthetic events to push for --controller.")
+    ap.add_argument("--moe", action="store_true",
+                    help="MoE expert-axis capacity-factor sweep on the "
+                         "8-device CPU sim (in-process): one row per "
+                         "candidate capacity factor with tokens/s, the "
+                         "measured dropped_fraction, a2a_wire_bytes, "
+                         "and goodput; the summary's "
+                         "capacity_factor_at_peak is the "
+                         "HVDT_AUTOTUNE_MOE_SEED input.  Never touches "
+                         "the last-good cache.")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="1F1B microbatch-count sweep on the CPU sim "
+                         "(in-process): fixed total batch per row with "
+                         "tokens/s, bubble_fraction_priced (cost "
+                         "model) and bubble_fraction_observed (wall "
+                         "clock); the summary's microbatches_at_peak "
+                         "is the HVDT_AUTOTUNE_PIPELINE_SEED input.  "
+                         "Never touches the last-good cache.")
+    ap.add_argument("--json-out", default="",
+                    help="also write the --moe/--pipeline sweep JSON "
+                         "to this file (the HVDT_AUTOTUNE_*_SEED "
+                         "format)")
     ap.add_argument("--fleet", metavar="TRACE", default=None,
                     help="Fleet-scheduler trace replay: run the "
                          "trace-driven CPU chaos simulation "
@@ -302,6 +323,241 @@ def _run_fleet_bench(args) -> None:
         "rollbacks": report["rollbacks"],
         "dropped_requests": report["dropped_requests"],
     }))
+
+
+def _force_cpu_sim(n: int = 8) -> None:
+    """Pin the 8-device CPU sim BEFORE the first jax backend init (the
+    conftest / analysis-gate idiom) — the --moe/--pipeline legs are
+    CPU-sim sweeps by contract, comparable across hosts."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _shard_map_fn():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map  # older jax
+
+    return shard_map
+
+
+def _run_moe_bench(args) -> None:
+    """--moe: expert-axis capacity-factor sweep on the CPU sim
+    (in-process, never touches the last-good cache).
+
+    One row per ``ParameterManager.MOE_CAPACITY_CANDIDATES`` entry:
+    time ``moe_dispatch_combine`` (the production dispatch -> expert ->
+    combine path, both alltoalls included) over the ep mesh with a
+    skewed router, and report ``tokens_per_s``, the measured
+    ``dropped_fraction``, the per-rank ``a2a_wire_bytes``, and
+    ``goodput_tokens_per_s = tokens_per_s * (1 - dropped_fraction)`` —
+    the objective that prices the capacity trade (bigger capacity moves
+    more wire bytes but drops fewer tokens).  The summary's
+    ``capacity_factor_at_peak`` is what ``HVDT_AUTOTUNE_MOE_SEED``
+    reads to seed the autotuner's MoE dimension — measured, not
+    guessed."""
+    _force_cpu_sim(8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import numpy as np
+
+    from horovod_tpu.autotune import ParameterManager
+    from horovod_tpu.parallel.moe import moe_capacity, moe_dispatch_combine
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs, dtype=object), ("ep",))
+    shard_map = _shard_map_fn()
+    tok, dim = 256, 64
+    n_experts = n      # one expert per rank
+    key = jax.random.PRNGKey(0)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (n * tok, dim), jnp.float32)
+    # Skewed router weights: realistic imbalance so low capacity
+    # factors actually drop tokens and the sweep prices the trade.
+    rw = jax.random.normal(kw, (dim, n_experts), jnp.float32) * 2.0
+
+    def make_step(cf):
+        def local(xl, rwl):
+            y, aux = moe_dispatch_combine(
+                xl, xl @ rwl, lambda blk: blk * 2.0, axis="ep",
+                experts_per_rank=1, capacity_factor=cf, top_k=1)
+            return y, aux.dropped_fraction
+
+        return jax.jit(shard_map(local, mesh=mesh,
+                                 in_specs=(P("ep"), P()),
+                                 out_specs=(P("ep"), P())))
+
+    iters, warmup = max(3, args.num_iters), max(1, args.num_warmup)
+    rows = []
+    for cf in ParameterManager.MOE_CAPACITY_CANDIDATES:
+        step = make_step(cf)
+
+        def run_and_wait():
+            y, d = step(x, rw)
+            return float(jnp.sum(y[..., :1])), float(d)
+
+        for _ in range(warmup):
+            run_and_wait()
+        times = []
+        dropped = 0.0
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            _, dropped = run_and_wait()
+            times.append(time.perf_counter() - t0)
+        secs = min(times)
+        cap = moe_capacity(tok, n_experts, top_k=1, capacity_factor=cf)
+        tps = (n * tok) / secs
+        rows.append({
+            "capacity_factor": cf,
+            "capacity": cap,
+            "seconds": secs,
+            "tokens_per_s": round(tps, 1),
+            "dropped_fraction": round(dropped, 6),
+            "goodput_tokens_per_s": round(tps * (1.0 - dropped), 1),
+            # bytes one rank puts on the a2a wire per step: the [ep,
+            # cap, dim] f32 dispatch block out and the combine back
+            "a2a_wire_bytes": 2 * n * cap * dim * 4,
+        })
+        print(f"capacity_factor {cf:>4}  cap {cap:>4}  "
+              f"{secs*1e3:>8.2f}ms  dropped {dropped:>7.4f}  "
+              f"goodput {rows[-1]['goodput_tokens_per_s']:>10.1f} tok/s",
+              file=sys.stderr)
+
+    peak = max(rows, key=lambda r: r["goodput_tokens_per_s"])
+    summary = {
+        "metric": "moe_capacity_sweep",
+        "value": peak["goodput_tokens_per_s"],
+        "unit": "goodput_tokens_per_s",
+        "n_devices": n,
+        "experts": n_experts,
+        "tokens_per_rank": tok,
+        "capacity_factor_at_peak": peak["capacity_factor"],
+        "dropped_fraction": peak["dropped_fraction"],
+        "a2a_wire_bytes": peak["a2a_wire_bytes"],
+        "rows": rows,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
+def _run_pipeline_bench(args) -> None:
+    """--pipeline: 1F1B microbatch-count sweep on the CPU sim
+    (in-process, never touches the last-good cache).
+
+    Fixed total batch, one row per
+    ``ParameterManager.PIPELINE_LOG2_MICROBATCH_CANDIDATES`` count m:
+    time ``pipeline_1f1b`` over the pp mesh and report ``tokens_per_s``
+    plus both bubble accountings — ``bubble_fraction_priced`` is the
+    cost model's analytic ``(p-1)/(m+p-1)``, ``bubble_fraction_observed``
+    is measured from wall clock: the per-tick time comes from the
+    t(2m)-t(m) slope (same microbatch size, m more steady ticks), so
+    ``(t(m) - m*tick)/t(m)`` is the fraction of the step not spent on
+    useful ticks.  More microbatches shrink the bubble but each tick
+    moves less, so the sweep has a real peak; the summary's
+    ``microbatches_at_peak`` is what ``HVDT_AUTOTUNE_PIPELINE_SEED``
+    reads to seed the autotuner's pipeline dimension."""
+    _force_cpu_sim(8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import numpy as np
+
+    from horovod_tpu.analysis import costmodel as _cm
+    from horovod_tpu.autotune import ParameterManager
+    from horovod_tpu.parallel.pipeline import pipeline_1f1b
+
+    devs = jax.devices()
+    p = 4 if len(devs) >= 4 else len(devs)
+    mesh = Mesh(np.asarray(devs[:p], dtype=object), ("pp",))
+    shard_map = _shard_map_fn()
+    dim = 64
+    total = 128     # total rows per step, split into m microbatches
+    w = jax.random.normal(jax.random.PRNGKey(1), (p, dim, dim),
+                          jnp.float32) * 0.1
+
+    def stage_fn(params, xb):
+        return jnp.tanh(xb @ params)
+
+    def make_step(m):
+        def local(wl, mbs):
+            return pipeline_1f1b(stage_fn, wl[0], mbs, axis="pp")
+
+        return jax.jit(shard_map(local, mesh=mesh,
+                                 in_specs=(P("pp"), P()),
+                                 out_specs=P()))
+
+    iters, warmup = max(3, args.num_iters), max(1, args.num_warmup)
+
+    def time_step(m, mb):
+        step = make_step(m)
+        mbs = jax.random.normal(jax.random.PRNGKey(2), (m, mb, dim),
+                                jnp.float32)
+
+        def run_and_wait():
+            float(jnp.sum(step(w, mbs)[..., :1]))
+
+        for _ in range(warmup):
+            run_and_wait()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            run_and_wait()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    model = _cm.CostModel(_cm.Calibration())
+    rows = []
+    for lg in ParameterManager.PIPELINE_LOG2_MICROBATCH_CANDIDATES:
+        m = int(round(2 ** lg))
+        mb = max(1, total // m)
+        t_m = time_step(m, mb)
+        t_2m = time_step(2 * m, mb)
+        tick = max(0.0, (t_2m - t_m) / m)
+        observed = (t_m - m * tick) / t_m if t_m > 0 else 0.0
+        observed = min(1.0, max(0.0, observed))
+        priced = model.pipeline_bubble_fraction(p, m)
+        rows.append({
+            "microbatches": m,
+            "microbatch_rows": mb,
+            "seconds": t_m,
+            "tokens_per_s": round(m * mb / t_m, 1),
+            "tick_seconds": tick,
+            "bubble_fraction_priced": round(priced, 4),
+            "bubble_fraction_observed": round(observed, 4),
+        })
+        print(f"microbatches {m:>3}  {t_m*1e3:>8.2f}ms  "
+              f"{rows[-1]['tokens_per_s']:>10.1f} rows/s  "
+              f"bubble priced {priced:.3f} observed {observed:.3f}",
+              file=sys.stderr)
+
+    peak = max(rows, key=lambda r: r["tokens_per_s"])
+    summary = {
+        "metric": "pipeline_microbatch_sweep",
+        "value": peak["tokens_per_s"],
+        "unit": "tokens_per_s",
+        "n_devices": len(devs),
+        "stages": p,
+        "microbatches_at_peak": peak["microbatches"],
+        "bubble_fraction_priced": peak["bubble_fraction_priced"],
+        "bubble_fraction_observed": peak["bubble_fraction_observed"],
+        "rows": rows,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
 
 
 def _run_serve_child(args) -> None:
@@ -1172,6 +1428,19 @@ def main() -> None:
         # Pure-CPU in-process fleet trace replay — no child, no
         # accelerator, no last-good cache.
         _run_fleet_bench(args)
+        return
+
+    if args.moe:
+        # CPU-sim in-process expert-axis sweep — no child, no
+        # last-good cache (must run before anything imports jax so the
+        # 8-device sim pin takes).
+        _run_moe_bench(args)
+        return
+
+    if args.pipeline:
+        # CPU-sim in-process 1F1B microbatch sweep — no child, no
+        # last-good cache.
+        _run_pipeline_bench(args)
         return
 
     if args.serve_llm:

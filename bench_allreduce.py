@@ -356,6 +356,152 @@ def _run_reduce_scatter(args) -> None:
     print(json.dumps(summary))
 
 
+def bench_a2a_jit(mesh, nbytes: int, dtype, inner: int, iters: int,
+                  warmup: int, wire: str = "f32"):
+    """Per-op seconds for a chained ``all_to_all`` of ``nbytes`` on the
+    dp mesh — the MoE expert-dispatch exchange
+    (``parallel/moe._a2a_transport``), measured through the production
+    transport path so an int8 leg times exactly what an
+    ``HVDT_TRANSPORT=ep:ring:int8:...`` policy line buys: block-scaled
+    int8 payload + f32 scale alltoalls with quantize/dequantize on
+    either side (the gamma term), not a bare int8 exchange."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.moe import _a2a_transport
+    from horovod_tpu.transport import policy as tpolicy
+
+    n = mesh.devices.size
+    count = max(n, nbytes // jnp.dtype(dtype).itemsize)
+    count -= count % n
+    c = count // n
+    # Global [n, n, c] sharded on dim 0: each rank holds one [n, c]
+    # dispatch block whose slice i is bound for rank i — the MoE
+    # dispatch layout.
+    x = jax.device_put(jnp.ones((n, n, c), dtype),
+                       NamedSharding(mesh, P("dp")))
+    pcast = getattr(lax, "pcast", None)
+
+    prev = os.environ.get("HVDT_TRANSPORT")
+    if wire == "f32":
+        os.environ.pop("HVDT_TRANSPORT", None)
+    else:
+        os.environ["HVDT_TRANSPORT"] = f"dp:ring:{wire}:64M"
+    tpolicy.reset()
+    try:
+        def body(xl):
+            def one(_, acc):
+                # a2a permutes blocks across ranks, so chaining the
+                # output back as the next input keeps values bounded
+                # while forcing each iteration to wait for the last.
+                out = _a2a_transport(acc[0], "dp", "bench")[None]
+                return (pcast(out, ("dp",), to="varying")
+                        if pcast is not None else out)
+
+            return lax.fori_loop(0, inner, one, xl)
+
+        f = jax.jit(_shard_map()(body, mesh=mesh, in_specs=P("dp"),
+                                 out_specs=P("dp")))
+
+        def run_and_wait():
+            float(jnp.sum(f(x)[..., :1].astype(jnp.float32)))
+
+        for _ in range(warmup):
+            run_and_wait()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            run_and_wait()
+            times.append((time.perf_counter() - t0) / inner)
+        return min(times)
+    finally:
+        if prev is None:
+            os.environ.pop("HVDT_TRANSPORT", None)
+        else:
+            os.environ["HVDT_TRANSPORT"] = prev
+        tpolicy.reset()
+
+
+def _run_a2a(args) -> None:
+    """--a2a: sweep the expert-dispatch ``all_to_all`` per message size,
+    f32 against the block-scaled int8 MoE wire, and emit
+    ``op="all_to_all"`` rows.
+
+    The rows feed ``analysis.costmodel.fit_from_bench`` (via
+    tools/fit_costmodel.py) alongside the allreduce sweeps: (alpha,
+    beta) are LINK constants with per-op geometry factored out row by
+    row, so a2a rows sharpen the same fit that prices
+    ``CostModel.alltoall_seconds`` — which is what the autotuner's
+    MoE capacity-factor dimension's model seed
+    (``predict_leg_order(...)["moe"]``) consults.  Rows deliberately
+    omit ``bytes_on_wire`` so the fitter applies a2a geometry
+    (``(n-1)/n``) itself."""
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()
+    n = mesh.devices.size
+    dev0 = jax.devices()[0]
+    print(f"# all_to_all sweep on {n}x "
+          f"{dev0.platform}:{dev0.device_kind} "
+          f"(the MoE expert-dispatch wire; int8 = block-scaled "
+          f"payload + f32 scales)", file=sys.stderr)
+
+    import numpy as np
+
+    rows = []
+    size = args.min_bytes
+    while size <= args.max_bytes:
+        t_f32 = bench_a2a_jit(mesh, size, args.dtype, args.inner,
+                              args.iters, args.warmup, wire="f32")
+        t_int8 = bench_a2a_jit(mesh, size, args.dtype, args.inner,
+                               args.iters, args.warmup, wire="int8")
+        count = max(1, size // np.dtype(args.dtype).itemsize)
+        speedup = t_f32 / t_int8 if t_int8 > 0 else None
+        for wire, secs in (("f32", t_f32), ("int8", t_int8)):
+            rows.append({
+                "bytes": size, "size_bytes": size,
+                "axis": "dp", "axis_size": int(n),
+                "algorithm": "ring", "wire": wire,
+                "op": "all_to_all",
+                "seconds": secs,
+                "a2a_us": secs * 1e6,
+                "a2a_algbw_gbps": size / secs / 1e9,
+                "a2a_wire_bytes": wire_payload_bytes(
+                    count, args.dtype, wire),
+                "int8_speedup_vs_f32": speedup,
+            })
+        print(f"{_fmt_bytes(size):>8}  f32 {t_f32*1e6:>9.1f}us  "
+              f"int8 {t_int8*1e6:>9.1f}us  "
+              f"speedup {speedup:>5.2f}x", file=sys.stderr)
+        size *= 4
+
+    peak = max((r for r in rows if r["wire"] == "f32"),
+               key=lambda r: r["a2a_algbw_gbps"])
+    summary = {
+        "metric": "a2a_sweep",
+        "schema_version": SCHEMA_VERSION,
+        "value": round(peak["int8_speedup_vs_f32"], 3),
+        "unit": "int8_speedup_vs_f32",
+        "n_devices": int(n),
+        "platform": dev0.platform,
+        "at_bytes": peak["bytes"],
+        "int8_a2a_speedup_vs_f32_at_peak": round(
+            peak["int8_speedup_vs_f32"], 3),
+        "rows": rows,
+    }
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+
+
 def _run_hierarchical(args) -> None:
     """--hierarchical: the per-(axis, algorithm, wire, size) sweep of
     the transport-policy data plane, with the measured
@@ -559,6 +705,12 @@ def main() -> None:
                          "the flat allreduce; emits "
                          "rs_ag_speedup_vs_allreduce rows (the "
                          "HVDT_AUTOTUNE_ZERO_SEED input)")
+    ap.add_argument("--a2a", action="store_true",
+                    help="measure the MoE expert-dispatch all_to_all "
+                         "(f32 vs the block-scaled int8 transport "
+                         "wire); emits op=all_to_all rows for the "
+                         "cost-model fitter and "
+                         "int8_a2a_speedup_vs_f32_at_peak")
     ap.add_argument("--hierarchical", action="store_true",
                     help="two-level transport-policy sweep on an "
                          "(outer x inner) mesh: per-(axis, algorithm, "
@@ -582,6 +734,9 @@ def main() -> None:
         return
     if args.reduce_scatter:
         _run_reduce_scatter(args)
+        return
+    if args.a2a:
+        _run_a2a(args)
         return
     if args.hierarchical or args.transport:
         _run_hierarchical(args)

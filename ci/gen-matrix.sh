@@ -21,7 +21,10 @@
 #       closed-loop-policy-controller (pricing / guardrails /
 #       leg-actuation / driver-hook) +
 #       fleet-scheduler (shared inventory / seq-guarded target doc /
-#       bin-packing reclaim-backfill / trace-driven chaos sim) tests on
+#       bin-packing reclaim-backfill / trace-driven chaos sim) +
+#       4d-parallel (pp*ep*dp acceptance vs 1-chip dense reference /
+#       priced-vs-observed pipeline bubble / int8 expert wire /
+#       layout-change checkpoint restore) tests on
 #       CPU) — the pre-merge gate.  The full matrix additionally
 #       emits the `analysis` service: python -m horovod_tpu.analysis
 #       --all --perf as a hard gate over the hvdt-lint ratchet

@@ -207,6 +207,74 @@ def _selfcheck_step(zero: bool = False):
     return step, leaves, tree
 
 
+def _parallel4d_step():
+    """Build the 4D reference program: a 1F1B pipeline whose stage body
+    is an MoE layer — every a2a is issued inside the microbatch scan, so
+    the fingerprint carries the composed a2a+ppermute pairs the 4D
+    schedule closure verifies — over a ``(pp, ep, dp)`` mesh, with the
+    loss psum-reduced over ``dp`` (the reduce group the pipeline/expert
+    axes are excluded from).  Axis extents degrade to 1 on small hosts
+    exactly like :func:`_selfcheck_step`; the jaxpr carries every
+    collective regardless."""
+    import inspect
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:                     # jax 0.4.x
+        from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    pp = 2 if n % 2 == 0 else 1
+    ep = 2 if (n // pp) % 2 == 0 else 1
+    dp = n // (pp * ep)
+    mesh = Mesh(np.asarray(devs, dtype=object).reshape(pp, ep, dp),
+                ("pp", "ep", "dp"))
+    smap_kw = {}
+    sig = inspect.signature(shard_map).parameters
+    if "check_rep" in sig:
+        smap_kw["check_rep"] = False
+    elif "check_vma" in sig:
+        smap_kw["check_vma"] = False
+
+    num_mb, tok, dim = 4, 8, 16
+    n_experts = ep                         # one expert per ep rank
+    stage_w = jnp.zeros((pp, dim, dim), jnp.float32)
+    router_w = jnp.zeros((pp, dim, n_experts), jnp.float32)
+    microbatches = jnp.zeros((dp, num_mb, tok, dim), jnp.float32)
+
+    def local(w, rw, mbs):
+        from ..parallel.moe import moe_dispatch_combine
+        from ..parallel.pipeline import pipeline_1f1b
+
+        def stage_fn(params, x):
+            sw, srw = params
+            h = x @ sw
+            # The aux-loss pmeans trace into the scan body jaxpr even
+            # though only the combined activations leave the stage.
+            y, _aux = moe_dispatch_combine(
+                h, h @ srw, lambda blk: blk * 2.0, axis="ep",
+                experts_per_rank=1, capacity_factor=1.25, top_k=1)
+            return y
+
+        out = pipeline_1f1b(stage_fn, (w[0], rw[0]), mbs[0], axis="pp")
+        return jax.lax.pmean(jnp.mean(out * out), "dp")
+
+    def step(w, rw, mbs):
+        return shard_map(
+            local, mesh=mesh,
+            in_specs=(P("pp"), P("pp"), P("dp")),
+            out_specs=P(), **smap_kw)(w, rw, mbs)
+
+    return step, (stage_w, router_w, microbatches)
+
+
 def _gate_selfcheck(export: Optional[str], root: str) -> int:
     from . import schedule as sched
 
@@ -250,6 +318,28 @@ def _gate_selfcheck(export: Optional[str], root: str) -> int:
         problems.extend(
             f["message"]
             for f in sched.verify_no_data_dependent_collectives(fp_h))
+
+        # 4D leg: MoE-inside-1F1B on the (pp, ep, dp) mesh with the
+        # int8 expert dispatch wire — the a2a/ppermute closure gate.
+        os.environ["HVDT_TRANSPORT"] = "ep:ring:int8:64M"
+        tpolicy.reset()
+        step4, args4 = _parallel4d_step()
+        fp4 = sched.extract_schedule(step4, *args4, label="parallel4d")
+        if not any(e.op == "all_to_all" for e in fp4.events):
+            problems.append(
+                "parallel4d fingerprint traced no all_to_all — the MoE "
+                "dispatch/combine pair is missing from the schedule")
+        if not any(e.op == "ppermute" for e in fp4.events):
+            problems.append(
+                "parallel4d fingerprint traced no ppermute — the 1F1B "
+                "clock is missing from the schedule")
+        problems.extend(
+            f["message"]
+            for f in sched.verify_a2a_ppermute_pairing(fp4))
+        problems.extend(
+            f["message"]
+            for f in sched.verify_no_data_dependent_collectives(fp4))
+        print(f"hvdt-schedule: {fp4.summary()}")
 
         if export:
             path = export if os.path.isabs(export) \
@@ -340,6 +430,16 @@ def _reference_fingerprints() -> list:
         step, leaves, _ = _selfcheck_step(zero=True)
         out.append(sched.extract_schedule(step, *leaves,
                                           label="overlap-hier-zero"))
+        # The 4D composition: MoE dispatch/combine inside the 1F1B
+        # scan on the (pp, ep, dp) mesh, expert a2a on the int8 wire —
+        # prices a2a seconds and the ppermute tick stream so the
+        # ratchet covers 4D schedules.
+        os.environ["HVDT_TRANSPORT"] = "ep:ring:int8:64M"
+        os.environ.pop("HVDT_QUANT_BLOCK", None)
+        tpolicy.reset()
+        step4, args4 = _parallel4d_step()
+        out.append(sched.extract_schedule(step4, *args4,
+                                          label="parallel4d"))
     finally:
         for k, v in old_env.items():
             if v is None:
@@ -398,6 +498,19 @@ def _gate_perf(root: str, baseline_path: str, update: bool,
     costs = {fp.label: model.evaluate(fp, topo) for fp in fps}
     for c in costs.values():
         print(f"hvdt-perf: {c.summary()}")
+
+    # Hard gate: every a2a/ppermute the 4D schedules issue must come
+    # back PRICED — a zero-second expert exchange or pipeline tick
+    # means the event's axes escaped tier classification (or a new op
+    # bypassed collective_geometry) and the ratchet would silently
+    # stop covering it.
+    for label, c in sorted(costs.items()):
+        for ec in c.events:
+            if ec.op in ("all_to_all", "ppermute") and ec.seconds <= 0:
+                problems.append(
+                    f"{label}: collective #{ec.index} ({ec.op}) is "
+                    f"unpriced (0 s) — its axes did not map onto a "
+                    f">1-member tier group on the reference topology")
 
     # (c) model-vs-measured validation: the fitted model must reproduce
     # the measured hierarchical speedup its calibration sweep recorded.

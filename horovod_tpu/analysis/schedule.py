@@ -65,7 +65,8 @@ __all__ = [
     "CollectiveEvent", "ScheduleFingerprint", "extract_schedule",
     "hlo_collective_counts", "verify_bucket_plan_invariance",
     "verify_flip_compat", "verify_post_pin_psum_family",
-    "verify_no_data_dependent_collectives", "first_schedule_deviation",
+    "verify_no_data_dependent_collectives", "verify_a2a_ppermute_pairing",
+    "first_schedule_deviation",
     "load_fingerprint", "COLLECTIVE_PRIMS", "PSUM_FAMILY",
 ]
 
@@ -447,6 +448,52 @@ def verify_bucket_plan_invariance(
             "overlap_schedule no longer equals the reverse-topological "
             "mapping of fused_allreduce_buckets — the issue order the "
             "barrier chain pins has drifted from the plan"))
+    return out
+
+
+def verify_a2a_ppermute_pairing(
+        fp: ScheduleFingerprint) -> List[Dict[str, Any]]:
+    """The 4D-schedule closure checks.
+
+    * **a2a pairing** — MoE combine reverses dispatch, so every
+      ``all_to_all`` signature (axes, dtype, element count) must appear
+      an EVEN number of times per control-flow context: an odd count
+      means tokens were scattered onto the expert axis and never
+      gathered back (or a combine exchanges a different payload than
+      its dispatch — either way the expert-parallel layout leaks out of
+      the MoE block).  The int8 dispatch wire issues two a2a per leg
+      (payload + scales); each signature still pairs across
+      dispatch/combine, so the parity check holds for every wire.
+    * **ppermute clocking** — every ``ppermute`` must sit under a
+      ``scan`` context: the 1F1B microbatch clock is a ``lax.scan``,
+      and a hand-rolled ppermute outside it runs outside the
+      warmup/steady/cooldown accounting, so its ticks are invisible to
+      the bubble-fraction telemetry the cost model is validated
+      against."""
+    out = []
+    a2a: Dict[Tuple, List[CollectiveEvent]] = {}
+    for e in fp.events:
+        if e.op == "all_to_all":
+            key = (e.axes, e.dtype, e.count, e.context)
+            a2a.setdefault(key, []).append(e)
+        elif e.op == "ppermute" and "scan" not in e.context:
+            out.append(_finding(
+                "ppermute-outside-scan",
+                f"collective #{e.index} (ppermute over {list(e.axes)}) "
+                f"is issued outside a scan body — it runs outside the "
+                f"1F1B microbatch clock, so its ticks escape the "
+                f"warmup/steady/cooldown phase accounting",
+                event=e.to_dict()))
+    for key, evs in sorted(a2a.items()):
+        if len(evs) % 2 != 0:
+            axes, dtype, count, _ = key
+            out.append(_finding(
+                "unpaired-all-to-all",
+                f"all_to_all signature (axes={list(axes)}, dtype={dtype}, "
+                f"count={count}) appears {len(evs)} time(s) — "
+                f"dispatch/combine must pair, an odd count means the "
+                f"expert-parallel layout leaks out of the MoE block",
+                indices=[e.index for e in evs]))
     return out
 
 

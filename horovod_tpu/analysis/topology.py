@@ -39,7 +39,7 @@ from typing import Dict, Optional, Sequence, Tuple
 __all__ = [
     "LinkConstants", "TopologySpec", "DEFAULT_TIER_CONSTANTS",
     "TIER_ICI", "TIER_DCN", "TIERS", "classify_axis", "tier_sizes",
-    "chip_peak_flops",
+    "chip_peak_flops", "NOMINAL_SIM_PEAK_FLOPS",
 ]
 
 TIER_ICI = "ici"
@@ -95,6 +95,12 @@ DEFAULT_TIER_CONSTANTS: Dict[str, LinkConstants] = {
 # The rule imports these so its bounds live where the constants do.
 PEAK_LITERAL_FLOOR = 1e11
 PEAK_LITERAL_CEIL = 1e16
+
+# Nominal peak FLOP/s when no real device kind matches the table (CPU
+# simulator) — the HVDT_PEAK_FLOPS default and report_pipeline_mfu
+# fallback.  Any consistent value works there (MFU is a ratio); it
+# lives HERE so the magic-peak-flops rule keeps it single-sourced.
+NOMINAL_SIM_PEAK_FLOPS = 1e12
 
 # Per-logical-byte quantize/dequantize fallback for compressed wires
 # (block-scaled int8/int4 kernels run near HBM speed — the packed int4
@@ -183,13 +189,20 @@ def classify_axis(axis: str, axes: Sequence[str]) -> str:
     """Transport tier of one mesh axis within its reduce group.
 
     Literal ``ici``/``dcn`` names classify themselves (the pod mesh
-    contract names its axes exactly that); anything else falls back to
-    the ``parallel/mesh.py`` position convention — innermost axis rides
+    contract names its axes exactly that); the 4D pod axes follow the
+    ``pod_mesh_spec`` placement contract — ``pp`` carves whole pod
+    groups (its ppermute ticks cross DCN), ``ep`` carves chips inside a
+    pod (its expert a2a rides ICI); anything else falls back to the
+    ``parallel/mesh.py`` position convention — innermost axis rides
     ICI, outer axes cross DCN."""
     if axis in TIERS:
         return axis
     from ..parallel import mesh as _mesh
 
+    if axis == _mesh.AXIS_PP:
+        return TIER_DCN
+    if axis == _mesh.AXIS_EP:
+        return TIER_ICI
     return _mesh.axis_transport_class(axis, axes)
 
 

@@ -169,6 +169,13 @@ class ParameterManager:
     OVERLAP_SCHEDULE_CANDIDATES = (0.0, 1.0)
     TRANSPORT_CANDIDATES = (0.0, 1.0)
     ZERO_CANDIDATES = (0.0, 1.0)
+    # Expert capacity factors (parallel/moe.py): dispatch payload and
+    # dropped-token fraction trade directly against each other.
+    MOE_CAPACITY_CANDIDATES = (1.0, 1.25, 1.5, 2.0)
+    # log2(microbatch count) for the 1F1B clock (parallel/pipeline.py):
+    # 4..32 microbatches — bubble fraction (p-1)/(m+p-1) vs per-tick
+    # ppermute payload.
+    PIPELINE_LOG2_MICROBATCH_CANDIDATES = (2.0, 3.0, 4.0, 5.0)
 
     def __init__(self,
                  warmup_samples: Optional[int] = None,
@@ -180,7 +187,9 @@ class ParameterManager:
                  tune_quant: Optional[bool] = None,
                  tune_overlap: Optional[bool] = None,
                  tune_transport: Optional[bool] = None,
-                 tune_zero: Optional[bool] = None):
+                 tune_zero: Optional[bool] = None,
+                 tune_moe: Optional[bool] = None,
+                 tune_pipeline: Optional[bool] = None):
         self.warmup = (warmup_samples if warmup_samples is not None
                        else config.get_int("HVDT_AUTOTUNE_WARMUP_SAMPLES"))
         self.steps_per_sample = (
@@ -239,8 +248,29 @@ class ParameterManager:
         # > 1).
         self.tune_zero = (tune_zero if tune_zero is not None
                           else config.get_bool("HVDT_AUTOTUNE_ZERO"))
+        # Optional eighth dimension: expert capacity factor
+        # (parallel/moe.py) — a2a dispatch bytes scale linearly with
+        # capacity while the dropped-token fraction falls, and the
+        # break-even moves with the dispatch wire the GP is already
+        # pricing, so they are searched jointly.  Hot-swappable: the
+        # capacity changes the dispatch layout (a re-jit), never
+        # optimizer state.  The starting leg is the explicit
+        # HVDT_MOE_CAPACITY_FACTOR, the MEASURED
+        # HVDT_AUTOTUNE_MOE_SEED verdict, or the cost model's a2a-wire
+        # ordering.
+        self.tune_moe = (tune_moe if tune_moe is not None
+                         else config.get_bool("HVDT_AUTOTUNE_MOE"))
+        # Optional ninth dimension: 1F1B microbatch count
+        # (parallel/pipeline.py) — more microbatches shrink the bubble
+        # (p-1)/(m+p-1) but shrink every ppermute tick's payload toward
+        # the latency floor, the same alpha/beta trade the bucket-size
+        # dimension walks, so the GP prices them jointly.
+        # Hot-swappable: the clock changes lowering, never state.
+        self.tune_pipeline = (
+            tune_pipeline if tune_pipeline is not None
+            else config.get_bool("HVDT_AUTOTUNE_PIPELINE"))
         # Column layout: [log2_bucket, overlap] (+fused) (+quant)
-        # (+overlap_schedule) (+transport).
+        # (+overlap_schedule) (+transport) (+zero) (+moe) (+pipeline).
         self._quant_col = (2 + int(self.tune_fused)) if self.tune_quant \
             else None
         self._overlap_col = (
@@ -254,6 +284,16 @@ class ParameterManager:
             2 + int(self.tune_fused) + int(self.tune_quant)
             + int(self.tune_overlap) + int(self.tune_transport)
         ) if self.tune_zero else None
+        self._moe_col = (
+            2 + int(self.tune_fused) + int(self.tune_quant)
+            + int(self.tune_overlap) + int(self.tune_transport)
+            + int(self.tune_zero)
+        ) if self.tune_moe else None
+        self._pipeline_col = (
+            2 + int(self.tune_fused) + int(self.tune_quant)
+            + int(self.tune_overlap) + int(self.tune_transport)
+            + int(self.tune_zero) + int(self.tune_moe)
+        ) if self.tune_pipeline else None
         import itertools
 
         dims = [self.LOG2_BUCKET_CANDIDATES, self.OVERLAP_CANDIDATES]
@@ -267,6 +307,10 @@ class ParameterManager:
             dims.append(self.TRANSPORT_CANDIDATES)
         if self.tune_zero:
             dims.append(self.ZERO_CANDIDATES)
+        if self.tune_moe:
+            dims.append(self.MOE_CAPACITY_CANDIDATES)
+        if self.tune_pipeline:
+            dims.append(self.PIPELINE_LOG2_MICROBATCH_CANDIDATES)
         grid = np.array(list(itertools.product(*dims)), float)
         self._bo = BayesianOptimizer(grid, noise=noise)
         start = [math.log2(config.get_int("HVDT_FUSION_THRESHOLD")), 1.0]
@@ -280,6 +324,10 @@ class ParameterManager:
             start.append(float(_env_transport()))
         if self.tune_zero:
             start.append(float(_env_zero()))
+        if self.tune_moe:
+            start.append(_env_capacity_factor())
+        if self.tune_pipeline:
+            start.append(math.log2(_env_microbatches()))
         self._current = np.array(start)
         self._sample = _Sample(self._current)
         self._samples_done = 0
@@ -349,6 +397,24 @@ class ParameterManager:
         return _env_zero()
 
     @property
+    def capacity_factor(self) -> float:
+        """Current expert capacity-factor choice; outside the tuned
+        dimension it reports the HVDT_MOE_CAPACITY_FACTOR / seed-file
+        default."""
+        if self.tune_moe:
+            return float(self._current[self._moe_col])
+        return _env_capacity_factor()
+
+    @property
+    def num_microbatches(self) -> int:
+        """Current 1F1B microbatch-count choice (the log2 knob decoded);
+        outside the tuned dimension it reports the
+        HVDT_PIPELINE_MICROBATCHES / seed-file default."""
+        if self.tune_pipeline:
+            return int(round(2 ** self._current[self._pipeline_col]))
+        return _env_microbatches()
+
+    @property
     def tuning_complete(self) -> bool:
         return self._done
 
@@ -394,8 +460,11 @@ class ParameterManager:
         try:
             with open(self._log_file, "a", newline="") as f:
                 row = [time.time(), int(2 ** s.point[0]), int(s.point[1])]
-                for extra in s.point[2:]:    # fused / quant dimensions
-                    row.append(int(extra))
+                for extra in s.point[2:]:    # fused/quant/.../moe dims
+                    # Leg knobs are small ints; the capacity-factor
+                    # column is fractional — keep it readable either way.
+                    row.append(int(extra) if float(extra).is_integer()
+                               else f"{extra:g}")
                 csv.writer(f).writerow(row + [f"{s.score:.1f}"])
         except OSError as e:
             log.warning("autotune log write failed: %s", e)
@@ -526,6 +595,72 @@ def _env_transport() -> bool:
         return bool(ms) if ms is not None else False
 
 
+def _env_capacity_factor() -> float:
+    """The environment's expert capacity-factor default (the MoE
+    dimension's starting leg): an explicitly set
+    HVDT_MOE_CAPACITY_FACTOR wins; else the MEASURED verdict of a
+    bench.py --moe sweep named by HVDT_AUTOTUNE_MOE_SEED
+    (capacity_factor_at_peak); else the cost model may order the leg
+    (HVDT_AUTOTUNE_MODEL_SEED — a True 'moe' verdict means the
+    quantized dispatch wire wins, so capacity headroom is cheap: start
+    at the registry default 1.25; False starts tight at 1.0 to keep
+    the expensive f32 dispatch payload minimal)."""
+    import os
+
+    if os.environ.get("HVDT_MOE_CAPACITY_FACTOR", "").strip():
+        return config.get_float("HVDT_MOE_CAPACITY_FACTOR")
+    seed = config.get_str("HVDT_AUTOTUNE_MOE_SEED").strip()
+    if seed:
+        import json
+
+        try:
+            with open(seed) as fh:
+                doc = json.load(fh)
+            v = float(doc.get("capacity_factor_at_peak", 0.0))
+            if v > 0:
+                return v
+        except (OSError, ValueError, TypeError) as e:
+            log.warning("moe autotune seed %s unreadable: %s", seed, e)
+    ms = _model_seed("moe")
+    if ms is not None:
+        return 1.25 if ms else 1.0
+    return config.get_float("HVDT_MOE_CAPACITY_FACTOR")
+
+
+def _env_microbatches() -> int:
+    """The environment's 1F1B microbatch-count default (the pipeline
+    dimension's starting leg): an explicitly set
+    HVDT_PIPELINE_MICROBATCHES wins; else the MEASURED verdict of a
+    bench.py --pipeline sweep named by HVDT_AUTOTUNE_PIPELINE_SEED
+    (microbatches_at_peak); else the cost model may order the leg
+    (HVDT_AUTOTUNE_MODEL_SEED — a True 'pipeline' verdict means the
+    tick is bandwidth-dominated, so halving per-tick payload is free
+    bubble shrink: start at the high end 16; False starts at the
+    registry default 8)."""
+    import os
+
+    if os.environ.get("HVDT_PIPELINE_MICROBATCHES", "").strip():
+        return max(1, config.get_int("HVDT_PIPELINE_MICROBATCHES"))
+    seed = config.get_str("HVDT_AUTOTUNE_PIPELINE_SEED").strip()
+    if seed:
+        import json
+
+        try:
+            with open(seed) as fh:
+                doc = json.load(fh)
+            v = int(doc.get("microbatches_at_peak", 0))
+            if v > 0:
+                return v
+        except (OSError, ValueError, TypeError) as e:
+            log.warning("pipeline autotune seed %s unreadable: %s",
+                        seed, e)
+    ms = _model_seed("pipeline")
+    if ms is not None:
+        return 16 if ms else max(1, config.get_int(
+            "HVDT_PIPELINE_MICROBATCHES"))
+    return max(1, config.get_int("HVDT_PIPELINE_MICROBATCHES"))
+
+
 class BenchmarkAutotuner:
     """Closed-loop driver tying :class:`ParameterManager` to a train loop.
 
@@ -617,9 +752,13 @@ class BenchmarkAutotuner:
               if self.pm.tune_transport else "")
         zr = (f" zero={'sharded' if self.pm.zero_sharding else 'repl'}"
               if self.pm.tune_zero else "")
+        moe = (f" capacity={self.pm.capacity_factor:g}"
+               if self.pm.tune_moe else "")
+        pipe = (f" microbatches={self.pm.num_microbatches}"
+                if self.pm.tune_pipeline else "")
         return (f"{state}: bucket={self.pm.bucket_bytes // 2**20} MiB "
                 f"overlap={self.pm.overlap_buckets}"
-                f"{fused}{quant}{ovl}{tr}{zr} "
+                f"{fused}{quant}{ovl}{tr}{zr}{moe}{pipe} "
                 f"({self.pm._samples_done} samples)")
 
 
@@ -701,6 +840,27 @@ class AutotunedStep:
     ``HVDT_AUTOTUNE_ZERO_SEED`` bench_allreduce --reduce-scatter
     verdict.
 
+    With ``HVDT_AUTOTUNE_MOE=1`` the space gains an expert
+    capacity-factor dimension (parallel/moe.py): builders accepting a
+    ``capacity_factor`` keyword are rebuilt as
+    ``builder(threshold_bytes, capacity_factor=float)`` — dispatch
+    payload vs dropped-token fraction, priced jointly with the wire
+    legs; hot-swappable because capacity changes the dispatch layout
+    (a re-jit), never optimizer state.  Starting leg: explicit
+    ``HVDT_MOE_CAPACITY_FACTOR``, the measured
+    ``HVDT_AUTOTUNE_MOE_SEED`` bench verdict, or the cost model's
+    a2a-wire ordering (``HVDT_AUTOTUNE_MODEL_SEED``).
+
+    With ``HVDT_AUTOTUNE_PIPELINE=1`` the space gains a 1F1B
+    microbatch-count dimension (parallel/pipeline.py): builders
+    accepting a ``microbatches`` keyword are rebuilt as
+    ``builder(threshold_bytes, microbatches=int)`` — bubble fraction
+    vs per-tick ppermute payload; hot-swappable because the clock
+    changes lowering, never state.  Starting leg: explicit
+    ``HVDT_PIPELINE_MICROBATCHES``, the measured
+    ``HVDT_AUTOTUNE_PIPELINE_SEED`` bench verdict, or the cost model's
+    ppermute ordering.
+
     Args:
       builder: ``builder(threshold_bytes | None) -> step_callable``
         (optionally also accepting ``fused=bool``).
@@ -729,6 +889,8 @@ class AutotunedStep:
             self._accepts_overlap = "overlap" in sig or var_kw
             self._accepts_transport = "transport" in sig or var_kw
             self._accepts_zero = "zero" in sig or var_kw
+            self._accepts_capacity = "capacity_factor" in sig or var_kw
+            self._accepts_microbatches = "microbatches" in sig or var_kw
         except (TypeError, ValueError):
             self._accepts_fused = False
             self._accepts_quant = False
@@ -736,6 +898,8 @@ class AutotunedStep:
             self._accepts_overlap = False
             self._accepts_transport = False
             self._accepts_zero = False
+            self._accepts_capacity = False
+            self._accepts_microbatches = False
         # Pin every tuned A/B dimension's starting leg at build 0 so the
         # opt-state structure established before tuning matches every
         # later rebuild (both fused legs keep one state tree —
@@ -760,6 +924,12 @@ class AutotunedStep:
         if (self.enabled and self._accepts_zero
                 and config.get_bool("HVDT_AUTOTUNE_ZERO")):
             build_kw["zero"] = _env_zero()
+        if (self.enabled and self._accepts_capacity
+                and config.get_bool("HVDT_AUTOTUNE_MOE")):
+            build_kw["capacity_factor"] = _env_capacity_factor()
+        if (self.enabled and self._accepts_microbatches
+                and config.get_bool("HVDT_AUTOTUNE_PIPELINE")):
+            build_kw["microbatches"] = _env_microbatches()
         self._step = builder(None, **build_kw)
         self._tree_example = tree_example
         self._steps_per_sample = steps_per_sample
@@ -807,6 +977,10 @@ class AutotunedStep:
             kw["transport"] = pm.transport_policy
         if pm.tune_zero and self._accepts_zero:
             kw["zero"] = pm.zero_sharding
+        if pm.tune_moe and self._accepts_capacity:
+            kw["capacity_factor"] = pm.capacity_factor
+        if pm.tune_pipeline and self._accepts_microbatches:
+            kw["microbatches"] = pm.num_microbatches
         kw.update(self._filtered_overrides())
         threshold = (self._override_threshold
                      if self._override_threshold is not None
@@ -819,7 +993,9 @@ class AutotunedStep:
                     "quant_leg": "_accepts_quant_leg",
                     "overlap": "_accepts_overlap",
                     "transport": "_accepts_transport",
-                    "zero": "_accepts_zero"}
+                    "zero": "_accepts_zero",
+                    "capacity_factor": "_accepts_capacity",
+                    "microbatches": "_accepts_microbatches"}
 
     def apply_leg(self, **legs: Any) -> None:
         """Queue a policy-controller leg override, adopted at the NEXT

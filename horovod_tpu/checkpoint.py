@@ -43,7 +43,8 @@ from typing import Any, Optional
 from .common.logging_util import get_logger
 
 __all__ = ["save_checkpoint", "restore_checkpoint", "CheckpointManager",
-           "save_zero_state", "restore_zero_state"]
+           "save_zero_state", "restore_zero_state",
+           "save_zero_state_4d", "restore_zero_state_4d"]
 
 log = get_logger(__name__)
 
@@ -305,6 +306,114 @@ def restore_zero_state(path: str, num_shards: Optional[int] = None):
     if num_shards is not None and int(num_shards) != n_saved:
         state, meta = _zero.reshard_state(state, meta, int(num_shards))
     return state, meta, doc.get("step")
+
+
+_ZERO_LAYOUT = "zero_layout.json"
+
+
+def save_zero_state_4d(path: str, stage_states, stage_metas,
+                       step: Optional[int] = None) -> None:
+    """Persist a pipeline-sharded ZeRO state: one standard per-shard
+    checkpoint per pipeline stage (``stage_0000/`` …, each with its own
+    SHA-256 manifest via :func:`save_zero_state`) plus a top-level
+    ``zero_layout.json`` naming the saved parallelism layout — the save
+    half of the 4D layout-change contract.  A single-stage call is
+    exactly a flat save plus the layout doc, so ``(dp=n)`` checkpoints
+    round-trip through the same path."""
+    stage_states = list(stage_states)
+    stage_metas = list(stage_metas)
+    if len(stage_states) != len(stage_metas):
+        raise ValueError("one meta per stage state required")
+    rank, _ = _rank_size()
+    for si, (st, me) in enumerate(zip(stage_states, stage_metas)):
+        save_zero_state(os.path.join(path, f"stage_{si:04d}"), st, me,
+                        step)
+    if rank == 0:
+        doc = {"layout": {"pp": len(stage_states),
+                          "dp": int(stage_metas[0]["num_shards"])},
+               "stages": len(stage_states),
+               "step": int(step) if step is not None else None}
+        tmp = os.path.join(path, f".{_ZERO_LAYOUT}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(path, _ZERO_LAYOUT))
+    _barrier()
+
+
+def restore_zero_state_4d(path: str, target_metas):
+    """Restore a (possibly pipeline-sharded) ZeRO checkpoint into a
+    **changed parallelism layout**.
+
+    ``target_metas`` is one ``ops.zero.state_metadata`` per pipeline
+    stage of the NEW layout (a one-element list for a flat ``(dp=n)``
+    restore).  Handles every direction through the global logical
+    vector (``ops.zero.concat_states`` + ``rebucket_state`` — the
+    shard/gather-fn pattern): ``(pp=2, dp=4) → (dp=8)`` merges stage
+    checkpoints, ``(dp=8) → (pp=2, dp=4)`` splits a flat one, and
+    dp-only resharding falls out of the same path.  Every shard file of
+    every stage is SHA-256-verified against its manifest before load.
+    The one layout contract: the target's global LOGICAL vector must be
+    stage-major (stage 0's logical elements first).  Logical order
+    within a state is bucket-plan order — the reverse-topological
+    overlap schedule, i.e. REVERSED flatten order — so a combined
+    single-tree target matches only if stage 0's leaves sort after
+    stage 1's; when in doubt, check alignment through
+    ``ops.zero.flatten_state_buffers``, which reads the logical vector
+    directly.
+
+    Returns ``(states, metas, step)`` — lists with one entry per NEW
+    stage.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from .ops import zero as _zero
+
+    layout_doc = os.path.join(path, _ZERO_LAYOUT)
+    if os.path.exists(layout_doc):
+        with open(layout_doc) as f:
+            doc = json.load(f)
+        n_stages = int(doc.get("stages", 1))
+        saved = [restore_zero_state(os.path.join(path, f"stage_{s:04d}"))
+                 for s in range(n_stages)]
+        states = [s for s, _, _ in saved]
+        metas = [m for _, m, _ in saved]
+        step = saved[0][2]
+    else:
+        state, meta, step = restore_zero_state(path)
+        states, metas = [state], [meta]
+    combined, combined_meta = _zero.concat_states(states, metas)
+    flats = _zero.flatten_state_buffers(combined, combined_meta)
+    total = next(iter(flats.values())).size
+    want = sum(int(b["size"]) for tm in target_metas
+               for b in tm["buckets"])
+    if want != total:
+        raise ValueError(
+            f"target layout covers {want} logical elements but the "
+            f"checkpoint holds {total} — different parameter sets")
+    out_states, out_metas = [], []
+    off = 0
+    for tm in target_metas:
+        span = sum(int(b["size"]) for b in tm["buckets"])
+        piece = {name: flat[off:off + span]
+                 for name, flat in flats.items()}
+        off += span
+        n = int(tm["num_shards"])
+        if "mu" in piece:
+            st = _zero.ZeroAdamState(
+                count=jnp.asarray(int(np.asarray(combined.count))
+                                  if hasattr(combined, "mu") else 0,
+                                  jnp.int32),
+                mu=_zero._split_logical(piece["mu"], tm["buckets"], n),
+                nu=_zero._split_logical(piece["nu"], tm["buckets"], n))
+        else:
+            st = _zero.ZeroSgdState(
+                trace=_zero._split_logical(piece["trace"],
+                                           tm["buckets"], n))
+        out_states.append(st)
+        out_metas.append(dict(tm))
+    return out_states, out_metas, step
 
 
 class CheckpointManager:

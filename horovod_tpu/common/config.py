@@ -14,6 +14,8 @@ import dataclasses
 import os
 from typing import Any, Callable, Dict, Optional
 
+from ..analysis.topology import NOMINAL_SIM_PEAK_FLOPS
+
 __all__ = ["Knob", "KNOBS", "CONTRACT_VARS", "get", "get_bool", "get_int",
            "get_float", "get_str", "registry_doc"]
 
@@ -146,6 +148,70 @@ KNOBS: Dict[str, Knob] = {
            "exceeds 1.0 the autotuner's zero dimension STARTS on the "
            "sharded leg — seeded from measurements, not guesses "
            "(mirrors HVDT_AUTOTUNE_TRANSPORT_SEED)."),
+        # --- 4D parallelism (horovod_tpu/parallel: expert axis +
+        #     1F1B pipeline as first-class mesh axes) ---
+        _k("HVDT_PP", 1, int,
+           "Pipeline-parallel extent of the pod mesh "
+           "(parallel.mesh.pod_mesh_spec): carves whole pod groups "
+           "into 1F1B stages — the pp axis rides the DCN tier, its "
+           "ppermute ticks cross pods.  Must divide the pod count; 1 "
+           "(default) keeps the classic (dcn, ici) 2-axis mesh."),
+        _k("HVDT_EP", 1, int,
+           "Expert-parallel extent of the pod mesh "
+           "(parallel.mesh.pod_mesh_spec): carves chips inside each "
+           "pod into expert ranks — the ep axis rides the ICI tier, "
+           "the MoE dispatch/combine a2a stays on-pod.  Must divide "
+           "the pod size; 1 (default) keeps the classic 2-axis mesh."),
+        _k("HVDT_MOE_CAPACITY_FACTOR", 1.25, float,
+           "Default expert capacity factor for "
+           "parallel.moe.moe_dispatch_combine: per-expert slots = "
+           "ceil(tokens * top_k / experts * factor).  Tokens over "
+           "capacity are dropped (residual passthrough); "
+           "hvdt_moe_dropped_fraction reports the realized drop rate."),
+        _k("HVDT_MOE_TOPK", 1, int,
+           "Default experts-per-token for "
+           "parallel.moe.moe_dispatch_combine (gates renormalized "
+           "over the chosen k; 1 = switch routing).  Primary choices "
+           "claim capacity before secondary ones."),
+        _k("HVDT_PEAK_FLOPS", NOMINAL_SIM_PEAK_FLOPS, float,
+           "Nominal peak FLOP/s for parallel.pipeline."
+           "report_pipeline_mfu (per-chip peak x chips).  On the CPU "
+           "sim any consistent value works — MFU is a ratio; the "
+           "hvdt_pipeline_mfu gauge carries the result."),
+        _k("HVDT_PIPELINE_MICROBATCHES", 8, int,
+           "Default 1F1B microbatch count (the pipeline autotune "
+           "dimension's starting point; bench.py --pipeline default). "
+           "More microbatches shrink the bubble fraction "
+           "(p-1)/(m+p-1) at the cost of smaller per-tick payloads."),
+        _k("HVDT_AUTOTUNE_MOE", False, _parse_bool,
+           "Add an expert capacity-factor dimension to the autotune "
+           "search space; the step builder is rebuilt with "
+           "capacity_factor=... at each knob change "
+           "(autotune.AutotunedStep), hot-swappable because capacity "
+           "changes the dispatch layout, never optimizer state.  "
+           "Starting point: HVDT_MOE_CAPACITY_FACTOR set explicitly, "
+           "the measured HVDT_AUTOTUNE_MOE_SEED verdict, or the cost "
+           "model's a2a-wire ordering (HVDT_AUTOTUNE_MODEL_SEED)."),
+        _k("HVDT_AUTOTUNE_MOE_SEED", "", str,
+           "Path to a bench.py --moe --json-out file; its measured "
+           "capacity_factor_at_peak becomes the autotuner's MoE "
+           "dimension starting point — policies are seeded from "
+           "measurements, not guesses (mirrors "
+           "HVDT_AUTOTUNE_TRANSPORT_SEED)."),
+        _k("HVDT_AUTOTUNE_PIPELINE", False, _parse_bool,
+           "Add a 1F1B microbatch-count dimension to the autotune "
+           "search space; the step builder is rebuilt with "
+           "microbatches=... at each knob change "
+           "(autotune.AutotunedStep), hot-swappable because the "
+           "microbatch clock changes lowering, never state.  Starting "
+           "point: HVDT_PIPELINE_MICROBATCHES set explicitly, the "
+           "measured HVDT_AUTOTUNE_PIPELINE_SEED verdict, or the "
+           "cost model's ppermute ordering (HVDT_AUTOTUNE_MODEL_SEED)."),
+        _k("HVDT_AUTOTUNE_PIPELINE_SEED", "", str,
+           "Path to a bench.py --pipeline --json-out file; its "
+           "measured microbatches_at_peak becomes the autotuner's "
+           "pipeline dimension starting point (mirrors "
+           "HVDT_AUTOTUNE_MOE_SEED)."),
         # --- activation rematerialization (models/: jax.checkpoint
         #     policy on the transformer block — the second half of the
         #     memory-for-MFU trade next to HVDT_ZERO) ---

@@ -101,6 +101,7 @@ __all__ = [
     "zero_transform", "zero_sgd", "zero_adam", "zero_from_optimizer",
     "state_metadata", "reshard_state", "shard_align",
     "extract_shard_rows", "implant_shard_rows",
+    "flatten_state_buffers", "rebucket_state", "concat_states",
 ]
 
 STAGES: Tuple[str, ...] = ("off", "grads", "states", "params")
@@ -1201,3 +1202,114 @@ def reshard_state(state, meta: Dict[str, Any], new_num_shards: int):
          "dtype": b["dtype"]}
         for sz, b in zip(sizes, meta["buckets"])]
     return new_state, new_meta
+
+
+# ---------------------------------------------------------------------------
+# Layout-change restore (4D mesh): the shard/gather-fn generalization of
+# reshard_state.  reshard_state only changes the shard COUNT of an
+# unchanged bucketization; a parallelism-layout change — merging
+# pipeline-stage checkpoints into one data-parallel state, or splitting
+# a flat state back onto pipeline stages — also changes the BUCKET
+# boundaries (each stage buckets only its own parameters).  Both
+# directions factor through the same invariant: strip every bucket's
+# alignment padding, concatenate in bucket order, and the result is the
+# *global logical vector* in deterministic parameter order.  Any target
+# layout whose parameter order matches (stage-major — stage 0's
+# parameters before stage 1's, the order ``plan_for`` walks a stacked
+# param tree in) is then a pure re-split of that vector.
+# ---------------------------------------------------------------------------
+
+
+def _state_buffers(state):
+    """``[(name, stacks)]`` for either state flavour (mu/nu or trace)."""
+    if hasattr(state, "mu"):
+        return [("mu", state.mu), ("nu", state.nu)]
+    return [("trace", state.trace)]
+
+
+def flatten_state_buffers(state, meta: Dict[str, Any]):
+    """``{buffer_name: global logical vector}`` (host numpy): every
+    ``[n, shard_len]`` bucket stack stripped of alignment padding and
+    concatenated in bucket order."""
+    import numpy as np
+
+    sizes = [int(b["size"]) for b in meta["buckets"]]
+    out = {}
+    for name, stacks in _state_buffers(state):
+        out[name] = np.concatenate(
+            [np.asarray(s).reshape(-1)[:sz]
+             for s, sz in zip(stacks, sizes)]) if stacks else \
+            np.zeros((0,), np.float32)
+    return out
+
+
+def _split_logical(flat, buckets, num_shards: int):
+    """Re-split one global logical vector into ``[num_shards,
+    shard_len]`` stacks per the target bucket list."""
+    import numpy as np
+
+    stacks = []
+    off = 0
+    for b in buckets:
+        sz, sl = int(b["size"]), int(b["shard_len"])
+        chunk = flat[off:off + sz]
+        off += sz
+        padded = np.zeros((num_shards * sl,), chunk.dtype)
+        padded[:sz] = chunk
+        stacks.append(jnp.asarray(padded.reshape(num_shards, sl)))
+    if off != flat.size:
+        raise ValueError(
+            f"target buckets cover {off} elements but the saved state "
+            f"holds {flat.size} — the layouts describe different "
+            "parameter sets")
+    return tuple(stacks)
+
+
+def rebucket_state(state, meta: Dict[str, Any],
+                   new_meta: Dict[str, Any]):
+    """Re-lay a saved ZeRO state onto a DIFFERENT bucketization and/or
+    shard count (same total logical size) via the global flat vector.
+    Returns the new state; ``new_meta`` (``state_metadata`` of the
+    target transform) is authoritative for the result layout."""
+    flats = flatten_state_buffers(state, meta)
+    n = int(new_meta["num_shards"])
+    buckets = new_meta["buckets"]
+    if hasattr(state, "mu"):
+        return ZeroAdamState(
+            count=jnp.asarray(state.count),
+            mu=_split_logical(flats["mu"], buckets, n),
+            nu=_split_logical(flats["nu"], buckets, n))
+    return ZeroSgdState(trace=_split_logical(flats["trace"], buckets, n))
+
+
+def concat_states(states, metas):
+    """Concatenate per-pipeline-stage ZeRO states (stage-major order)
+    into one combined ``(state, meta)`` whose bucket list is the stage
+    bucket lists in order.  All stages must agree on shard count,
+    alignment and state flavour; the combined meta carries
+    ``layout={"pp": n_stages, "dp": num_shards}``."""
+    if not states:
+        raise ValueError("concat_states needs at least one state")
+    first = metas[0]
+    for m in metas[1:]:
+        if int(m["num_shards"]) != int(first["num_shards"]):
+            raise ValueError("stage checkpoints disagree on num_shards")
+        if int(m.get("align", 256)) != int(first.get("align", 256)):
+            raise ValueError("stage checkpoints disagree on alignment")
+    kinds = {hasattr(s, "mu") for s in states}
+    if len(kinds) != 1:
+        raise ValueError("stage checkpoints mix Adam and SGD states")
+    buffers = {}
+    for name, _ in _state_buffers(states[0]):
+        buffers[name] = tuple(
+            stack for st in states
+            for stack in dict(_state_buffers(st))[name])
+    if hasattr(states[0], "mu"):
+        state = ZeroAdamState(count=jnp.asarray(states[0].count),
+                              mu=buffers["mu"], nu=buffers["nu"])
+    else:
+        state = ZeroSgdState(trace=buffers["trace"])
+    meta = dict(first)
+    meta["buckets"] = [dict(b) for m in metas for b in m["buckets"]]
+    meta["layout"] = {"pp": len(states), "dp": int(first["num_shards"])}
+    return state, meta

@@ -265,7 +265,9 @@ def DistributedOptimizer(optimizer,
                          threshold_bytes: Optional[int] = None,
                          prescale_factor: float = 1.0,
                          postscale_factor: float = 1.0,
-                         zero: Optional[Any] = None):
+                         zero: Optional[Any] = None,
+                         pipeline: Optional[str] = None,
+                         expert: Optional[str] = None):
     """Wrap an optax optimizer so gradients are averaged across the mesh
     axis before the update (ref: torch/optimizer.py:516 DistributedOptimizer
     factory; same call-shape philosophy: wrap and use as usual).
@@ -302,11 +304,33 @@ def DistributedOptimizer(optimizer,
         ``num_shards``/threshold, or None (default) to read
         ``HVDT_ZERO``.  Unset/off keeps the replicated chain as the
         identical pre-existing code objects (identity-tested).
+      pipeline: mesh axis name the step's parameters are PIPELINE-sharded
+        over (parallel.pipeline_1f1b stages).  A sharded axis is the
+        opposite of a reduce axis — every rank owns different stage
+        params, so their gradients must stay per-rank.  Declaring it
+        here is the 4D-mesh contract: the wrapper refuses an ``axis``
+        that overlaps it (reducing over ``pp`` would average unrelated
+        stages' gradients into garbage), and ZeRO keeps sharding state
+        WITHIN the remaining ``axis`` group only.
+      expert: mesh axis name expert parameters are sharded over
+        (parallel.moe_dispatch_combine).  Same contract as ``pipeline``:
+        per-rank experts, per-rank gradients, excluded from the reduce
+        group.
     """
     import optax
 
     from .ops import zero as _zero
 
+    reduce_axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    for kind, sharded in (("pipeline", pipeline), ("expert", expert)):
+        if sharded is not None and sharded in reduce_axes:
+            raise ValueError(
+                f"{kind}={sharded!r} names a parameter-SHARDED mesh axis "
+                f"but axis={axis!r} would reduce gradients over it — "
+                f"every {sharded} rank owns different parameters, so "
+                "averaging across it destroys them.  Drop it from the "
+                "reduce group (ZeRO then shards state within the "
+                "remaining data-parallel group).")
     _stage = _zero.resolve_stage(zero)
     if compression is None:
         compression = Compression.from_env()
@@ -334,7 +358,8 @@ def DistributedOptimizer(optimizer,
             "DistributedOptimizer constructions, labelled op/compression"
         ).inc(op=ReduceOp(op).name.lower(),
               compression=getattr(compression, "__name__", "none"),
-              backward_passes=str(backward_passes_per_step))
+              backward_passes=str(backward_passes_per_step),
+              pipeline=pipeline or "off", expert=expert or "off")
     comm = DistributedGradientTransformation(
         axis=axis, op=op, compression=compression,
         threshold_bytes=threshold_bytes, prescale_factor=prescale_factor,

@@ -31,6 +31,8 @@ from .mesh import (  # noqa: F401
     MeshSpec,
     make_mesh,
     mesh_shape_for,
+    pod_axis_tiers,
+    pod_mesh_spec,
 )
 from .sharding import (  # noqa: F401
     batch_spec,
@@ -40,5 +42,15 @@ from .sharding import (  # noqa: F401
     transformer_rules,
 )
 from .ring_attention import ring_attention  # noqa: F401
-from .pipeline import pipeline_spmd  # noqa: F401
-from .moe import moe_dispatch_combine  # noqa: F401
+from .pipeline import (  # noqa: F401
+    bubble_fraction,
+    pipeline_1f1b,
+    pipeline_spmd,
+    report_pipeline_mfu,
+)
+from .moe import (  # noqa: F401
+    MoEAux,
+    moe_capacity,
+    moe_dispatch_combine,
+    report_moe_aux,
+)

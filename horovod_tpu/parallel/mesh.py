@@ -46,6 +46,7 @@ __all__ = [
     "CANONICAL_AXES", "TRANSPORT_ICI", "TRANSPORT_DCN",
     "TRANSPORT_CLASSES", "axis_transport_class", "split_transport_axes",
     "MeshSpec", "make_mesh", "mesh_shape_for", "pod_mesh_spec",
+    "pod_axis_tiers",
 ]
 
 
@@ -86,9 +87,13 @@ def split_transport_axes(axes: Sequence[str], fast_width: int = 1
 
 
 def pod_mesh_spec(num_pods: Optional[int] = None,
-                  pod_size: Optional[int] = None) -> "MeshSpec":
-    """The two-level data-parallel mesh of the elastic pod contract:
-    axes ``("dcn", "ici")`` sized ``(num_pods, pod_size)``.
+                  pod_size: Optional[int] = None,
+                  *,
+                  pp: Optional[int] = None,
+                  ep: Optional[int] = None) -> "MeshSpec":
+    """The data-parallel mesh of the elastic pod contract — axes
+    ``("dcn", "ici")`` sized ``(num_pods, pod_size)`` — optionally
+    extended to the 4D layout with pipeline/expert degrees.
 
     Defaults come from the pod-aware launcher's worker env
     (``HVDT_NUM_PODS`` / ``HVDT_POD_SIZE``, runner/hosts.SlotInfo.to_env
@@ -98,7 +103,18 @@ def pod_mesh_spec(num_pods: Optional[int] = None,
     puts ``ici`` in the fast tier and ``dcn`` in the slow one, and the
     PR-8 policy grammar matches them directly — cross-pod gradient
     exchange rides the ``dcn`` policy (int8 + error feedback under
-    ``HVDT_TRANSPORT=...,dcn:tree:int8:8M``) with no extra wiring.
+    ``HVDT_TRANSPORT=...,dcn:tree:8M``) with no extra wiring.
+
+    4D extension (``pp``/``ep``, default the ``HVDT_PP``/``HVDT_EP``
+    env): pipeline stages are latency-tolerant point-to-point hops, so
+    ``pp`` carves pod GROUPS out of the DCN tier (``pp`` must divide
+    ``num_pods``); expert alltoall is bandwidth-hungry, so ``ep``
+    carves chips out of the ICI tier inside each pod (``ep`` must
+    divide ``pod_size``).  The resulting axis order
+    ``(pp, dcn, ici, ep)`` keeps the data-parallel reduce group at
+    ``("dcn", "ici")`` — ZeRO shards and gradient hierarchies are
+    unchanged — and :func:`pod_axis_tiers` names each axis's physical
+    tier for pricing and policy defaults.
     """
     import os
 
@@ -109,12 +125,52 @@ def pod_mesh_spec(num_pods: Optional[int] = None,
         if pod_size <= 0:
             pod_size = int(os.environ.get("HVDT_SIZE", "1") or 1) \
                 // max(1, num_pods)
+    if pp is None:
+        pp = int(os.environ.get("HVDT_PP", "1") or 1)
+    if ep is None:
+        ep = int(os.environ.get("HVDT_EP", "1") or 1)
     if num_pods < 1 or pod_size < 1:
         raise ValueError(
             f"pod mesh needs num_pods >= 1 and pod_size >= 1, got "
             f"({num_pods}, {pod_size})")
-    return MeshSpec(axes=((TRANSPORT_DCN, int(num_pods)),
-                          (TRANSPORT_ICI, int(pod_size))))
+    if pp < 1 or ep < 1:
+        raise ValueError(f"pp and ep must be >= 1, got ({pp}, {ep})")
+    if pp == 1 and ep == 1:
+        return MeshSpec(axes=((TRANSPORT_DCN, int(num_pods)),
+                              (TRANSPORT_ICI, int(pod_size))))
+    if num_pods % pp:
+        raise ValueError(
+            f"pipeline degree pp={pp} must divide num_pods={num_pods} "
+            "(stages are pod groups on the DCN tier)")
+    if pod_size % ep:
+        raise ValueError(
+            f"expert degree ep={ep} must divide pod_size={pod_size} "
+            "(experts share a pod's ICI tier)")
+    axes: List[Tuple[str, int]] = []
+    if pp > 1:
+        axes.append((AXIS_PP, int(pp)))
+    axes.append((TRANSPORT_DCN, int(num_pods // pp)))
+    axes.append((TRANSPORT_ICI, int(pod_size // ep)))
+    if ep > 1:
+        axes.append((AXIS_EP, int(ep)))
+    return MeshSpec(axes=tuple(axes))
+
+
+def pod_axis_tiers(spec: "MeshSpec") -> Dict[str, str]:
+    """Physical tier of each axis in a pod-contract mesh spec.
+
+    Axes at or outside the ``dcn`` axis cross pod boundaries (``pp``
+    hops ride DCN); axes at or inside the ``ici`` axis stay within a
+    pod (``ep`` alltoall rides ICI).  Cost pricing and transport-policy
+    class defaults consult this instead of guessing from reduce-group
+    position — a single-axis ``pp`` group would otherwise classify as
+    ICI under :func:`axis_transport_class`'s innermost-is-fast rule.
+    """
+    names = spec.names
+    boundary = names.index(TRANSPORT_ICI) if TRANSPORT_ICI in names \
+        else len(names) - 1
+    return {name: (TRANSPORT_ICI if i >= boundary else TRANSPORT_DCN)
+            for i, name in enumerate(names)}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -214,8 +270,23 @@ def make_mesh(spec: Optional[MeshSpec] = None,
     # Auto axes = classic GSPMD propagation: plain model code works and the
     # partitioner inserts collectives.  Explicit (sharding-in-types) mode is
     # opt-in for users who want shardings checked in the type system.
-    from jax.sharding import AxisType
-
+    # jax <= 0.4.x has no AxisType (every mesh axis is Auto-equivalent):
+    # degrade to a plain Mesh there — explicit_sharding needs the type
+    # system and cannot be honoured, so it raises rather than silently
+    # weakening the user's contract.
+    try:
+        from jax.sharding import AxisType
+    except ImportError:
+        AxisType = None
+    if AxisType is None:
+        if explicit_sharding:
+            raise NotImplementedError(
+                "explicit_sharding=True needs jax.sharding.AxisType "
+                "(sharding-in-types); this JAX build predates it")
+        if len(devices) == spec.total and devices == list(jax.devices()):
+            return jax.make_mesh(shape, spec.names)
+        used = np.asarray(devices[: spec.total], dtype=object).reshape(shape)
+        return Mesh(used, spec.names)
     kind = AxisType.Explicit if explicit_sharding else AxisType.Auto
     axis_types = (kind,) * len(shape)
     if len(devices) == spec.total and devices == list(jax.devices()):

@@ -1,26 +1,151 @@
-"""Expert parallelism: switch-style top-1 MoE with alltoall token routing.
+"""Expert parallelism: capacity-factor top-k MoE with alltoall token routing.
 
 The reference exposes the raw alltoall primitive that makes user-level MoE
 possible (ref: operations.cc:1642-1725, ops/collective_operations.h:195
 AlltoallOp) but ships no EP layer (SURVEY.md §2.7).  Here the full dispatch
 → expert → combine path is provided, TPU-style: static capacity (no dynamic
-shapes for XLA), ``lax.all_to_all`` over the ``ep`` mesh axis riding ICI.
+shapes for XLA), ``lax.all_to_all`` over the ``ep`` mesh axis.
+
+The token exchange rides the transport-policy layer
+(horovod_tpu/transport): an ``HVDT_TRANSPORT=ep:ring:int8:8M`` entry puts
+the dispatch/combine payloads on the block-scaled int8 wire (quant/kernels
+— real int8 bytes plus f32 block scales on the wire, f32 math on both
+ends), exactly like the gradient allreduce's per-axis wire override.
+Both alltoalls are booked against the trace-time telemetry and flight
+recorder (ops/device.fused_allreduce idiom), so ``hvdt_collective_*``
+series and desync forensics cover expert routing with no extra wiring.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+import os
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["moe_dispatch_combine", "MoEAux"]
+from ..ops.device import _axis_size_static
+
+__all__ = ["moe_dispatch_combine", "MoEAux", "moe_capacity",
+           "report_moe_aux"]
 
 
 class MoEAux(NamedTuple):
     load_balance_loss: jax.Array   # switch-transformer aux loss (scalar)
     dropped_fraction: jax.Array    # fraction of tokens over capacity (scalar)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def moe_capacity(tokens_per_rank: int, num_experts: int, *,
+                 top_k: int = 1, capacity_factor: float = 1.25) -> int:
+    """Per-expert dispatch slots: ``ceil(T·k/E · factor)``, floor 1.
+
+    The static-shape contract every tensor in the dispatch path is sized
+    by (GShard's expert capacity) — XLA never sees a data-dependent
+    shape; tokens beyond it are dropped (residual passthrough)."""
+    want = tokens_per_rank * top_k * capacity_factor
+    return max(1, int(-(-want // num_experts)))
+
+
+def _a2a_transport(block: jax.Array, axis: str, name: str):
+    """``lax.all_to_all`` over ``axis`` with the transport policy's wire.
+
+    ``block`` is ``[ep, ...]`` (leading dim = axis size; slice i goes to
+    rank i).  Resolves ``axis`` against ``HVDT_TRANSPORT`` exactly like
+    the fused allreduce: an int8 wire sends block-scaled int8 payloads +
+    f32 scales (two alltoalls, f32 restore on arrival); bf16/fp16 cast
+    down for the flight; unset keeps the exact-dtype exchange.  Books
+    the trace-time collective counters and one flight-recorder event
+    per traced program."""
+    from ..telemetry import flight_recorder as _frm
+    from ..telemetry import instrument as _ti
+    from ..transport import policy as _tpolicy
+
+    _res = _tpolicy.resolve_axis(axis)
+    wire = _res.fast.wire if _res is not None else None
+
+    orig_dtype = block.dtype
+    ep = block.shape[0]
+    rest = int(block.size) // ep
+    payload_bytes = int(block.size) * jnp.dtype(orig_dtype).itemsize
+    wire_label = jnp.dtype(orig_dtype).name
+
+    def _a2a(x):
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+
+    int8_wire = (wire == "int8"
+                 and jnp.issubdtype(orig_dtype, jnp.floating))
+    cast_wire = (wire in ("bf16", "fp16")
+                 and jnp.issubdtype(orig_dtype, jnp.floating))
+
+    if int8_wire:
+        from ..quant.kernels import (dequantize_flat, quant_block_size,
+                                     quantize_flat)
+
+        shape = block.shape
+        bs = quant_block_size()
+        pad = (-rest) % bs
+        rows = block.reshape(ep, rest).astype(jnp.float32)
+        if pad:
+            rows = jnp.concatenate(
+                [rows, jnp.zeros((ep, pad), jnp.float32)], axis=1)
+        padded = rest + pad
+        # Row boundaries align with block boundaries after padding, so
+        # one flat quantize covers all rows.
+        q, scales = quantize_flat(rows.reshape(-1), bs)
+        wire_label = "int8_blockwise"
+        payload_bytes = int(q.size) + int(scales.size) * 4
+        with jax.named_scope(f"hvdt.moe_a2a.{name}"):
+            recv_q = _a2a(q.reshape(ep, padded))
+            recv_s = _a2a(scales.reshape(ep, padded // bs))
+        out = dequantize_flat(recv_q.reshape(-1),
+                              recv_s.reshape(-1), bs)
+        out = out.reshape(ep, padded)
+        if pad:
+            out = out[:, :rest]
+        result = out.reshape(shape).astype(orig_dtype)
+    else:
+        x = block
+        if cast_wire:
+            wdt = jnp.bfloat16 if wire == "bf16" else jnp.float16
+            x = x.astype(wdt)
+            wire_label = jnp.dtype(wdt).name
+            payload_bytes = int(x.size) * jnp.dtype(wdt).itemsize
+        with jax.named_scope(f"hvdt.moe_a2a.{name}"):
+            result = _a2a(x)
+        if result.dtype != orig_dtype:
+            result = result.astype(orig_dtype)
+
+    _rec = _ti.get_recorder()
+    _flight = _frm.get_flight_recorder()
+    if _rec is not None:
+        _rec.record_collective(
+            "alltoall", jnp.dtype(orig_dtype).name, wire_label,
+            payload_bytes, count=1, path="jit", axis=axis)
+    if _flight is not None:
+        _flight.record(
+            op="alltoall", name=name, dtype=jnp.dtype(orig_dtype).name,
+            shape=tuple(int(s) for s in block.shape),
+            nbytes=payload_bytes, wire=wire_label, path="jit",
+            count=1, axis=axis)
+    return result
 
 
 def moe_dispatch_combine(tokens: jax.Array,
@@ -29,21 +154,32 @@ def moe_dispatch_combine(tokens: jax.Array,
                          *,
                          axis: str = "ep",
                          experts_per_rank: int = 1,
-                         capacity_factor: float = 1.25) -> Tuple[jax.Array, MoEAux]:
-    """Route each token to its top-1 expert across the ``ep`` axis.
+                         capacity_factor: Optional[float] = None,
+                         top_k: Optional[int] = None
+                         ) -> Tuple[jax.Array, MoEAux]:
+    """Route each token to its top-k experts across the ``ep`` axis.
 
     Must run inside shard_map with ``axis`` bound.  Tokens over a full
     expert's capacity are dropped (residual passthrough — standard switch
-    behavior).
+    behavior); primary (k=0) choices claim capacity before secondary
+    ones, so overflow sheds the lowest-gate assignments first.
 
     Args:
       tokens: local tokens ``[T, D]``.
       router_logits: ``[T, E]`` where ``E = ep_size * experts_per_rank``.
       expert_fn: vmapped-over-experts body ``[E_local, N, D] -> [E_local, N, D]``.
-      capacity_factor: per-expert slots = ceil(T/E * factor).
+      capacity_factor: per-expert slots = ceil(T·k/E · factor); defaults
+        to ``HVDT_MOE_CAPACITY_FACTOR`` (1.25).
+      top_k: experts per token, gates renormalized over the chosen k;
+        defaults to ``HVDT_MOE_TOPK`` (1, switch routing).
 
     Returns (combined ``[T, D]``, MoEAux).
     """
+    if capacity_factor is None:
+        capacity_factor = _env_float("HVDT_MOE_CAPACITY_FACTOR", 1.25)
+    if top_k is None:
+        top_k = _env_int("HVDT_MOE_TOPK", 1)
+    k = max(1, int(top_k))
     t, d = tokens.shape
     ep = _axis_size_static(axis)
     e_total = ep * experts_per_rank
@@ -51,48 +187,102 @@ def moe_dispatch_combine(tokens: jax.Array,
         raise ValueError(
             f"router logits last dim {router_logits.shape[-1]} != "
             f"ep*experts_per_rank = {e_total}")
-    cap = max(1, int(-(-t * capacity_factor // e_total)))  # ceil
+    if k > e_total:
+        raise ValueError(f"top_k={k} exceeds {e_total} experts")
+    cap = moe_capacity(t, e_total, top_k=k,
+                       capacity_factor=capacity_factor)
 
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                     # [T]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    top_vals, top_idx = lax.top_k(probs, k)                  # [T, K]
+    gates = top_vals / jnp.maximum(
+        top_vals.sum(-1, keepdims=True), 1e-9)               # [T, K]
 
-    one_hot = jax.nn.one_hot(expert, e_total, dtype=jnp.float32)   # [T, E]
-    pos = (jnp.cumsum(one_hot, axis=0) - one_hot) * one_hot        # [T, E]
-    pos_in_expert = pos.sum(-1).astype(jnp.int32)                  # [T]
+    # Flatten choices k-major ([K*T]): row k*T + t is token t's k-th
+    # choice, so the cumsum hands capacity to every primary assignment
+    # before any secondary one.
+    expert_f = top_idx.T.reshape(-1)                         # [K*T]
+    gate_f = gates.T.reshape(-1)                             # [K*T]
+    tokens_f = jnp.tile(tokens, (k, 1))                      # [K*T, D]
+
+    one_hot = jax.nn.one_hot(expert_f, e_total, dtype=jnp.float32)
+    pos = (jnp.cumsum(one_hot, axis=0) - one_hot) * one_hot  # [K*T, E]
+    pos_in_expert = pos.sum(-1).astype(jnp.int32)            # [K*T]
     kept = pos_in_expert < cap
 
     # Scatter local tokens into [E, cap, D] dispatch slots.
     dispatch = jnp.zeros((e_total, cap, d), tokens.dtype)
-    idx_e = jnp.where(kept, expert, 0)
+    idx_e = jnp.where(kept, expert_f, 0)
     idx_c = jnp.where(kept, pos_in_expert, 0)
     weight = jnp.where(kept, 1.0, 0.0)
     dispatch = dispatch.at[idx_e, idx_c].add(
-        tokens * weight[:, None].astype(tokens.dtype))
+        tokens_f * weight[:, None].astype(tokens.dtype))
 
     # [E, cap, D] -> [ep, E_local, cap, D] -> alltoall over ep.
     dispatch = dispatch.reshape(ep, experts_per_rank, cap, d)
-    recv = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=0,
-                          tiled=False)                  # [ep(src), E_l, cap, D]
+    recv = _a2a_transport(dispatch, axis, "moe.dispatch")
     # Fold source-rank dim into the capacity dim for the expert body.
     recv = recv.transpose(1, 0, 2, 3).reshape(experts_per_rank, ep * cap, d)
     processed = expert_fn(recv)
     processed = processed.reshape(experts_per_rank, ep, cap, d).transpose(
         1, 0, 2, 3)
-    back = lax.all_to_all(processed, axis, split_axis=0, concat_axis=0,
-                          tiled=False)                  # [ep, E_l, cap, D]
+    back = _a2a_transport(processed, axis, "moe.combine")
     back = back.reshape(e_total, cap, d)
 
-    # Combine: gather each kept token's slot, weight by its gate.
-    out = back[idx_e, idx_c] * (gate * weight).astype(tokens.dtype)[:, None]
+    # Combine: gather each kept slot, weight by its renormalized gate.
+    slots = back[idx_e, idx_c] * (gate_f * weight).astype(
+        tokens.dtype)[:, None]                               # [K*T, D]
+    out = slots.reshape(k, t, d).sum(axis=0)
 
-    # Switch-transformer load-balancing loss: E * Σ_e f_e · P_e, where f is
-    # the routed fraction and P the mean router prob — averaged globally.
-    f = one_hot.mean(axis=0)
-    p_mean = probs.mean(axis=0)
-    f = lax.pmean(f, axis)
-    p_mean = lax.pmean(p_mean, axis)
+    # Switch-transformer load-balancing loss over the PRIMARY routing:
+    # E * Σ_e f_e · P_e, where f is the top-1 routed fraction and P the
+    # mean router prob — averaged globally (reduces to the classic
+    # switch loss at k=1).
+    primary = jax.nn.one_hot(top_idx[:, 0], e_total, dtype=jnp.float32)
+    f = lax.pmean(primary.mean(axis=0), axis)
+    p_mean = lax.pmean(probs.mean(axis=0), axis)
     aux = MoEAux(
         load_balance_loss=e_total * jnp.sum(f * p_mean),
         dropped_fraction=lax.pmean(1.0 - kept.mean(), axis))
+
+    from ..telemetry import instrument as _ti
+
+    _rec = _ti.get_recorder()
+    if _rec is not None:
+        # Static routing geometry, booked at trace time (path=jit
+        # convention): slot count and the slot/token expansion the
+        # capacity factor buys.
+        _rec.registry.gauge(
+            "hvdt_moe_capacity_slots",
+            "Per-expert dispatch slots of the last traced MoE layer "
+            "(ceil(T*k/E * capacity_factor))").set(float(cap))
+        _rec.registry.gauge(
+            "hvdt_moe_expansion_ratio",
+            "Dispatch slots / routed assignments of the last traced "
+            "MoE layer (capacity head-room; <1 guarantees drops)"
+        ).set(float(cap * e_total) / float(t * k))
     return out, aux
+
+
+def report_moe_aux(aux: MoEAux, *, step: Optional[int] = None) -> None:
+    """Host-side per-step reporter for the routing aux outputs.
+
+    The traced program returns ``MoEAux`` as arrays; the train loop
+    calls this after the step to surface them as ``hvdt_moe_*`` gauges
+    (attribution-plane idiom — the time-series/anomaly layer picks the
+    gauges up from the registry).  No-op when telemetry is off."""
+    from ..telemetry import instrument as _ti
+
+    _rec = _ti.get_recorder()
+    if _rec is None:
+        return
+    del step
+    _rec.registry.gauge(
+        "hvdt_moe_load_balance_loss",
+        "Switch-transformer load-balance aux loss of the last "
+        "reported step (E * sum_e f_e * P_e)").set(
+        float(jax.device_get(aux.load_balance_loss)))
+    _rec.registry.gauge(
+        "hvdt_moe_dropped_fraction",
+        "Fraction of routed token assignments dropped over expert "
+        "capacity in the last reported step").set(
+        float(jax.device_get(aux.dropped_fraction)))

@@ -238,7 +238,7 @@ class Router:
         self._retries = m.counter(
             "hvdt_router_retries_total",
             "Dispatch attempts retried on another replica after a "
-            "wire/5xx failure")
+            "wire/5xx failure, by tenant")
         self._hedges = m.counter(
             "hvdt_router_hedges_total",
             "Hedge requests issued past the hedge threshold, by tenant")
@@ -248,7 +248,8 @@ class Router:
         self._ejections = m.counter(
             "hvdt_router_ejections_total",
             "Replicas pulled from routing, labelled reason="
-            "heartbeat|probe|slo|dispatch")
+            "heartbeat|probe|slo|dispatch and the tenant whose traffic "
+            "triggered it (tenant=control for control-loop ejections)")
         self._readmissions = m.counter(
             "hvdt_router_readmissions_total",
             "Ejected replicas re-admitted after cooldown with a fresh "
@@ -323,7 +324,8 @@ class Router:
                     log.info("router: replica %d deregistered after "
                              "drain", rid)
                 else:
-                    self._ejections.inc(reason="heartbeat")
+                    self._ejections.inc(reason="heartbeat",
+                                        tenant="control")
                     log.warning("router: replica %d heartbeat stale "
                                 "(> %.1fs) — removed from routing",
                                 rid, liveness)
@@ -341,10 +343,11 @@ class Router:
                             f"reported p99 {float(p99):.1f}ms breaches "
                             f"SLO {self.slo_p99_ms:.1f}ms")
 
-    def _eject(self, view: ReplicaView, reason: str, why: str) -> None:
+    def _eject(self, view: ReplicaView, reason: str, why: str,
+               tenant: str = "control") -> None:
         view.state.blacklist()
         view.ejected = True
-        self._ejections.inc(reason=reason)
+        self._ejections.inc(reason=reason, tenant=tenant)
         log.warning("router: ejecting replica %d (%s: %s; cooldown "
                     "%.1fs base)", view.id, reason, why,
                     self.eject_cooldown_s)
@@ -574,13 +577,13 @@ class Router:
                 # (cooldown applies) and retry the request elsewhere.
                 # This is THE zero-dropped-request path for a crash.
                 if isinstance(e, (ConnectionError, OSError)):
-                    self._eject(view, "dispatch", repr(e))
+                    self._eject(view, "dispatch", repr(e), tenant=tenant)
                 tried.add(view.id)
                 if not retry or time.monotonic() >= deadline:
                     return 502, json.dumps(
                         {"error": f"replica {view.id} failed: {e}"}
                     ).encode(), view.id
-                self._retries.inc()
+                self._retries.inc(tenant=tenant)
                 backoff.sleep()
                 continue
             if status >= 500 or status == 503:
@@ -590,7 +593,7 @@ class Router:
                 tried.add(view.id)
                 if not retry or time.monotonic() >= deadline:
                     return last_status
-                self._retries.inc()
+                self._retries.inc(tenant=tenant)
                 if not backoff.sleep():
                     return last_status
                 continue

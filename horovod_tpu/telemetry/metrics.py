@@ -565,8 +565,29 @@ CATALOG: Dict[str, MetricSpec] = {
            "Rendezvous-KV bootstrap-wait retries"),
         _m("hvdt_kv_errors_total", "counter", ("op",),
            "Rendezvous-KV client op failures by op"),
-        _m("hvdt_distributed_optimizer_builds_total", "counter", (),
-           "DistributedOptimizer/GradientTransformation constructions"),
+        _m("hvdt_distributed_optimizer_builds_total", "counter",
+           ("op", "compression", "backward_passes", "pipeline", "expert"),
+           "DistributedOptimizer/GradientTransformation constructions, "
+           "labelled reduce op / wire compression / accumulation and "
+           "the declared pipeline/expert sharded axes (off when pure "
+           "data-parallel)"),
+        # -- 4D parallel substrate (parallel/moe.py, parallel/pipeline.py) --
+        _m("hvdt_moe_capacity_slots", "gauge", (),
+           "Per-expert dispatch slots of the last traced MoE layer "
+           "(ceil(T*k/E * capacity_factor) — the static-shape capacity "
+           "every dispatch tensor is sized by)"),
+        _m("hvdt_moe_expansion_ratio", "gauge", (),
+           "Dispatch slots / routed assignments of the last traced MoE "
+           "layer (capacity head-room; < 1 guarantees dropped tokens)"),
+        _m("hvdt_moe_load_balance_loss", "gauge", (),
+           "Switch-transformer load-balance aux loss of the last "
+           "reported step (E * sum_e f_e * P_e; report_moe_aux)"),
+        _m("hvdt_moe_dropped_fraction", "gauge", (),
+           "Fraction of routed token assignments dropped over expert "
+           "capacity in the last reported step (report_moe_aux)"),
+        _m("hvdt_pipeline_mfu", "gauge", (),
+           "Model FLOPs utilization of the last reported pipeline step "
+           "(achieved model FLOP/s / peak; report_pipeline_mfu)"),
         # -- serving router (serve/router.py) --
         _m("hvdt_router_requests_total", "counter",
            ("route", "status", "tenant"),
@@ -581,14 +602,17 @@ CATALOG: Dict[str, MetricSpec] = {
            "no labels)"),
         _m("hvdt_router_upstream_latency_ms", "summary", (),
            "Router upstream (replica) dispatch latency (ms)"),
-        _m("hvdt_router_retries_total", "counter", (),
-           "Wire-death retries dispatched to another replica"),
+        _m("hvdt_router_retries_total", "counter", ("tenant",),
+           "Wire-death retries dispatched to another replica, by "
+           "tenant class"),
         _m("hvdt_router_hedges_total", "counter", ("tenant",),
            "Hedge requests issued past the hedge threshold"),
         _m("hvdt_router_hedge_wins_total", "counter", ("tenant",),
            "Hedge requests that answered before the primary"),
-        _m("hvdt_router_ejections_total", "counter", ("reason",),
-           "Replica ejections by reason (probe | slo | dispatch)"),
+        _m("hvdt_router_ejections_total", "counter", ("reason", "tenant"),
+           "Replica ejections by reason (probe | slo | dispatch) and "
+           "the tenant whose traffic triggered them (control-loop "
+           "ejections carry tenant=control)"),
         _m("hvdt_router_readmissions_total", "counter", (),
            "Ejected replicas re-admitted after a fresh heartbeat"),
         _m("hvdt_router_no_replica_total", "counter", (),

@@ -388,7 +388,8 @@ class TestPerfGate:
         assert rc == 0
         doc = json.loads(bl.read_text())
         assert set(doc["entries"]) == {
-            "overlap-plain", "overlap-hier", "overlap-hier-zero"}
+            "overlap-plain", "overlap-hier", "overlap-hier-zero",
+            "parallel4d"}
         for entry in doc["entries"].values():
             assert entry["exposed_comm_s"] > 0
             assert entry["wire_bytes_by_axis"]
@@ -605,7 +606,8 @@ class TestAutotuneModelSeed:
 
     def test_predict_leg_order_shape(self):
         verdict = cm.predict_leg_order(cm.Calibration())
-        assert set(verdict) == {"transport", "quant", "overlap"}
+        assert set(verdict) == {"transport", "quant", "overlap",
+                                "moe", "pipeline"}
         assert all(isinstance(v, bool) for v in verdict.values())
         # defaults: slow dcn, fast ici => hierarchy + overlap pay off
         assert verdict["transport"] is True

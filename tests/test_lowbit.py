@@ -540,7 +540,8 @@ class TestInt4CostModel:
             os.path.join(REPO, cm.CALIBRATION_NAME))
         out = cm.predict_leg_order(
             cal, tp_.TopologySpec(pods=2, chips_per_pod=4))
-        assert set(out) == {"transport", "quant", "overlap"}
+        assert set(out) == {"transport", "quant", "overlap",
+                            "moe", "pipeline"}
         assert isinstance(out["quant"], bool)
 
     def test_int4_sweep_prediction_within_25pct(self):

@@ -5,12 +5,32 @@ correctness on a simulated 8-device mesh, checked against single-device
 dense references.
 """
 
+import inspect
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SMAP_SIG = inspect.signature(_shard_map).parameters
+_SMAP_KW = ({"check_rep": False} if "check_rep" in _SMAP_SIG
+            else ({"check_vma": False} if "check_vma" in _SMAP_SIG
+                  else {}))
+
+
+def shard_map(*args, **kw):
+    kw.pop("check_rep", None)
+    kw.pop("check_vma", None)
+    kw.update(_SMAP_KW)
+    return _shard_map(*args, **kw)
+
 
 from horovod_tpu.parallel import (
     MeshSpec,
@@ -89,7 +109,7 @@ class TestRingAttention:
         q, k, v = (jax.random.normal(kk, (b, l, h, d), jnp.float32)
                    for kk in jax.random.split(key, 3))
         mesh = make_mesh(sp=4, devices=jax.devices()[:4])
-        shard = jax.shard_map(
+        shard = shard_map(
             lambda q, k, v: ring_attention(q, k, v, causal=causal),
             mesh=mesh,
             in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
@@ -106,7 +126,7 @@ class TestRingAttention:
         k = jax.random.normal(key, (b, l, hk, d))
         v = jax.random.normal(key, (b, l, hk, d))
         mesh = make_mesh(sp=2, devices=jax.devices()[:2])
-        got = jax.shard_map(
+        got = shard_map(
             lambda q, k, v: ring_attention(q, k, v, causal=True),
             mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"))(q, k, v)
@@ -124,7 +144,7 @@ class TestRingAttention:
         seg = jnp.concatenate(
             [jnp.zeros((b, 8), jnp.int32), jnp.ones((b, 8), jnp.int32)], 1)
         mesh = make_mesh(sp=4, devices=jax.devices()[:4])
-        got = jax.shard_map(
+        got = shard_map(
             lambda q, k, v, s: ring_attention(q, k, v, causal=True,
                                               segment_ids=s),
             mesh=mesh, in_specs=(P(None, "sp"),) * 3 + (P(None, "sp"),),
@@ -145,7 +165,7 @@ class TestRingAttention:
         mesh = make_mesh(sp=4, devices=jax.devices()[:4])
 
         def loss(q):
-            out = jax.shard_map(
+            out = shard_map(
                 lambda q: ring_attention(q, q, q, causal=True),
                 mesh=mesh, in_specs=P(None, "sp"),
                 out_specs=P(None, "sp"))(q)
@@ -166,7 +186,7 @@ class TestPipeline:
             return jnp.tanh(x @ w)
 
         mesh = make_mesh(pp=4, devices=jax.devices()[:4])
-        out = jax.shard_map(
+        out = shard_map(
             lambda w, x: pipeline_spmd(
                 lambda wp, xp: stage(wp[0], xp), w, x),
             mesh=mesh, in_specs=(P("pp"), P(None)), out_specs=P(None))(ws, xs)
@@ -184,7 +204,7 @@ class TestPipeline:
         mesh = make_mesh(pp=2, devices=jax.devices()[:2])
 
         def loss(ws):
-            out = jax.shard_map(
+            out = shard_map(
                 lambda w, x: pipeline_spmd(lambda wp, xp: xp @ wp[0], w, x),
                 mesh=mesh, in_specs=(P("pp"), P(None)),
                 out_specs=P(None))(ws, xs)
@@ -221,7 +241,7 @@ class TestMoE:
                 tok, lg, expert_fn_factory(local_consts),
                 experts_per_rank=epr, capacity_factor=4.0)
 
-        out, aux = jax.shard_map(
+        out, aux = shard_map(
             body, mesh=mesh, in_specs=(P("ep"), P("ep")),
             out_specs=(P("ep"), P()))(tokens, logits)
         out = np.asarray(out)
@@ -238,7 +258,7 @@ class TestMoE:
         tokens = jnp.ones((8, d))
         logits = jnp.tile(jnp.array([[50.0, 0.0]]), (8, 1))
         mesh = make_mesh(ep=2, devices=jax.devices()[:2])
-        out, aux = jax.shard_map(
+        out, aux = shard_map(
             lambda tok, lg: moe_dispatch_combine(
                 tok, lg, lambda x: x, experts_per_rank=1,
                 capacity_factor=0.25),
@@ -264,7 +284,7 @@ class TestRingAttentionPallas:
         q, k, v = (jax.random.normal(kk, (b, l, h, d), jnp.float32)
                    for kk in jax.random.split(key, 3))
         mesh = make_mesh(sp=sp, devices=jax.devices()[:sp])
-        got = jax.shard_map(
+        got = shard_map(
             lambda q, k, v: ring_attention(q, k, v, causal=causal,
                                            use_pallas=True),
             mesh=mesh, in_specs=(P(None, "sp"),) * 3,
@@ -300,7 +320,7 @@ class TestRingCustomVjp:
         def ring_loss(q, k, v):
             def local(q, k, v):
                 return ring_attention(q, k, v, axis="sp", causal=causal)
-            out = jax.shard_map(local, mesh=mesh,
+            out = shard_map(local, mesh=mesh,
                                 in_specs=(P(None, "sp"),) * 3,
                                 out_specs=P(None, "sp"))(q, k, v)
             return ((out * w) ** 2).sum()
@@ -329,7 +349,7 @@ class TestRingCustomVjp:
             def local(q, seg):
                 return ring_attention(q, q, q, axis="sp", causal=True,
                                       segment_ids=seg)
-            out = jax.shard_map(local, mesh=mesh,
+            out = shard_map(local, mesh=mesh,
                                 in_specs=(P(None, "sp"), P(None, "sp")),
                                 out_specs=P(None, "sp"))(q, seg)
             return (out ** 2).sum()
@@ -369,7 +389,7 @@ class TestRingPallasBackward:
             # blocks with plain indices, which the vma checker rejects
             # for 'sp'-varying operands (same workaround as the forward
             # test above; real TPU lowers natively with check_vma on).
-            out = jax.shard_map(local, mesh=mesh,
+            out = shard_map(local, mesh=mesh,
                                 in_specs=(P(None, "sp"),) * 3,
                                 out_specs=P(None, "sp"),
                                 check_vma=False)(q, k, v)

@@ -517,7 +517,8 @@ class TestRouter:
         router.refresh()
         assert router._routable() == []
         assert router.metrics.get(
-            "hvdt_router_ejections_total").value(reason="slo") == 1
+            "hvdt_router_ejections_total").value(
+            reason="slo", tenant="control") == 1
         time.sleep(0.5)             # cooldown expires
         beat(20.0)                  # and the replica reports healthy
         router.refresh()
@@ -538,7 +539,8 @@ class TestRouter:
         router.refresh()
         assert router._routable() == []
         assert router.metrics.get(
-            "hvdt_router_ejections_total").value(reason="heartbeat") == 1
+            "hvdt_router_ejections_total").value(
+            reason="heartbeat", tenant="control") == 1
 
     def test_draining_replica_leaves_without_ejection_event(
             self, kv_server):

@@ -737,6 +737,179 @@ class TestCheckpointReshard:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+class TestCheckpointLayoutChange:
+    """4D layout-change restore (checkpoint.save/restore_zero_state_4d):
+    a checkpoint saved under (pp=2, dp=4) restores under a flat (dp=8)
+    and the reverse, through the global logical vector — per-stage SHA
+    manifests verified on every path.  Parameter-order contract: global
+    order is stage-major (stage 0's parameters first), which is how the
+    ``{"stage0": ..., "stage1": ...}`` combined tree flattens."""
+
+    def _stage_params(self, si):
+        rng = np.random.RandomState(10 + si)
+        return {"w": jnp.asarray(rng.randint(-4, 4, (16, 128)),
+                                 jnp.float32),
+                "b": jnp.asarray(rng.randint(-4, 4, (33,)),
+                                 jnp.float32)}
+
+    def _trained(self, params, n, seed=0):
+        rng = np.random.RandomState(seed)
+        grads = jax.tree.map(
+            lambda v: jnp.asarray(rng.randint(-40, 40, v.shape),
+                                  jnp.float32), params)
+        tx = z.zero_adam(1e-3, axis="dp", num_shards=n,
+                         threshold_bytes=4096)
+        s = tx.init(params)
+        _, s = tx.update(grads, s, params)
+        _, s = tx.update(grads, s, params)
+        return tx, s
+
+    def _logical(self, state, tx_or_meta, params=None):
+        meta = (tx_or_meta if isinstance(tx_or_meta, dict)
+                else z.state_metadata(tx_or_meta, params))
+        flats = z.flatten_state_buffers(state, meta)
+        return {k: np.asarray(v) for k, v in flats.items()}
+
+    def test_pp2_dp4_to_flat_dp8(self, tmp_path):
+        """Acceptance: save under (pp=2, dp=4), restore under (dp=8);
+        the merged logical vector is the stage-major concatenation of
+        the per-stage ones, bit for bit (the documented merge
+        contract)."""
+        p0, p1 = self._stage_params(0), self._stage_params(1)
+        tx0, s0 = self._trained(p0, 4, seed=0)
+        tx1, s1 = self._trained(p1, 4, seed=1)
+        ckpt.save_zero_state_4d(
+            str(tmp_path), [s0, s1],
+            [z.state_metadata(tx0, p0), z.state_metadata(tx1, p1)],
+            step=2)
+        doc = json.loads((tmp_path / "zero_layout.json").read_text())
+        assert doc["layout"] == {"pp": 2, "dp": 4}
+
+        combined = {"stage0": p0, "stage1": p1}
+        tx8 = z.zero_adam(1e-3, axis="dp", num_shards=8,
+                          threshold_bytes=4096)
+        states, metas, step = ckpt.restore_zero_state_4d(
+            str(tmp_path), [z.state_metadata(tx8, combined)])
+        assert step == 2 and len(states) == 1
+        assert metas[0]["num_shards"] == 8
+        got = self._logical(states[0], metas[0])
+        l0 = self._logical(s0, tx0, p0)
+        l1 = self._logical(s1, tx1, p1)
+        for buf in ("mu", "nu"):
+            np.testing.assert_array_equal(
+                got[buf], np.concatenate([l0[buf], l1[buf]]))
+        assert int(np.asarray(states[0].count)) == 2
+
+    def test_flat_dp8_to_pp2_dp4(self, tmp_path):
+        """The reverse direction: a flat (dp=8) checkpoint splits into
+        two (dp=4) pipeline stages covering the head and tail of its
+        logical vector."""
+        p0, p1 = self._stage_params(0), self._stage_params(1)
+        combined = {"stage0": p0, "stage1": p1}
+        tx8, s8 = self._trained(combined, 8, seed=2)
+        meta8 = z.state_metadata(tx8, combined)
+        ckpt.save_zero_state_4d(str(tmp_path), [s8], [meta8], step=5)
+        tx0 = z.zero_adam(1e-3, axis="dp", num_shards=4,
+                          threshold_bytes=4096)
+        tx1 = z.zero_adam(1e-3, axis="dp", num_shards=4,
+                          threshold_bytes=4096)
+        states, metas, step = ckpt.restore_zero_state_4d(
+            str(tmp_path),
+            [z.state_metadata(tx0, p0), z.state_metadata(tx1, p1)])
+        assert step == 5 and len(states) == 2
+        assert all(m["num_shards"] == 4 for m in metas)
+        whole = self._logical(s8, meta8)
+        g0 = self._logical(states[0], metas[0])
+        g1 = self._logical(states[1], metas[1])
+        for buf in ("mu", "nu"):
+            split = g0[buf].size
+            np.testing.assert_array_equal(g0[buf], whole[buf][:split])
+            np.testing.assert_array_equal(g1[buf], whole[buf][split:])
+
+    def test_dp_only_reshard_through_4d_path(self, tmp_path):
+        """pp=1 save at dp=4 → restore at dp=8 through the 4D entry
+        points: moments identical, and training CONTINUES — the
+        restored transform takes the same next step the saved one
+        would."""
+        p = self._stage_params(0)
+        tx4, s4 = self._trained(p, 4, seed=5)
+        ckpt.save_zero_state_4d(str(tmp_path), [s4],
+                                [z.state_metadata(tx4, p)], step=3)
+        tx8 = z.zero_adam(1e-3, axis="dp", num_shards=8,
+                          threshold_bytes=4096)
+        states, metas, step = ckpt.restore_zero_state_4d(
+            str(tmp_path), [z.state_metadata(tx8, p)])
+        assert step == 3 and metas[0]["num_shards"] == 8
+        f4 = tx4.full_state(s4, p)
+        f8 = tx8.full_state(states[0], p)
+        for a, b in zip(jax.tree.leaves(f4), jax.tree.leaves(f8)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        g = jax.tree.map(jnp.ones_like, p)
+        u4, _ = tx4.update(g, s4, p)
+        u8, _ = tx8.update(g, states[0], p)
+        for k in u4:
+            np.testing.assert_allclose(np.asarray(u4[k]),
+                                       np.asarray(u8[k]),
+                                       rtol=1e-6, atol=1e-9)
+
+    def test_round_trip_through_both_layouts(self, tmp_path):
+        """(pp=2, dp=4) → (dp=8) → (pp=2, dp=4) is the identity on
+        every moment buffer."""
+        p0, p1 = self._stage_params(0), self._stage_params(1)
+        tx0, s0 = self._trained(p0, 4, seed=3)
+        tx1, s1 = self._trained(p1, 4, seed=4)
+        metas0 = [z.state_metadata(tx0, p0), z.state_metadata(tx1, p1)]
+        ckpt.save_zero_state_4d(str(tmp_path / "a"), [s0, s1], metas0,
+                                step=1)
+        combined = {"stage0": p0, "stage1": p1}
+        tx8 = z.zero_adam(1e-3, axis="dp", num_shards=8,
+                          threshold_bytes=4096)
+        flat_states, flat_metas, _ = ckpt.restore_zero_state_4d(
+            str(tmp_path / "a"), [z.state_metadata(tx8, combined)])
+        ckpt.save_zero_state_4d(str(tmp_path / "b"), flat_states,
+                                flat_metas, step=1)
+        back, _, _ = ckpt.restore_zero_state_4d(str(tmp_path / "b"),
+                                                metas0)
+        for orig, rest in zip((s0, s1), back):
+            for a, b in zip(jax.tree.leaves(orig), jax.tree.leaves(rest)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+
+    def test_stage_shard_sha_verified(self, tmp_path):
+        """Tampering with one shard of one STAGE checkpoint fails the
+        restore — the per-stage manifests are actually checked."""
+        p0, p1 = self._stage_params(0), self._stage_params(1)
+        tx0, s0 = self._trained(p0, 4)
+        tx1, s1 = self._trained(p1, 4)
+        ckpt.save_zero_state_4d(
+            str(tmp_path), [s0, s1],
+            [z.state_metadata(tx0, p0), z.state_metadata(tx1, p1)])
+        target = tmp_path / "stage_0001" / "shard_0002.npz"
+        blob = bytearray(target.read_bytes())
+        blob[50] ^= 0xFF
+        target.write_bytes(bytes(blob))
+        combined = {"stage0": p0, "stage1": p1}
+        tx8 = z.zero_adam(1e-3, axis="dp", num_shards=8,
+                          threshold_bytes=4096)
+        with pytest.raises(ValueError, match="SHA-256"):
+            ckpt.restore_zero_state_4d(
+                str(tmp_path), [z.state_metadata(tx8, combined)])
+
+    def test_mismatched_parameter_set_raises(self, tmp_path):
+        """Restoring into a layout covering a different logical vector
+        is a hard error, not silent truncation."""
+        p0, p1 = self._stage_params(0), self._stage_params(1)
+        tx0, s0 = self._trained(p0, 4)
+        ckpt.save_zero_state_4d(str(tmp_path), [s0],
+                                [z.state_metadata(tx0, p0)])
+        combined = {"stage0": p0, "stage1": p1}
+        tx8 = z.zero_adam(1e-3, axis="dp", num_shards=8,
+                          threshold_bytes=4096)
+        with pytest.raises(ValueError, match="logical elements"):
+            ckpt.restore_zero_state_4d(
+                str(tmp_path), [z.state_metadata(tx8, combined)])
+
+
 # ---------------------------------------------------------------------------
 # autotune: the replicated-vs-sharded dimension
 # ---------------------------------------------------------------------------
